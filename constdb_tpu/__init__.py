@@ -1,0 +1,25 @@
+"""constdb-tpu: a TPU-native, Redis-protocol, master-master replicated CRDT store.
+
+A brand-new framework with the capabilities of fxsjy/ConstDB (reference:
+/root/reference — Rust/tokio).  Not a port: the bulk CRDT merge path
+(snapshot ingest, replica catch-up) is a batched JAX/Pallas engine that treats
+replica reconciliation as parallel max/union/sum reductions over columnar
+(key_id, node_id, uuid, value) tensors, sharded over a `jax.sharding.Mesh`.
+The serving plane is a columnar keyspace (numpy struct-of-arrays mirrored to
+device) rather than per-key heap objects.
+
+Layer map (mirrors SURVEY.md §1):
+  utils/      core types: HLC uuids, varint, checksum, byte helpers  (L1)
+  resp/       RESP wire protocol: incremental parser + encoder       (L2)
+  crdt/       CRDT conflict-resolution semantics (pure, shared)      (L7)
+  store/      columnar keyspace: counters/elements/registers, GC     (L7)
+  engine/     MergeEngine boundary: CPU reference + batched JAX      (L7/TPU)
+  ops/        JAX segment/scatter kernels, Pallas hot loops          (TPU)
+  parallel/   mesh + shard_map sharded merge                         (TPU)
+  snapshot/   columnar snapshot format, streaming writer/loader      (L8)
+  server/     asyncio server core, command dispatch, repl log        (L3-L6)
+  replica/    MEET/SYNC, puller/pusher state machines                (L9)
+  stats/      metrics + INFO                                         (L10)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from .bytesutil import bytes2i64, bytes2u64, i64_to_bytes
+from .hlc import HLC, uuid_ms, uuid_seq, now_ms, now_secs
+from .varint import write_uvarint, write_varint, read_uvarint, read_varint, VarintReader
+from .checksum import StreamChecksum, crc64
+
+__all__ = [
+    "bytes2i64", "bytes2u64", "i64_to_bytes",
+    "HLC", "uuid_ms", "uuid_seq", "now_ms", "now_secs",
+    "write_uvarint", "write_varint", "read_uvarint", "read_varint", "VarintReader",
+    "StreamChecksum", "crc64",
+]

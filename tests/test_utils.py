@@ -1,0 +1,154 @@
+import random
+
+import pytest
+
+from constdb_tpu.utils.bytesutil import bytes2i64, bytes2u64, i64_to_bytes
+from constdb_tpu.utils.checksum import StreamChecksum, _crc64_py, crc64
+from constdb_tpu.utils.hlc import HLC, SEQ_MASK, uuid_ms, uuid_seq
+from constdb_tpu.utils.varint import (
+    VarintReader,
+    read_uvarint,
+    read_varint,
+    write_uvarint,
+    write_varint,
+)
+
+
+class TestHLC:
+    def test_write_uuids_strictly_monotonic(self):
+        # parity: reference src/server.rs:433-443 test_uuid
+        h = HLC()
+        prev = 0
+        for _ in range(10_000):
+            u = h.tick(True)
+            assert u > prev
+            prev = u
+
+    def test_reads_do_not_consume_sequence(self):
+        t = [100]
+        h = HLC(clock=lambda: t[0])
+        w = h.tick(True)
+        r1 = h.tick(False)
+        r2 = h.tick(False)
+        assert r1 == w and r2 == w
+
+    def test_monotonic_under_clock_regression(self):
+        t = [1000]
+        h = HLC(clock=lambda: t[0])
+        u1 = h.tick(True)
+        t[0] = 500  # clock steps back
+        u2 = h.tick(True)
+        assert u2 > u1
+        t[0] = 2000
+        u3 = h.tick(True)
+        assert u3 > u2 and uuid_ms(u3) == 2000
+
+    def test_seq_overflow_rolls_into_ms(self):
+        t = [7]
+        h = HLC(clock=lambda: t[0])
+        h._uuid = (7 << 22) | SEQ_MASK
+        u = h.tick(True)
+        assert uuid_ms(u) == 8 and uuid_seq(u) == 0
+
+    def test_observe_remote(self):
+        t = [100]
+        h = HLC(clock=lambda: t[0])
+        h.tick(True)
+        remote = (10_000 << 22) | 5
+        h.observe(remote)
+        assert h.tick(True) > remote
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "v",
+        [0, 1, 63, 64, 100, (1 << 14) - 1, 1 << 14, (1 << 30) - 1, 1 << 30, (1 << 41), (1 << 64) - 1],
+    )
+    def test_uvarint_roundtrip(self, v):
+        out = bytearray()
+        write_uvarint(out, v)
+        got, pos = read_uvarint(out, 0)
+        assert got == v and pos == len(out)
+
+    def test_uvarint_sizes(self):
+        for v, n in [(0, 1), (63, 1), (64, 2), ((1 << 14) - 1, 2), (1 << 14, 4), ((1 << 30) - 1, 4), (1 << 30, 9)]:
+            out = bytearray()
+            write_uvarint(out, v)
+            assert len(out) == n, v
+
+    @pytest.mark.parametrize("v", [0, -1, 1, -64, 63, -(1 << 62), (1 << 62), -(1 << 63), (1 << 63) - 1])
+    def test_varint_signed_roundtrip(self, v):
+        out = bytearray()
+        write_varint(out, v)
+        got, pos = read_varint(out, 0)
+        assert got == v and pos == len(out)
+
+    def test_random_streams(self):
+        rng = random.Random(7)
+        vals = [rng.getrandbits(rng.randrange(1, 64)) - (1 << 62) for _ in range(500)]
+        out = bytearray()
+        for v in vals:
+            write_varint(out, v)
+        r = VarintReader(out)
+        assert [r.varint() for _ in vals] == vals
+        assert r.remaining == 0
+
+    def test_truncated_raises(self):
+        out = bytearray()
+        write_uvarint(out, 1 << 40)
+        with pytest.raises(IndexError):
+            read_uvarint(out[:4], 0)
+
+
+class TestChecksum:
+    def test_crc64_xz_known_vector(self):
+        # CRC-64/XZ check value for "123456789"
+        assert _crc64_py(b"123456789") == 0x995DC9BBDF1939FA
+
+    def test_crc64_incremental_matches_oneshot(self):
+        data = bytes(range(256)) * 11
+        one = crc64(data)
+        inc = 0
+        for i in range(0, len(data), 97):
+            inc = crc64(data[i:i + 97], inc)
+        assert inc == one
+
+    def test_native_matches_python_if_built(self):
+        from constdb_tpu.utils import checksum
+
+        if not checksum._load_native():
+            pytest.skip("native library not built")
+        data = random.Random(3).randbytes(10_000)
+        assert checksum.crc64(data) == checksum._crc64_py(data)
+
+    @pytest.mark.parametrize("alg", [StreamChecksum.ALG_CRC64, StreamChecksum.ALG_BLAKE2B64])
+    def test_stream_checksum(self, alg):
+        a = StreamChecksum(alg)
+        b = StreamChecksum(alg)
+        a.update(b"hello ")
+        a.update(b"world")
+        b.update(b"hello world")
+        assert a.digest() == b.digest()
+        c = StreamChecksum(alg)
+        c.update(b"hello worlx")
+        assert c.digest() != a.digest()
+
+
+class TestBytesUtil:
+    def test_bytes2i64(self):
+        assert bytes2i64(b"123") == 123
+        assert bytes2i64(b"-9") == -9
+        assert bytes2i64(b"0") == 0
+        for bad in (b"", b"+1", b" 1", b"01", b"1.5", b"abc", b"1a", str(1 << 63).encode()):
+            assert bytes2i64(bad) is None, bad
+
+    def test_bytes2u64(self):
+        assert bytes2u64(b"5") == 5
+        assert bytes2u64(b"-5") is None
+
+    def test_i64_to_bytes_interned(self):
+        assert i64_to_bytes(-1) == b"-1"
+        assert i64_to_bytes(0) == b"0"
+        assert i64_to_bytes(9999) == b"9999"
+        assert i64_to_bytes(123456789) == b"123456789"
+        assert i64_to_bytes(5) is i64_to_bytes(5)
