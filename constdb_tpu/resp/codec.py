@@ -1,0 +1,149 @@
+"""Incremental RESP parser and encoder.
+
+Capability parity with the reference's hand-rolled read/write buffers
+(reference src/conn/buf_read.rs:114-211 recursive-descent parser with
+NeedMoreMsg + compaction; src/conn/buf_write.rs:32-159 encoder).
+
+The parser consumes from an internal bytearray; `feed()` appends raw socket
+bytes, `next_msg()` returns one complete message or None.  Partial input never
+raises — the cursor only advances past fully parsed messages.  Consumed bytes
+are compacted away lazily once they exceed a threshold.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..errors import InvalidRequestMsg
+from .message import Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, Nil, NoReply, Simple
+
+_CRLF = b"\r\n"
+_COMPACT_THRESHOLD = 1 << 16
+
+
+def encode_into(out: bytearray, m: Msg) -> None:
+    if isinstance(m, NoReply):
+        return
+    if isinstance(m, Nil):
+        out += b"$-1\r\n"
+    elif isinstance(m, Simple):
+        out += b"+"
+        out += m.val
+        out += _CRLF
+    elif isinstance(m, Err):
+        out += b"-"
+        out += m.val
+        out += _CRLF
+    elif isinstance(m, Int):
+        out += b":%d\r\n" % m.val
+    elif isinstance(m, Bulk):
+        out += b"$%d\r\n" % len(m.val)
+        out += m.val
+        out += _CRLF
+    elif isinstance(m, Arr):
+        out += b"*%d\r\n" % len(m.items)
+        for item in m.items:
+            encode_into(out, item)
+    else:
+        raise TypeError(f"cannot encode {m!r}")
+
+
+def encode_msg(m: Msg) -> bytes:
+    out = bytearray()
+    encode_into(out, m)
+    return bytes(out)
+
+
+class _NeedMore(Exception):
+    pass
+
+
+_NEED_MORE = _NeedMore()
+
+
+class RespParser:
+    __slots__ = ("_buf", "_pos", "max_depth")
+
+    def __init__(self, max_depth: int = 32):
+        self._buf = bytearray()
+        self._pos = 0
+        self.max_depth = max_depth
+
+    def feed(self, data) -> None:
+        self._buf += data
+
+    @property
+    def buffered(self) -> int:
+        return len(self._buf) - self._pos
+
+    def next_msg(self) -> Optional[Msg]:
+        """One complete message, or None if more bytes are needed.
+        Raises InvalidRequestMsg on malformed input."""
+        if self._pos >= len(self._buf):
+            return None
+        start = self._pos
+        try:
+            m = self._parse(0)
+        except _NeedMore:
+            self._pos = start
+            return None
+        if self._pos >= _COMPACT_THRESHOLD:
+            del self._buf[: self._pos]
+            self._pos = 0
+        return m
+
+    # --- internals ---
+
+    def _line(self) -> bytes:
+        idx = self._buf.find(_CRLF, self._pos)
+        if idx < 0:
+            # guard: a line that never terminates is malformed, not "partial"
+            if len(self._buf) - self._pos > 1 << 20:
+                raise InvalidRequestMsg("line too long")
+            raise _NEED_MORE
+        line = bytes(self._buf[self._pos:idx])
+        self._pos = idx + 2
+        return line
+
+    def _int_line(self) -> int:
+        line = self._line()
+        try:
+            return int(line)
+        except ValueError:
+            raise InvalidRequestMsg(f"invalid integer line {line[:32]!r}") from None
+
+    def _parse(self, depth: int) -> Msg:
+        if depth > self.max_depth:
+            raise InvalidRequestMsg("nesting too deep")
+        if self._pos >= len(self._buf):
+            raise _NEED_MORE
+        t = self._buf[self._pos]
+        self._pos += 1
+        if t == 0x2B:  # '+'
+            return Simple(self._line())
+        if t == 0x2D:  # '-'
+            return Err(self._line())
+        if t == 0x3A:  # ':'
+            return Int(self._int_line())
+        if t == 0x24:  # '$'
+            n = self._int_line()
+            if n < 0:
+                return NIL
+            if n > 512 << 20:
+                raise InvalidRequestMsg("bulk string too large")
+            end = self._pos + n + 2
+            if end > len(self._buf):
+                raise _NEED_MORE
+            val = bytes(self._buf[self._pos:self._pos + n])
+            if self._buf[self._pos + n:end] != _CRLF:
+                raise InvalidRequestMsg("bulk string missing CRLF")
+            self._pos = end
+            return Bulk(val)
+        if t == 0x2A:  # '*'
+            n = self._int_line()
+            if n < 0:
+                return NIL
+            if n > 1 << 20:
+                raise InvalidRequestMsg("array too large")
+            return Arr([self._parse(depth + 1) for _ in range(n)])
+        raise InvalidRequestMsg(f"unexpected type byte {bytes([t])!r}")

@@ -58,16 +58,27 @@ def read_uvarint(buf, pos: int) -> tuple[int, int]:
         end = pos + 2
         if end > len(buf):
             raise IndexError("truncated varint")
-        return int.from_bytes(buf[pos:end], "big") & ((1 << 14) - 1), end
+        v = int.from_bytes(buf[pos:end], "big") & ((1 << 14) - 1)
+        if v < 1 << 6:
+            raise ValueError("non-canonical varint (overlong 2-byte form)")
+        return v, end
     if tag == 2:
         end = pos + 4
         if end > len(buf):
             raise IndexError("truncated varint")
-        return int.from_bytes(buf[pos:end], "big") & ((1 << 30) - 1), end
+        v = int.from_bytes(buf[pos:end], "big") & ((1 << 30) - 1)
+        if v < 1 << 14:
+            raise ValueError("non-canonical varint (overlong 4-byte form)")
+        return v, end
+    if flag != _TAG3:
+        raise ValueError("non-canonical varint (tag-3 flag low bits set)")
     end = pos + 9
     if end > len(buf):
         raise IndexError("truncated varint")
-    return int.from_bytes(buf[pos + 1:end], "big"), end
+    v = int.from_bytes(buf[pos + 1:end], "big")
+    if v < 1 << 30:
+        raise ValueError("non-canonical varint (overlong 9-byte form)")
+    return v, end
 
 
 def read_varint(buf, pos: int) -> tuple[int, int]:

@@ -1,0 +1,157 @@
+import random
+
+import pytest
+
+from constdb_tpu.errors import InvalidRequestMsg
+from constdb_tpu.resp import (
+    NIL, NO_REPLY, OK, Arr, Bulk, Err, Int, RespParser, Simple,
+    as_bytes, as_int, as_uint, encode_msg, mkcmd, msg_size,
+)
+
+
+GOLDEN = [
+    (Simple(b"OK"), b"+OK\r\n"),
+    (Err(b"boom"), b"-boom\r\n"),
+    (Int(42), b":42\r\n"),
+    (Int(-7), b":-7\r\n"),
+    (Bulk(b""), b"$0\r\n\r\n"),
+    (Bulk(b"hello"), b"$5\r\nhello\r\n"),
+    (Bulk(b"with\r\nnewline"), b"$13\r\nwith\r\nnewline\r\n"),
+    (NIL, b"$-1\r\n"),
+    (Arr([]), b"*0\r\n"),
+    (Arr([Bulk(b"GET"), Bulk(b"k")]), b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n"),
+    (Arr([Int(1), Arr([Simple(b"a")]), NIL]), b"*3\r\n:1\r\n*1\r\n+a\r\n$-1\r\n"),
+]
+
+
+class TestEncode:
+    @pytest.mark.parametrize("msg,wire", GOLDEN)
+    def test_golden(self, msg, wire):
+        assert encode_msg(msg) == wire
+
+    def test_no_reply_encodes_nothing(self):
+        assert encode_msg(NO_REPLY) == b""
+
+    def test_mkcmd(self):
+        assert mkcmd("SYNC", 0, b"n1", 17) == Arr(
+            [Bulk(b"SYNC"), Bulk(b"0"), Bulk(b"n1"), Bulk(b"17")]
+        )
+
+
+class TestParse:
+    @pytest.mark.parametrize("msg,wire", GOLDEN)
+    def test_golden_roundtrip(self, msg, wire):
+        p = RespParser()
+        p.feed(wire)
+        assert p.next_msg() == msg
+        assert p.next_msg() is None
+
+    def test_pipelined(self):
+        p = RespParser()
+        p.feed(b"+a\r\n:1\r\n$1\r\nx\r\n")
+        assert p.next_msg() == Simple(b"a")
+        assert p.next_msg() == Int(1)
+        assert p.next_msg() == Bulk(b"x")
+        assert p.next_msg() is None
+
+    def test_byte_at_a_time(self):
+        # parity: reference conn.rs:136-202 round-trips random messages
+        wire = b"".join(w for _, w in GOLDEN)
+        msgs = [m for m, _ in GOLDEN]
+        p = RespParser()
+        got = []
+        for i in range(len(wire)):
+            p.feed(wire[i:i + 1])
+            while (m := p.next_msg()) is not None:
+                got.append(m)
+        assert got == msgs
+
+    def test_random_split_points(self):
+        rng = random.Random(11)
+        msgs = []
+        for _ in range(100):
+            r = rng.random()
+            if r < 0.3:
+                msgs.append(Bulk(rng.randbytes(rng.randrange(0, 40))))
+            elif r < 0.5:
+                msgs.append(Int(rng.randrange(-(1 << 40), 1 << 40)))
+            elif r < 0.6:
+                msgs.append(NIL)
+            elif r < 0.7:
+                msgs.append(Simple(bytes(rng.choices(range(33, 127), k=5))))
+            else:
+                msgs.append(Arr([Bulk(rng.randbytes(3)), Int(rng.randrange(100))]))
+        wire = b"".join(encode_msg(m) for m in msgs)
+        p = RespParser()
+        got = []
+        pos = 0
+        while pos < len(wire):
+            step = rng.randrange(1, 30)
+            p.feed(wire[pos:pos + step])
+            pos += step
+            while (m := p.next_msg()) is not None:
+                got.append(m)
+        assert got == msgs
+
+    def test_malformed_type_byte(self):
+        p = RespParser()
+        p.feed(b"!bad\r\n")
+        with pytest.raises(InvalidRequestMsg):
+            p.next_msg()
+
+    def test_bulk_missing_crlf(self):
+        p = RespParser()
+        p.feed(b"$3\r\nabcXX")
+        with pytest.raises(InvalidRequestMsg):
+            p.next_msg()
+
+    def test_bad_integer(self):
+        p = RespParser()
+        p.feed(b":notanint\r\n")
+        with pytest.raises(InvalidRequestMsg):
+            p.next_msg()
+
+    def test_nested_array_partial(self):
+        wire = encode_msg(Arr([Arr([Bulk(b"deep")]), Int(2)]))
+        p = RespParser()
+        p.feed(wire[:8])
+        assert p.next_msg() is None
+        p.feed(wire[8:])
+        assert p.next_msg() == Arr([Arr([Bulk(b"deep")]), Int(2)])
+
+    def test_depth_limit(self):
+        p = RespParser(max_depth=4)
+        p.feed(b"*1\r\n" * 10 + b":1\r\n")
+        with pytest.raises(InvalidRequestMsg):
+            p.next_msg()
+
+    def test_compaction_keeps_parsing(self):
+        p = RespParser()
+        big = encode_msg(Bulk(b"z" * 70000))
+        p.feed(big)
+        p.feed(b":5\r\n")
+        assert p.next_msg() == Bulk(b"z" * 70000)
+        assert p.next_msg() == Int(5)
+
+
+class TestCoercion:
+    def test_as_bytes(self):
+        assert as_bytes(Bulk(b"x")) == b"x"
+        assert as_bytes(Int(12)) == b"12"
+        with pytest.raises(InvalidRequestMsg):
+            as_bytes(Arr([]))
+
+    def test_as_int(self):
+        assert as_int(Int(-3)) == -3
+        assert as_int(Bulk(b"44")) == 44
+        with pytest.raises(InvalidRequestMsg):
+            as_int(Bulk(b"x"))
+
+    def test_as_uint(self):
+        assert as_uint(Bulk(b"7")) == 7
+        with pytest.raises(InvalidRequestMsg):
+            as_uint(Int(-1))
+
+    def test_msg_size(self):
+        assert msg_size(Arr([Bulk(b"abc"), Int(1)])) == 11
+        assert msg_size(NIL) == 0
