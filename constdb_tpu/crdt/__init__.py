@@ -1,0 +1,9 @@
+from .semantics import (
+    ENC_COUNTER, ENC_BYTES, ENC_DICT, ENC_SET, ENC_NAMES,
+    lww_wins, elem_alive, key_alive, merge_envelope,
+)
+
+__all__ = [
+    "ENC_COUNTER", "ENC_BYTES", "ENC_DICT", "ENC_SET", "ENC_NAMES",
+    "lww_wins", "elem_alive", "key_alive", "merge_envelope",
+]

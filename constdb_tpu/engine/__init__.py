@@ -1,0 +1,15 @@
+from .base import ColumnarBatch, MergeEngine, MergeStats, batch_from_keyspace
+from .cpu import CpuMergeEngine
+
+__all__ = ["ColumnarBatch", "MergeEngine", "MergeStats", "batch_from_keyspace", "CpuMergeEngine"]
+
+
+def default_engine():
+    """The engine used for bulk merges: batched JAX engine when available,
+    CPU reference engine otherwise."""
+    try:
+        from .tpu import TpuMergeEngine
+
+        return TpuMergeEngine()
+    except Exception:  # jax missing or device init failure
+        return CpuMergeEngine()

@@ -1,0 +1,127 @@
+"""MergeEngine boundary: bulk CRDT merges over columnar batches.
+
+This is the seam the north-star targets (BASELINE.json): snapshot ingest and
+replica catch-up produce `ColumnarBatch`es (foreign CRDT state as
+struct-of-arrays), and an engine merges them into the local `KeySpace`.
+The CPU engine is the semantics reference; the JAX engine (engine/tpu.py)
+runs the same rules as batched scatter reductions on device.
+
+The per-key loops this replaces in the reference:
+`DB::merge_entry` → `Object::merge` → `Counter::merge` / `Set::merge` /
+`Dict::merge` (reference src/db.rs:31-43, src/object.rs:63-83,
+src/type_counter.rs:59-91, src/crdt/lwwhash.rs:176-181, 319-323).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+import numpy as np
+
+from ..store.keyspace import KeySpace
+
+_I64 = np.int64
+
+
+@dataclass
+class ColumnarBatch:
+    """Foreign CRDT state in columnar form.
+
+    Key-aligned arrays are indexed by *batch key position* (bki); counter and
+    element rows point into the key arrays via `cnt_ki` / `el_ki`.
+    """
+
+    # keys
+    keys: list = field(default_factory=list)           # bytes per batch key
+    key_enc: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int8))
+    key_ct: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    key_mt: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    key_dt: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    key_expire: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # registers (aligned with keys; unused slots hold None/0)
+    reg_val: list = field(default_factory=list)
+    reg_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    reg_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # counter slots
+    cnt_ki: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    cnt_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    cnt_val: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    cnt_uuid: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # elements (set members / dict fields)
+    el_ki: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    el_member: list = field(default_factory=list)
+    el_val: list = field(default_factory=list)
+    el_add_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    el_add_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    el_del_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # standalone key-level tombstones (snapshot DELETES section)
+    del_keys: list = field(default_factory=list)
+    del_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.keys) + len(self.cnt_ki) + len(self.el_ki)
+
+
+@dataclass
+class MergeStats:
+    keys_seen: int = 0
+    keys_created: int = 0
+    type_conflicts: int = 0
+    counter_rows: int = 0
+    elem_rows: int = 0
+
+    def __iadd__(self, other: "MergeStats") -> "MergeStats":
+        self.keys_seen += other.keys_seen
+        self.keys_created += other.keys_created
+        self.type_conflicts += other.type_conflicts
+        self.counter_rows += other.counter_rows
+        self.elem_rows += other.elem_rows
+        return self
+
+
+class MergeEngine(Protocol):
+    name: str
+
+    def merge(self, store: KeySpace, batch: ColumnarBatch) -> MergeStats: ...
+
+
+def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True) -> ColumnarBatch:
+    """Dump a keyspace's full logical state as a batch (snapshot body /
+    merge-test vehicle).  GC-freed element rows are excluded."""
+    b = ColumnarBatch()
+    n = ks.keys.n
+    b.keys = list(ks.key_bytes)
+    b.key_enc = ks.keys.enc.copy()
+    b.key_ct = ks.keys.ct.copy()
+    b.key_mt = ks.keys.mt.copy()
+    b.key_dt = ks.keys.dt.copy()
+    b.key_expire = ks.keys.expire.copy()
+    b.reg_val = list(ks.reg_val)
+    b.reg_t = ks.keys.rv_t.copy()
+    b.reg_node = ks.keys.rv_node.copy()
+
+    b.cnt_ki = ks.cnt.kid.copy()
+    b.cnt_node = ks.cnt.node.copy()
+    b.cnt_val = ks.cnt.val.copy()
+    b.cnt_uuid = ks.cnt.uuid.copy()
+
+    live = ks.el.kid >= 0
+    b.el_ki = ks.el.kid[live].copy()
+    b.el_add_t = ks.el.add_t[live].copy()
+    b.el_add_node = ks.el.add_node[live].copy()
+    b.el_del_t = ks.el.del_t[live].copy()
+    rows = np.nonzero(live)[0]
+    b.el_member = [ks.el_member[r] for r in rows]
+    b.el_val = [ks.el_val[r] for r in rows]
+
+    if include_deletes and ks.key_deletes:
+        b.del_keys = list(ks.key_deletes.keys())
+        b.del_t = np.fromiter(ks.key_deletes.values(), dtype=_I64, count=len(ks.key_deletes))
+    assert n == len(b.keys)
+    return b

@@ -1,0 +1,76 @@
+"""CPU reference MergeEngine: the per-row loop the TPU engine must match.
+
+Semantics per crdt/semantics.py; this is also the measured CPU baseline for
+bench.py (the equivalent of the reference's single-key merge path,
+src/db.rs:31-43 → src/object.rs:63-83 → per-type merges).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..crdt import semantics as S
+from ..store.keyspace import KeySpace
+from .base import ColumnarBatch, MergeStats
+
+log = logging.getLogger(__name__)
+
+
+class CpuMergeEngine:
+    name = "cpu"
+
+    def merge(self, store: KeySpace, batch: ColumnarBatch) -> MergeStats:
+        st = MergeStats()
+        n = batch.n_keys
+        st.keys_seen = n
+
+        # map batch key position -> local kid (-1 = type conflict, skip)
+        kid_of = [-1] * n
+        for i in range(n):
+            key = batch.keys[i]
+            enc = int(batch.key_enc[i])
+            kid = store.index.get(key, -1)
+            if kid < 0:
+                kid = store.create_key(key, enc, int(batch.key_ct[i]), int(batch.key_dt[i]))
+                store.keys.mt[kid] = batch.key_mt[i]
+                st.keys_created += 1
+            elif store.enc_of(kid) != enc:
+                # parity: reference db.rs:31-43 logs and skips on conflict
+                log.error("type conflict merging key %r: local=%s incoming=%s",
+                          key, store.enc_of(kid), enc)
+                st.type_conflicts += 1
+                continue
+            else:
+                ct, mt, dt = store.envelope(kid)
+                ct, mt, dt = S.merge_envelope(ct, mt, dt, int(batch.key_ct[i]),
+                                              int(batch.key_mt[i]), int(batch.key_dt[i]))
+                store.keys.ct[kid], store.keys.mt[kid], store.keys.dt[kid] = ct, mt, dt
+            kid_of[i] = kid
+            exp = int(batch.key_expire[i])
+            if exp > int(store.keys.expire[kid]):
+                store.keys.expire[kid] = exp
+            if enc == S.ENC_BYTES and batch.reg_val[i] is not None:
+                store.register_merge(kid, batch.reg_val[i], int(batch.reg_t[i]),
+                                     int(batch.reg_node[i]))
+
+        for r in range(len(batch.cnt_ki)):
+            kid = kid_of[int(batch.cnt_ki[r])]
+            if kid < 0:
+                continue
+            store.counter_merge_slot(kid, int(batch.cnt_node[r]),
+                                     int(batch.cnt_val[r]), int(batch.cnt_uuid[r]))
+            st.counter_rows += 1
+
+        for r in range(len(batch.el_ki)):
+            kid = kid_of[int(batch.el_ki[r])]
+            if kid < 0:
+                continue
+            store.elem_merge(kid, batch.el_member[r], int(batch.el_add_t[r]),
+                             int(batch.el_add_node[r]), int(batch.el_del_t[r]),
+                             batch.el_val[r])
+            st.elem_rows += 1
+
+        for i, key in enumerate(batch.del_keys):
+            store.record_key_delete(key, int(batch.del_t[i]))
+
+        return st

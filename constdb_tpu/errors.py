@@ -41,10 +41,6 @@ class InvalidRequestMsg(CstError):
         super().__init__(f"invalid request: {why}")
 
 
-class NeedMoreMsg(CstError):
-    """RESP partial parse: more bytes are needed.  Internal control flow."""
-
-
 class InvalidSnapshot(CstError):
     def __init__(self, offset: int):
         self.offset = offset

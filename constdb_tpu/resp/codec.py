@@ -43,6 +43,8 @@ def encode_into(out: bytearray, m: Msg) -> None:
     elif isinstance(m, Arr):
         out += b"*%d\r\n" % len(m.items)
         for item in m.items:
+            if isinstance(item, NoReply):
+                raise TypeError("NoReply inside Arr would desync the frame")
             encode_into(out, item)
     else:
         raise TypeError(f"cannot encode {m!r}")
@@ -128,6 +130,8 @@ class RespParser:
         if t == 0x24:  # '$'
             n = self._int_line()
             if n < 0:
+                if n != -1:  # only $-1 is Nil; other negatives are malformed
+                    raise InvalidRequestMsg("negative bulk length")
                 return NIL
             if n > 512 << 20:
                 raise InvalidRequestMsg("bulk string too large")
@@ -142,6 +146,8 @@ class RespParser:
         if t == 0x2A:  # '*'
             n = self._int_line()
             if n < 0:
+                if n != -1:
+                    raise InvalidRequestMsg("negative array length")
                 return NIL
             if n > 1 << 20:
                 raise InvalidRequestMsg("array too large")

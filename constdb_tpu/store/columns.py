@@ -1,0 +1,65 @@
+"""Growable struct-of-arrays column group.
+
+The keyspace's numeric plane lives in these instead of per-key heap objects:
+columns are contiguous numpy arrays, so bulk merge stages to the device with
+zero per-row Python work and merged columns write back with fancy indexing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Columns:
+    """A set of equally-sized growable numpy columns (amortized doubling)."""
+
+    def __init__(self, spec: dict[str, np.dtype], cap: int = 1024):
+        self._spec = {k: np.dtype(v) for k, v in spec.items()}
+        self._cap = max(cap, 16)
+        self.n = 0
+        for name, dt in self._spec.items():
+            setattr(self, "_" + name, np.zeros(self._cap, dtype=dt))
+
+    def _grow(self, need: int) -> None:
+        cap = self._cap
+        while cap < need:
+            cap *= 2
+        for name in self._spec:
+            old = getattr(self, "_" + name)
+            new = np.zeros(cap, dtype=old.dtype)
+            new[: self.n] = old[: self.n]
+            setattr(self, "_" + name, new)
+        self._cap = cap
+
+    def append(self, **vals) -> int:
+        row = self.n
+        if row >= self._cap:
+            self._grow(row + 1)
+        self.n = row + 1
+        for name, v in vals.items():
+            getattr(self, "_" + name)[row] = v
+        return row
+
+    def append_block(self, n: int, **arrays) -> np.ndarray:
+        """Append n rows from aligned arrays; returns the new row indices."""
+        start = self.n
+        if start + n > self._cap:
+            self._grow(start + n)
+        self.n = start + n
+        for name, arr in arrays.items():
+            getattr(self, "_" + name)[start:start + n] = arr
+        return np.arange(start, start + n, dtype=np.int64)
+
+    def col(self, name: str) -> np.ndarray:
+        """Live view of a column (length n)."""
+        return getattr(self, "_" + name)[: self.n]
+
+    def __getattr__(self, name: str):
+        # convenience: cols.ct -> live view  (only called for missing attrs)
+        spec = object.__getattribute__(self, "_spec")
+        if name in spec:
+            return object.__getattribute__(self, "_" + name)[: object.__getattribute__(self, "n")]
+        raise AttributeError(name)
+
+    def __len__(self) -> int:
+        return self.n

@@ -1,0 +1,390 @@
+"""Columnar keyspace — the data plane of a node.
+
+Capability parity with the reference's `DB` + per-key `Object` heap
+(reference src/db.rs, src/object.rs, src/type_counter.rs,
+src/crdt/lwwhash.rs), redesigned TPU-first: all numeric CRDT state
+(envelope times, counter slots, element add/del times) lives in contiguous
+numpy columns so bulk merges stage to the device without per-row Python
+work.  Python dicts exist only as indexes from key/member bytes to rows.
+
+Tables:
+  keys  — one row per key: enc, ct/mt/dt envelope, expire, register value
+          (bytes in a side list) with its (write-time, writer-node), counter
+          sum cache.
+  cnt   — one row per (key, node) counter slot: val, uuid.
+  el    — one row per set-member / dict-field: add_t, add_node, del_t;
+          member/value bytes in side lists.  Rows freed by GC are recycled.
+
+Single-op serving methods implement the op-level rules of
+crdt/semantics.py; bulk merge goes through engine/ (MergeEngine boundary).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..crdt import semantics as S
+from ..errors import InvalidType
+from .columns import Columns
+
+_I64 = np.int64
+
+
+class _KeyCols(Columns):
+    def __init__(self) -> None:
+        super().__init__(
+            {"enc": np.int8, "ct": _I64, "mt": _I64, "dt": _I64, "expire": _I64,
+             "rv_t": _I64, "rv_node": _I64, "cnt_sum": _I64},
+            cap=8096,  # parity: reference db.rs DB_INITIAL_SIZE
+        )
+
+
+class _CntCols(Columns):
+    def __init__(self) -> None:
+        super().__init__({"kid": _I64, "node": _I64, "val": _I64, "uuid": _I64}, cap=4096)
+
+
+class _ElCols(Columns):
+    def __init__(self) -> None:
+        super().__init__({"kid": _I64, "add_t": _I64, "add_node": _I64, "del_t": _I64}, cap=8192)
+
+
+class KeySpace:
+    def __init__(self) -> None:
+        self.keys = _KeyCols()
+        self.key_bytes: list[bytes] = []
+        self.index: dict[bytes, int] = {}
+        self.reg_val: list[Optional[bytes]] = []
+
+        self.cnt = _CntCols()
+        self.cnt_slots: dict[int, dict[int, int]] = {}
+
+        self.el = _ElCols()
+        self.el_member: list[Optional[bytes]] = []
+        self.el_val: list[Optional[bytes]] = []
+        self.elems: dict[int, dict[bytes, int]] = {}
+        self.el_free: list[int] = []
+
+        # key-level tombstone record for snapshot DELETES + GC
+        # (parity: reference db.rs `deletes` map)
+        self.key_deletes: dict[bytes, int] = {}
+        # min-heap of (uuid, seq, key, member-or-None): merge and replicated
+        # ops enqueue out-of-order timestamps, so a plain FIFO (the
+        # reference's LinkedList, db.rs) would stall collection behind one
+        # future entry; seq breaks comparison ties before the None member
+        self.garbage: list[tuple[int, int, bytes, Optional[bytes]]] = []
+        self._garbage_seq = 0
+
+    # ------------------------------------------------------------------ keys
+
+    def lookup(self, key: bytes) -> int:
+        return self.index.get(key, -1)
+
+    def n_keys(self) -> int:
+        return self.keys.n
+
+    def create_key(self, key: bytes, enc: int, ct: int, dt: int = 0) -> int:
+        kid = self.keys.append(enc=enc, ct=ct, mt=0, dt=dt, expire=0,
+                               rv_t=0, rv_node=0, cnt_sum=0)
+        self.key_bytes.append(key)
+        self.reg_val.append(None)
+        self.index[key] = kid
+        return kid
+
+    def get_or_create(self, key: bytes, enc: int, uuid: int) -> tuple[int, bool]:
+        """Existing row (type-checked) or a fresh one created at `uuid`."""
+        kid = self.index.get(key, -1)
+        if kid < 0:
+            return self.create_key(key, enc, uuid), True
+        if int(self.keys.enc[kid]) != enc:
+            raise InvalidType()
+        return kid, False
+
+    def query(self, key: bytes, uuid: int) -> int:
+        """kid or -1; lazily applies a due expiry as a key-level delete
+        (parity: reference db.rs:53-66)."""
+        kid = self.index.get(key, -1)
+        if kid < 0:
+            return -1
+        exp = int(self.keys.expire[kid])
+        if exp:
+            ct, dt = int(self.keys.ct[kid]), int(self.keys.dt[kid])
+            if ct >= dt and ct < exp <= uuid:
+                # a due expiry is a plain key-level delete at `exp`.  (The
+                # reference also calls updated_at here, which resurrects the
+                # key it just expired — db.rs:53-66, its own assertion at
+                # db.rs:154 is commented out.  Fixed.)
+                self.keys.dt[kid] = exp
+                if exp > int(self.keys.mt[kid]):
+                    self.keys.mt[kid] = exp
+                self.record_key_delete(key, exp)
+        return kid
+
+    def alive(self, kid: int) -> bool:
+        return S.key_alive(int(self.keys.ct[kid]), int(self.keys.dt[kid]))
+
+    def enc_of(self, kid: int) -> int:
+        return int(self.keys.enc[kid])
+
+    def updated_at(self, kid: int, uuid: int) -> None:
+        ct, mt, dt = S.updated_at(int(self.keys.ct[kid]), int(self.keys.mt[kid]),
+                                  int(self.keys.dt[kid]), uuid)
+        self.keys.ct[kid], self.keys.mt[kid], self.keys.dt[kid] = ct, mt, dt
+
+    def envelope(self, kid: int) -> tuple[int, int, int]:
+        return int(self.keys.ct[kid]), int(self.keys.mt[kid]), int(self.keys.dt[kid])
+
+    def set_delete_time(self, kid: int, uuid: int) -> None:
+        if uuid > int(self.keys.dt[kid]):
+            self.keys.dt[kid] = uuid
+        if uuid > int(self.keys.mt[kid]):
+            self.keys.mt[kid] = uuid
+
+    def expire_at(self, key: bytes, t: int) -> None:
+        """Latest expiry wins (max-merge; see semantics.py header)."""
+        kid = self.index.get(key, -1)
+        if kid >= 0 and t > int(self.keys.expire[kid]):
+            self.keys.expire[kid] = t
+
+    def _enqueue_garbage(self, t: int, key: bytes, member: Optional[bytes]) -> None:
+        self._garbage_seq += 1
+        heapq.heappush(self.garbage, (t, self._garbage_seq, key, member))
+
+    def record_key_delete(self, key: bytes, t: int) -> None:
+        if self.key_deletes.get(key, -1) < t:
+            self.key_deletes[key] = t
+            self._enqueue_garbage(t, key, None)
+
+    # -------------------------------------------------------------- counters
+
+    def counter_change(self, kid: int, node: int, delta: int, uuid: int) -> int:
+        """LWW-gated per-node contribution; returns the new sum.  Advances
+        the stored slot uuid (fixing reference type_counter.rs:37-51)."""
+        slots = self.cnt_slots.setdefault(kid, {})
+        row = slots.get(node, -1)
+        if row < 0:
+            row = self.cnt.append(kid=kid, node=node, val=delta, uuid=uuid)
+            slots[node] = row
+            self.keys.cnt_sum[kid] += delta
+        elif int(self.cnt.uuid[row]) < uuid:
+            self.cnt.val[row] += delta
+            self.cnt.uuid[row] = uuid
+            self.keys.cnt_sum[kid] += delta
+        return int(self.keys.cnt_sum[kid])
+
+    def counter_sum(self, kid: int) -> int:
+        return int(self.keys.cnt_sum[kid])
+
+    def counter_slots(self, kid: int) -> list[tuple[int, int, int]]:
+        """[(node, val, uuid)] for DESC / DEL / snapshot."""
+        out = []
+        for node, row in self.cnt_slots.get(kid, {}).items():
+            out.append((node, int(self.cnt.val[row]), int(self.cnt.uuid[row])))
+        return out
+
+    def counter_merge_slot(self, kid: int, node: int, val: int, uuid: int) -> None:
+        """State-merge of one foreign slot (used by the CPU merge engine)."""
+        slots = self.cnt_slots.setdefault(kid, {})
+        row = slots.get(node, -1)
+        if row < 0:
+            row = self.cnt.append(kid=kid, node=node, val=val, uuid=uuid)
+            slots[node] = row
+            self.keys.cnt_sum[kid] += val
+        else:
+            v0, t0 = int(self.cnt.val[row]), int(self.cnt.uuid[row])
+            v1, t1 = S.merge_counter_slot(v0, t0, val, uuid)
+            self.cnt.val[row], self.cnt.uuid[row] = v1, t1
+            self.keys.cnt_sum[kid] += v1 - v0
+
+    # ------------------------------------------------------------- registers
+
+    def register_set(self, kid: int, val: bytes, uuid: int, node: int) -> bool:
+        """Op-level LWW write (client SET / replicated SET)."""
+        if S.lww_wins(int(self.keys.rv_t[kid]), int(self.keys.rv_node[kid]), uuid, node):
+            return False
+        self.reg_val[kid] = val
+        self.keys.rv_t[kid], self.keys.rv_node[kid] = uuid, node
+        self.updated_at(kid, uuid)
+        return True
+
+    def register_get(self, kid: int) -> Optional[bytes]:
+        return self.reg_val[kid]
+
+    def register_state(self, kid: int) -> tuple[Optional[bytes], int, int]:
+        return self.reg_val[kid], int(self.keys.rv_t[kid]), int(self.keys.rv_node[kid])
+
+    def register_merge(self, kid: int, val: bytes, t: int, node: int) -> None:
+        if S.lww_wins(t, node, int(self.keys.rv_t[kid]), int(self.keys.rv_node[kid])):
+            self.reg_val[kid] = val
+            self.keys.rv_t[kid], self.keys.rv_node[kid] = t, node
+
+    # -------------------------------------------------------------- elements
+
+    def elem_add(self, kid: int, member: bytes, val: Optional[bytes],
+                 uuid: int, node: int) -> bool:
+        """SADD member / HSET field.  Rejects stale writes (op-level rule:
+        reference lwwhash.rs:87-107, with (t, node) tie-break)."""
+        ems = self.elems.setdefault(kid, {})
+        row = ems.get(member, -1)
+        if row < 0:
+            row = self._el_new_row(kid, member, val, uuid, node)
+            ems[member] = row
+            return True
+        if int(self.el.del_t[row]) > uuid:
+            return False
+        at, an = int(self.el.add_t[row]), int(self.el.add_node[row])
+        if S.lww_wins(at, an, uuid, node):
+            return False
+        was_alive = S.elem_alive(at, int(self.el.del_t[row]))
+        self.el.add_t[row], self.el.add_node[row] = uuid, node
+        self.el_val[row] = val
+        return not was_alive
+
+    def elem_rem(self, kid: int, member: bytes, uuid: int) -> bool:
+        """SREM member / HDEL field (reference lwwhash.rs:109-128)."""
+        ems = self.elems.setdefault(kid, {})
+        row = ems.get(member, -1)
+        if row < 0:
+            row = self._el_new_row(kid, member, None, 0, 0)
+            self.el.del_t[row] = uuid
+            ems[member] = row
+            self._enqueue_garbage(uuid, self.key_bytes[kid], member)
+            return True
+        at = int(self.el.add_t[row])
+        if at > uuid:
+            return False
+        was_alive = S.elem_alive(at, int(self.el.del_t[row]))
+        if uuid > int(self.el.del_t[row]):
+            self.el.del_t[row] = uuid
+        self._enqueue_garbage(uuid, self.key_bytes[kid], member)
+        return was_alive
+
+    def elem_get(self, kid: int, member: bytes) -> Optional[bytes]:
+        """Live dict-field value or None."""
+        row = self.elems.get(kid, {}).get(member, -1)
+        if row < 0:
+            return None
+        if S.elem_alive(int(self.el.add_t[row]), int(self.el.del_t[row])):
+            return self.el_val[row]
+        return None
+
+    def elem_live(self, kid: int) -> Iterator[tuple[bytes, Optional[bytes], int]]:
+        """(member, value, add_t) for visible elements."""
+        for member, row in self.elems.get(kid, {}).items():
+            if S.elem_alive(int(self.el.add_t[row]), int(self.el.del_t[row])):
+                yield member, self.el_val[row], int(self.el.add_t[row])
+
+    def elem_all(self, kid: int) -> Iterator[tuple[bytes, int, int, int, Optional[bytes]]]:
+        """(member, add_t, add_node, del_t, value) incl. tombstones."""
+        for member, row in self.elems.get(kid, {}).items():
+            yield (member, int(self.el.add_t[row]), int(self.el.add_node[row]),
+                   int(self.el.del_t[row]), self.el_val[row])
+
+    def elem_merge(self, kid: int, member: bytes, add_t: int, add_node: int,
+                   del_t: int, val: Optional[bytes]) -> None:
+        """State-merge of one foreign element (CPU merge engine)."""
+        ems = self.elems.setdefault(kid, {})
+        row = ems.get(member, -1)
+        if row < 0:
+            row = self._el_new_row(kid, member, val, add_t, add_node)
+            self.el.del_t[row] = del_t
+            ems[member] = row
+            if add_t < del_t:
+                self._enqueue_garbage(del_t, self.key_bytes[kid], member)
+            return
+
+        a0, n0, d0 = int(self.el.add_t[row]), int(self.el.add_node[row]), int(self.el.del_t[row])
+        at, an, dt, local_wins = S.merge_elem(a0, n0, d0, add_t, add_node, del_t)
+        self.el.add_t[row], self.el.add_node[row], self.el.del_t[row] = at, an, dt
+        if not local_wins:
+            self.el_val[row] = val
+        # re-queue whenever the merged row is dead and its del_t advanced (a
+        # pending entry at the old, smaller del_t would be discarded by gc)
+        if at < dt and dt > d0:
+            self._enqueue_garbage(dt, self.key_bytes[kid], member)
+
+    def _el_new_row(self, kid: int, member: bytes, val: Optional[bytes],
+                    add_t: int, add_node: int) -> int:
+        if self.el_free:
+            row = self.el_free.pop()
+            self.el.kid[row] = kid
+            self.el.add_t[row] = add_t
+            self.el.add_node[row] = add_node
+            self.el.del_t[row] = 0
+            self.el_member[row] = member
+            self.el_val[row] = val
+            return row
+        row = self.el.append(kid=kid, add_t=add_t, add_node=add_node, del_t=0)
+        self.el_member.append(member)
+        self.el_val.append(val)
+        return row
+
+    # ------------------------------------------------------------------- GC
+
+    def gc(self, horizon: int) -> int:
+        """Physically drop tombstones every replica has acknowledged
+        (parity: reference db.rs:82-119, fixed to pop oldest-first and to
+        actually collect equal-time entries)."""
+        freed = 0
+        while self.garbage:
+            t, _seq, key, member = self.garbage[0]
+            if t > horizon:
+                break
+            heapq.heappop(self.garbage)
+            if member is None:
+                if self.key_deletes.get(key) == t:
+                    del self.key_deletes[key]
+                    freed += 1
+                continue
+            kid = self.index.get(key, -1)
+            if kid < 0:
+                continue
+            row = self.elems.get(kid, {}).get(member, -1)
+            if row < 0:
+                continue
+            at, dt = int(self.el.add_t[row]), int(self.el.del_t[row])
+            if at < dt and dt <= horizon:
+                del self.elems[kid][member]
+                self.el.kid[row] = -1
+                self.el_member[row] = None
+                self.el_val[row] = None
+                self.el_free.append(row)
+                freed += 1
+        return freed
+
+    # ------------------------------------------------------------ inspection
+
+    def canonical(self) -> dict:
+        """Full logical state (incl. tombstones) for convergence checks."""
+        out = {}
+        for key, kid in self.index.items():
+            enc = int(self.keys.enc[kid])
+            ct, mt, dt = self.envelope(kid)
+            if enc == S.ENC_COUNTER:
+                content = frozenset(self.counter_slots(kid))
+            elif enc == S.ENC_BYTES:
+                content = self.register_state(kid)
+            else:
+                content = frozenset(
+                    (m, at, an, dlt, v) for m, at, an, dlt, v in self.elem_all(kid)
+                )
+            out[key] = (enc, ct, mt, dt, int(self.keys.expire[kid]), content)
+        return out
+
+    def describe(self, kid: int) -> dict:
+        """DESC command payload: raw CRDT state incl. tombstones."""
+        enc = int(self.keys.enc[kid])
+        ct, mt, dt = self.envelope(kid)
+        d = {"enc": S.ENC_NAMES.get(enc, str(enc)), "ct": ct, "mt": mt, "dt": dt}
+        if enc == S.ENC_COUNTER:
+            d["slots"] = sorted(self.counter_slots(kid))
+            d["sum"] = self.counter_sum(kid)
+        elif enc == S.ENC_BYTES:
+            val, t, node = self.register_state(kid)
+            d["value"], d["vtime"], d["vnode"] = val, t, node
+        else:
+            d["elems"] = sorted(self.elem_all(kid))
+        return d

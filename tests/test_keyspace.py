@@ -1,0 +1,160 @@
+import pytest
+
+from constdb_tpu.crdt import ENC_BYTES, ENC_COUNTER, ENC_DICT, ENC_SET
+from constdb_tpu.errors import InvalidType
+from constdb_tpu.store import KeySpace
+
+
+def t(ms, seq=0):
+    return (ms << 22) | seq
+
+
+class TestCounter:
+    def test_change_and_sum(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
+        assert ks.counter_change(kid, 1, 1, t(2)) == 1
+        assert ks.counter_change(kid, 1, 1, t(3)) == 2
+        assert ks.counter_change(kid, 2, -1, t(3)) == 1
+        assert sorted(ks.counter_slots(kid)) == [(1, 2, t(3)), (2, -1, t(3))]
+
+    def test_stale_change_ignored(self):
+        # fixed semantics: stored slot uuid advances, so an older uuid is stale
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
+        ks.counter_change(kid, 1, 1, t(5))
+        assert ks.counter_change(kid, 1, 100, t(4)) == 1  # ignored
+        assert ks.counter_change(kid, 1, 1, t(6)) == 2
+
+    def test_merge_slot_lww(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
+        ks.counter_change(kid, 1, 5, t(5))
+        ks.counter_merge_slot(kid, 1, 9, t(4))   # older: ignored
+        assert ks.counter_sum(kid) == 5
+        ks.counter_merge_slot(kid, 1, 9, t(6))   # newer: replaces
+        assert ks.counter_sum(kid) == 9
+        ks.counter_merge_slot(kid, 1, 7, t(6))   # tie: max value
+        assert ks.counter_sum(kid) == 9
+        ks.counter_merge_slot(kid, 2, 3, t(2))   # new node
+        assert ks.counter_sum(kid) == 12
+
+
+class TestRegister:
+    def test_lww_set(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"r", ENC_BYTES, t(1))
+        assert ks.register_set(kid, b"a", t(2), node=1)
+        assert not ks.register_set(kid, b"b", t(1), node=9)  # older loses
+        assert ks.register_get(kid) == b"a"
+        # equal time: larger node wins
+        assert ks.register_set(kid, b"c", t(2), node=2)
+        assert ks.register_get(kid) == b"c"
+        assert not ks.register_set(kid, b"d", t(2), node=0)
+
+    def test_type_conflict(self):
+        ks = KeySpace()
+        ks.get_or_create(b"r", ENC_BYTES, t(1))
+        with pytest.raises(InvalidType):
+            ks.get_or_create(b"r", ENC_COUNTER, t(2))
+
+
+class TestElements:
+    def test_add_wins_on_tie(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_add(kid, b"m", None, t(5), node=1)
+        ks.elem_rem(kid, b"m", t(5))  # same uuid: add wins
+        assert [m for m, _, _ in ks.elem_live(kid)] == [b"m"]
+        ks.elem_rem(kid, b"m", t(6))
+        assert list(ks.elem_live(kid)) == []
+
+    def test_stale_add_rejected_after_removal(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_rem(kid, b"m", t(9))
+        assert not ks.elem_add(kid, b"m", None, t(5), node=1)
+        assert list(ks.elem_live(kid)) == []
+        assert ks.elem_add(kid, b"m", None, t(9), node=1)  # tie: add wins
+        assert [m for m, _, _ in ks.elem_live(kid)] == [b"m"]
+
+    def test_dict_values(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"h", ENC_DICT, t(1))
+        ks.elem_add(kid, b"f", b"v1", t(2), node=1)
+        ks.elem_add(kid, b"f", b"v2", t(3), node=1)
+        assert ks.elem_get(kid, b"f") == b"v2"
+        ks.elem_rem(kid, b"f", t(4))
+        assert ks.elem_get(kid, b"f") is None
+
+    def test_resurrect_key(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_add(kid, b"m", None, t(2), node=1)
+        ks.set_delete_time(kid, t(5))
+        assert not ks.alive(kid)
+        ks.updated_at(kid, t(6))
+        assert ks.alive(kid)  # created again at t6
+
+
+class TestExpiry:
+    def test_lazy_expire_on_query(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"k", ENC_BYTES, t(1))
+        ks.register_set(kid, b"v", t(1), node=1)
+        ks.expire_at(b"k", t(10))
+        assert ks.query(b"k", t(5)) == kid and ks.alive(kid)
+        assert ks.query(b"k", t(10)) == kid
+        assert not ks.alive(kid)
+        assert ks.key_deletes[b"k"] == t(10)
+
+    def test_expire_max_merge(self):
+        ks = KeySpace()
+        ks.get_or_create(b"k", ENC_BYTES, t(1))
+        ks.expire_at(b"k", t(10))
+        ks.expire_at(b"k", t(5))
+        assert int(ks.keys.expire[ks.lookup(b"k")]) == t(10)
+
+
+class TestGC:
+    def test_collects_acked_tombstones_only(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_add(kid, b"a", None, t(2), node=1)
+        ks.elem_add(kid, b"b", None, t(2), node=1)
+        ks.elem_rem(kid, b"a", t(3))
+        ks.elem_rem(kid, b"b", t(8))
+        assert ks.gc(t(5)) == 1  # only "a" is past the horizon
+        assert b"a" not in ks.elems[kid]
+        assert b"b" in ks.elems[kid]
+        assert ks.gc(t(9)) == 1
+        assert b"b" not in ks.elems[kid]
+
+    def test_readded_member_not_collected(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_add(kid, b"m", None, t(2), node=1)
+        ks.elem_rem(kid, b"m", t(3))
+        ks.elem_add(kid, b"m", None, t(4), node=1)  # re-added: alive again
+        ks.gc(t(10))
+        assert [m for m, _, _ in ks.elem_live(kid)] == [b"m"]
+
+    def test_row_reuse_after_gc(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
+        ks.elem_add(kid, b"m", None, t(2), node=1)
+        ks.elem_rem(kid, b"m", t(3))
+        ks.gc(t(10))
+        assert ks.el_free
+        ks.elem_add(kid, b"x", None, t(11), node=1)
+        assert not ks.el_free  # freed row recycled
+        assert [m for m, _, _ in ks.elem_live(kid)] == [b"x"]
+
+    def test_key_delete_record_gc(self):
+        ks = KeySpace()
+        ks.get_or_create(b"k", ENC_BYTES, t(1))
+        ks.record_key_delete(b"k", t(3))
+        ks.gc(t(2))
+        assert b"k" in ks.key_deletes
+        ks.gc(t(3))
+        assert b"k" not in ks.key_deletes
