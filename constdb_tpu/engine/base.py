@@ -58,6 +58,12 @@ class ColumnarBatch:
     # standalone key-level tombstones (snapshot DELETES section)
     del_keys: list = field(default_factory=list)
     del_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # contract: at most one counter row per (key, node) and one element row
+    # per (key, member).  True for snapshot dumps (batch_from_keyspace, the
+    # snapshot loader); batches built from raw op streams must leave this
+    # False so the engine's dense path (last-write-per-slot placement) is
+    # skipped in favor of the duplicate-safe scatter reduction.
+    rows_unique_per_slot: bool = False
 
     @property
     def n_keys(self) -> int:
@@ -95,6 +101,7 @@ def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True) -> ColumnarB
     """Dump a keyspace's full logical state as a batch (snapshot body /
     merge-test vehicle).  GC-freed element rows are excluded."""
     b = ColumnarBatch()
+    b.rows_unique_per_slot = True  # a state dump has one row per slot
     n = ks.keys.n
     b.keys = list(ks.key_bytes)
     b.key_enc = ks.keys.enc.copy()

@@ -1,0 +1,441 @@
+"""Batched JAX MergeEngine: the TPU path for bulk CRDT merges.
+
+Two device strategies, picked per CRDT family by batch density:
+
+  * dense (the fast path, ops/dense.py): the host pad-aligns every batch's
+    rows into the store's dense row space — [R+1, S] tensors with the local
+    state as row 0 — and the device reduces over the R axis elementwise.
+    No scatter (XLA TPU scatter serializes colliding updates), one transfer
+    each way.  Chosen when the batches cover a meaningful fraction of the
+    store (snapshot ingest, replica catch-up).
+  * scatter (ops/segment.py): touched-slot gather + scatter-max kernels.
+    Chosen for sparse merges (steady-state replication trickle).
+
+Host staging is bulk/vectorized (list-comp index probes, block appends,
+`dict.update`); the only remaining per-row Python is new element-row index
+insertion (native staging library replaces it later).
+
+Must be semantically bit-identical to engine/cpu.py — differential-tested in
+tests/test_engine_equivalence.py.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..crdt import semantics as S
+from ..ops import dense as D
+from ..ops import segment as K
+from ..store.keyspace import KeySpace
+from .base import ColumnarBatch, MergeStats
+
+log = logging.getLogger(__name__)
+
+_I64 = np.int64
+_RANK_BITS = KeySpace.NODE_RANK_BITS
+
+
+def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    if len(arr) == size:
+        return np.asarray(arr)
+    out = np.full(size, fill, dtype=np.asarray(arr).dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+class TpuMergeEngine:
+    name = "tpu"
+    # dense when staged rows cover >= 1/DENSE_FRACTION of the slot space
+    DENSE_FRACTION = 8
+    MEM_LIMIT = 6 << 30  # bytes of [R, S] staging we allow on device
+
+    def __init__(self) -> None:
+        import jax  # ensure a backend exists before we advertise ourselves
+
+        self._jax = jax
+        self._devices = jax.devices()
+
+    # ------------------------------------------------------------------ API
+
+    def merge(self, store: KeySpace, batch: ColumnarBatch) -> MergeStats:
+        return self.merge_many(store, [batch])
+
+    def merge_many(self, store: KeySpace, batches: list[ColumnarBatch]) -> MergeStats:
+        """Fold any number of columnar batches into the store.  Reductions
+        are associative + commutative, so all batches merge in one device
+        pass per CRDT family."""
+        st = MergeStats()
+        # the dense path places each batch row once per slot, which is only
+        # a merge if slots are unique within every batch
+        self._dense_ok = all(b.rows_unique_per_slot for b in batches)
+        resolved = [(b, self._resolve_keys(store, b, st)) for b in batches]
+        self._merge_envelopes(store, resolved)
+        self._merge_registers(store, resolved)
+        self._merge_counter_rows(store, resolved, st)
+        self._merge_elem_rows(store, resolved, st)
+        for b, _ in resolved:
+            for i, key in enumerate(b.del_keys):
+                store.record_key_delete(key, int(b.del_t[i]))
+        return st
+
+    # ------------------------------------------------------- key resolution
+
+    def _resolve_keys(self, store: KeySpace, batch: ColumnarBatch,
+                      st: MergeStats) -> np.ndarray:
+        """batch key position -> local kid (-1 on type conflict); bulk-creates
+        missing keys with the batch envelope (max-merge later is identity)."""
+        n = batch.n_keys
+        st.keys_seen += n
+        if n == 0:
+            return np.zeros(0, dtype=_I64)
+        index = store.index
+        kid_of = np.fromiter((index.get(k, -1) for k in batch.keys),
+                             dtype=_I64, count=n)
+        missing = np.nonzero(kid_of < 0)[0]
+        if len(missing):
+            # within one batch keys are unique, so bulk-create is safe
+            rows = store.keys.append_block(
+                len(missing),
+                enc=batch.key_enc[missing], ct=batch.key_ct[missing], mt=0,
+                dt=batch.key_dt[missing], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
+            miss_keys = [batch.keys[i] for i in missing]
+            store.key_bytes.extend(miss_keys)
+            store.reg_val.extend([None] * len(missing))
+            index.update(zip(miss_keys, rows.tolist()))
+            kid_of[missing] = rows
+            st.keys_created += len(missing)
+
+        present = np.setdiff1d(np.arange(n), missing, assume_unique=True)
+        if len(present):
+            conflicts = store.keys.enc[kid_of[present]] != batch.key_enc[present]
+            bad = present[conflicts]
+            if len(bad):
+                for i in bad:
+                    log.error("type conflict merging key %r: local=%s incoming=%s",
+                              batch.keys[i], int(store.keys.enc[kid_of[i]]),
+                              int(batch.key_enc[i]))
+                st.type_conflicts += len(bad)
+                kid_of[bad] = -1
+        return kid_of
+
+    # ------------------------------------------------- dense/scatter chooser
+
+    def _use_dense(self, total_rows: int, n_slots: int, n_batches: int,
+                   n_cols: int) -> bool:
+        if not getattr(self, "_dense_ok", False):
+            return False
+        if total_rows * self.DENSE_FRACTION < n_slots:
+            return False
+        # _dense_stack pads both axes to powers of two — budget the real size
+        mem = K.next_pow2(n_batches + 1) * K.next_pow2(max(n_slots, 1)) * 8 * n_cols
+        return mem <= self.MEM_LIMIT
+
+    @staticmethod
+    def _dense_stack(cur: np.ndarray, staged: list[tuple[np.ndarray, np.ndarray]],
+                     neutral, s_pad: int) -> np.ndarray:
+        """[Rp, Sp] tensor: row 0 = current column, one row per batch with
+        its values placed at its positions, neutral elsewhere."""
+        r_pad = K.next_pow2(len(staged) + 1)
+        out = np.full((r_pad, s_pad), neutral, dtype=_I64)
+        out[0, : len(cur)] = cur
+        for r, (pos, col) in enumerate(staged):
+            out[r + 1, pos] = col
+        return out
+
+    # ------------------------------------------------------------ envelopes
+
+    def _merge_envelopes(self, store: KeySpace, resolved) -> None:
+        staged = []  # (pos, [ct, mt, dt, exp])
+        for b, kid_of in resolved:
+            valid = np.nonzero(kid_of >= 0)[0]
+            if len(valid):
+                staged.append((kid_of[valid],
+                               [b.key_ct[valid], b.key_mt[valid],
+                                b.key_dt[valid], b.key_expire[valid]]))
+        if not staged:
+            return
+        total = sum(len(p) for p, _ in staged)
+        S_ = store.keys.n
+        if self._use_dense(total, S_, len(staged), 4):
+            s_pad = K.next_pow2(S_)
+            cols = np.stack([
+                self._dense_stack(cur, [(p, c[i]) for p, c in staged],
+                                  K.NEUTRAL_T, s_pad)
+                for i, cur in enumerate((store.keys.ct, store.keys.mt,
+                                         store.keys.dt, store.keys.expire))
+            ], axis=-1)  # [Rp, Sp, 4]
+            out = np.asarray(self._jax.device_get(D.dense_max(cols)))
+            store.keys.ct[:] = out[:S_, 0]
+            store.keys.mt[:] = out[:S_, 1]
+            store.keys.dt[:] = out[:S_, 2]
+            store.keys.expire[:] = out[:S_, 3]
+            return
+        # scatter path over touched slots
+        kv = np.concatenate([p for p, _ in staged])
+        trows, slot_idx = np.unique(kv, return_inverse=True)
+        n_slots = K.next_pow2(len(trows) + 1)
+        n_rows = K.next_pow2(len(kv))
+        out = K.scatter_max4(
+            _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
+            _pad(np.concatenate([c[0] for _, c in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([c[1] for _, c in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([c[2] for _, c in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([c[3] for _, c in staged]), n_rows, K.NEUTRAL_T),
+            _pad(store.keys.ct[trows], n_slots, 0),
+            _pad(store.keys.mt[trows], n_slots, 0),
+            _pad(store.keys.dt[trows], n_slots, 0),
+            _pad(store.keys.expire[trows], n_slots, 0),
+            n_slots)
+        ct, mt, dt, exp = (a[: len(trows)] for a in self._jax.device_get(out))
+        store.keys.ct[trows] = ct
+        store.keys.mt[trows] = mt
+        store.keys.dt[trows] = dt
+        store.keys.expire[trows] = exp
+
+    # ------------------------------------------------------------ registers
+
+    def _merge_registers(self, store: KeySpace, resolved) -> None:
+        staged = []  # (pos=kids, t, node, vals)
+        for b, kid_of in resolved:
+            if not b.n_keys:
+                continue
+            has = np.fromiter((v is not None for v in b.reg_val),
+                              dtype=bool, count=b.n_keys)
+            idx = np.nonzero((kid_of >= 0) & (b.key_enc == S.ENC_BYTES) & has)[0]
+            if len(idx):
+                staged.append((kid_of[idx], b.reg_t[idx], b.reg_node[idx],
+                               [b.reg_val[i] for i in idx]))
+        if not staged:
+            return
+        S_ = store.keys.n
+        total = sum(len(p) for p, *_ in staged)
+        if self._use_dense(total, S_, len(staged), 2):
+            s_pad = K.next_pow2(S_)
+            t = self._dense_stack(store.keys.rv_t,
+                                  [(p, t) for p, t, _, _ in staged],
+                                  K.NEUTRAL_T, s_pad)
+            n = self._dense_stack(store.keys.rv_node,
+                                  [(p, nn) for p, _, nn, _ in staged],
+                                  K.NEUTRAL_T, s_pad)
+            t_m, n_m, win = (np.asarray(a) for a in
+                             self._jax.device_get(D.dense_merge_lww(t, n)))
+            store.keys.rv_t[:] = t_m[:S_]
+            store.keys.rv_node[:] = n_m[:S_]
+            reg_val = store.reg_val
+            for r, (pos, _, _, vals) in enumerate(staged):
+                slots_w = np.nonzero(win[:S_] == r + 1)[0]
+                if not len(slots_w):
+                    continue
+                inv = np.full(S_, -1, dtype=_I64)
+                inv[pos] = np.arange(len(pos), dtype=_I64)
+                for s_ in slots_w:
+                    reg_val[int(s_)] = vals[int(inv[s_])]
+            return
+        # scatter path: registers are LWW slots — reuse the element add-side
+        # kernel with a zero del side
+        kids = np.concatenate([p for p, *_ in staged])
+        vals: list = []
+        for _, _, _, v in staged:
+            vals.extend(v)
+        trows, slot_idx = np.unique(kids, return_inverse=True)
+        n_slots = K.next_pow2(len(trows) + 1)
+        n_rows = K.next_pow2(len(kids))
+        out = K.merge_elems(
+            _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
+            _pad(np.concatenate([t for _, t, _, _ in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([n for _, _, n, _ in staged]), n_rows, K.NEUTRAL_T),
+            np.zeros(n_rows, dtype=_I64),
+            _pad(store.keys.rv_t[trows], n_slots, 0),
+            _pad(store.keys.rv_node[trows], n_slots, 0),
+            np.zeros(n_slots, dtype=_I64),
+            n_slots)
+        t, node, _dt, win_row = (a[: len(trows)] for a in self._jax.device_get(out))
+        store.keys.rv_t[trows] = t
+        store.keys.rv_node[trows] = node
+        reg_val = store.reg_val
+        for di in np.nonzero(win_row >= 0)[0]:
+            reg_val[int(trows[di])] = vals[int(win_row[di])]
+
+    # ------------------------------------------------------------- counters
+
+    def _merge_counter_rows(self, store: KeySpace, resolved,
+                            st: MergeStats) -> None:
+        staged = []  # (rows, val, uuid)
+        for b, kid_of in resolved:
+            if not len(b.cnt_ki):
+                continue
+            kid_arr = kid_of[b.cnt_ki]
+            keep = np.nonzero(kid_arr >= 0)[0]
+            if not len(keep):
+                continue
+            st.counter_rows += len(keep)
+            # vectorized combo keys: node ids -> dense ranks via the (tiny)
+            # per-batch unique node set, then (kid << RANK_BITS) | rank
+            uniq_nodes, inv = np.unique(b.cnt_node[keep], return_inverse=True)
+            ranks = np.fromiter((store.rank_of(int(x)) for x in uniq_nodes),
+                                dtype=_I64, count=len(uniq_nodes))
+            combos = (kid_arr[keep] << _RANK_BITS) | ranks[inv]
+            rows = self._resolve_cnt_rows(store, combos)
+            staged.append((rows, b.cnt_val[keep], b.cnt_uuid[keep]))
+        if not staged:
+            return
+        S_ = store.cnt.n
+        total = sum(len(r) for r, _, _ in staged)
+        old_val = store.cnt.val.copy()
+
+        if self._use_dense(total, S_, len(staged), 2):
+            s_pad = K.next_pow2(S_)
+            vals = self._dense_stack(store.cnt.val, [(r, v) for r, v, _ in staged],
+                                     0, s_pad)
+            ts = self._dense_stack(store.cnt.uuid, [(r, t) for r, _, t in staged],
+                                   K.NEUTRAL_T, s_pad)
+            new_val, new_t = (np.asarray(a)[:S_] for a in
+                              self._jax.device_get(D.dense_merge_counters(vals, ts)))
+            store.cnt.val[:] = new_val
+            store.cnt.uuid[:] = new_t
+            delta = new_val - old_val
+            changed = np.nonzero(delta)[0]
+            np.add.at(store.keys.cnt_sum, store.cnt.kid[changed], delta[changed])
+            return
+
+        all_rows = np.concatenate([r for r, _, _ in staged])
+        trows, slot_idx = np.unique(all_rows, return_inverse=True)
+        cur_val = store.cnt.val[trows].copy()
+        n_slots = K.next_pow2(len(trows) + 1)
+        n_rows = K.next_pow2(len(all_rows))
+        out = K.merge_counters(
+            _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
+            _pad(np.concatenate([v for _, v, _ in staged]), n_rows, 0),
+            _pad(np.concatenate([t for _, _, t in staged]), n_rows, K.NEUTRAL_T),
+            _pad(cur_val, n_slots, 0),
+            _pad(store.cnt.uuid[trows], n_slots, K.NEUTRAL_T),
+            n_slots)
+        new_val, new_t = (a[: len(trows)] for a in self._jax.device_get(out))
+        store.cnt.val[trows] = new_val
+        store.cnt.uuid[trows] = new_t
+        np.add.at(store.keys.cnt_sum, store.cnt.kid[trows], new_val - cur_val)
+
+    def _resolve_cnt_rows(self, store: KeySpace, combos: np.ndarray) -> np.ndarray:
+        """(kid, node) combo keys -> store cnt rows, bulk-creating missing
+        slots as neutral (val=0, t=NEUTRAL_T)."""
+        cnt_index = store.cnt_index
+        rows = np.fromiter((cnt_index.get(c, -1) for c in combos.tolist()),
+                           dtype=_I64, count=len(combos))
+        miss = np.nonzero(rows < 0)[0]
+        if len(miss):
+            miss_combos, minv = np.unique(combos[miss], return_inverse=True)
+            nodes = np.asarray(store.node_ids, dtype=_I64)[
+                miss_combos & ((1 << _RANK_BITS) - 1)]
+            new_rows = store.cnt.append_block(
+                len(miss_combos), kid=miss_combos >> _RANK_BITS,
+                node=nodes, val=0, uuid=K.NEUTRAL_T)
+            cnt_index.update(zip(miss_combos.tolist(), new_rows.tolist()))
+            by_kid = store.cnt_rows_by_kid
+            for combo, row in zip((miss_combos >> _RANK_BITS).tolist(),
+                                  new_rows.tolist()):
+                by_kid.setdefault(combo, []).append(row)
+            rows[miss] = new_rows[minv]
+        return rows
+
+    # ------------------------------------------------------------- elements
+
+    def _merge_elem_rows(self, store: KeySpace, resolved,
+                         st: MergeStats) -> None:
+        staged = []  # (rows, at, an, dt, vals, has_vals)
+        elems = store.elems
+        for b, kid_of in resolved:
+            if not len(b.el_ki):
+                continue
+            kid_arr = kid_of[b.el_ki]
+            keep = np.nonzero(kid_arr >= 0)[0]
+            if not len(keep):
+                continue
+            st.elem_rows += len(keep)
+            rows = np.empty(len(keep), dtype=_I64)
+            members = b.el_member
+            for j, r in enumerate(keep):
+                kid = int(kid_arr[r])
+                member = members[r]
+                ems = elems.setdefault(kid, {})
+                row = ems.get(member, -1)
+                if row < 0:
+                    row = store._el_new_row(kid, member, None, 0, 0)
+                    ems[member] = row
+                rows[j] = row
+            vals = [b.el_val[r] for r in keep]
+            staged.append((rows, b.el_add_t[keep], b.el_add_node[keep],
+                           b.el_del_t[keep], vals,
+                           any(v is not None for v in vals)))
+        if not staged:
+            return
+        S_ = store.el.n
+        total = sum(len(r) for r, *_ in staged)
+        old_dt = store.el.del_t.copy()
+
+        if self._use_dense(total, S_, len(staged), 3):
+            s_pad = K.next_pow2(S_)
+            at = self._dense_stack(store.el.add_t, [(r, a) for r, a, *_ in staged],
+                                   K.NEUTRAL_T, s_pad)
+            an = self._dense_stack(store.el.add_node,
+                                   [(r, x) for r, _, x, *_ in staged],
+                                   K.NEUTRAL_T, s_pad)
+            dt = self._dense_stack(store.el.del_t,
+                                   [(r, d) for r, _, _, d, *_ in staged], 0, s_pad)
+            m_at, m_an, m_dt, win = (np.asarray(a)[:S_] for a in
+                                     self._jax.device_get(D.dense_merge_elems(at, an, dt)))
+            store.el.add_t[:] = m_at
+            store.el.add_node[:] = m_an
+            store.el.del_t[:] = m_dt
+            el_val = store.el_val
+            for r, (pos, _, _, _, vals, has_vals) in enumerate(staged):
+                slots_w = np.nonzero(win == r + 1)[0]
+                if not len(slots_w) or not has_vals:
+                    continue
+                inv = np.full(S_, -1, dtype=_I64)
+                inv[pos] = np.arange(len(pos), dtype=_I64)
+                for s_ in slots_w:
+                    el_val[int(s_)] = vals[int(inv[s_])]
+            self._enqueue_elem_garbage(store, np.arange(S_), m_at, m_dt, old_dt)
+            return
+
+        all_rows = np.concatenate([r for r, *_ in staged])
+        vals_flat: list = []
+        for _, _, _, _, v, _ in staged:
+            vals_flat.extend(v)
+        trows, slot_idx = np.unique(all_rows, return_inverse=True)
+        cur_dt = old_dt[trows]
+        n_slots = K.next_pow2(len(trows) + 1)
+        n_rows = K.next_pow2(len(all_rows))
+        out = K.merge_elems(
+            _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
+            _pad(np.concatenate([a for _, a, *_ in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([x for _, _, x, *_ in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([d for _, _, _, d, _, _ in staged]), n_rows, 0),
+            _pad(store.el.add_t[trows], n_slots, 0),
+            _pad(store.el.add_node[trows], n_slots, 0),
+            _pad(cur_dt, n_slots, 0),
+            n_slots)
+        kk = len(trows)
+        m_at, m_an, m_dt, win_row = (a[:kk] for a in self._jax.device_get(out))
+        store.el.add_t[trows] = m_at
+        store.el.add_node[trows] = m_an
+        store.el.del_t[trows] = m_dt
+        el_val = store.el_val
+        for di in np.nonzero(win_row >= 0)[0]:
+            el_val[int(trows[di])] = vals_flat[int(win_row[di])]
+        self._enqueue_elem_garbage(store, trows, m_at, m_dt, cur_dt)
+
+    @staticmethod
+    def _enqueue_elem_garbage(store: KeySpace, rows, at, dt, old_dt) -> None:
+        """Queue tombstones whose del_t advanced (dead rows need GC once the
+        cluster horizon passes)."""
+        newly = np.nonzero((at < dt) & (dt > old_dt))[0]
+        el_kid = store.el.kid
+        el_member = store.el_member
+        key_bytes = store.key_bytes
+        for di in newly:
+            row = int(rows[di])
+            store._enqueue_garbage(int(dt[di]), key_bytes[int(el_kid[row])],
+                                   el_member[row])

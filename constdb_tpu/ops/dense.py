@@ -1,0 +1,63 @@
+"""Dense R-way CRDT merge kernels — the TPU fast path.
+
+XLA scatter on TPU serializes colliding updates (measured ~11M updates/s on
+v5e), so the batched engine avoids it for bulk merges: the host pad-aligns
+every batch's rows into the store's dense row space (numpy fancy writes at
+C speed), producing [R, S] tensors whose row 0 is the current store state.
+The merge is then a dense reduction over the R axis — pure VPU elementwise
+work at HBM bandwidth, the same shape trick used to batch ragged data for
+the MXU.
+
+Absent slots carry NEUTRAL_T and lose every comparison.  Row 0 is the local
+state, so `win_batch == 0` means "no value copy needed" — and argmax's
+first-match tie rule makes that automatic when the local write is the winner.
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from .segment import NEUTRAL_T  # noqa: E402
+
+
+@jax.jit
+def dense_merge_counters(vals, ts):
+    """[R, S] per-slot (value, uuid) LWW with max-value tie.
+    -> (val[S], t[S])."""
+    t_max = ts.max(axis=0)
+    val = jnp.where(ts == t_max[None, :], vals, NEUTRAL_T).max(axis=0)
+    return val, t_max
+
+
+@jax.jit
+def dense_merge_elems(at, an, dt):
+    """[R, S] element merge: lexicographic (add_t, add_node) winner + max
+    del_t.  -> (at[S], an[S], dt[S], win_batch[S]); win_batch==0 keeps the
+    local value."""
+    at_max = at.max(axis=0)
+    an_cand = jnp.where(at == at_max[None, :], an, NEUTRAL_T)
+    an_max = an_cand.max(axis=0)
+    winner = (at == at_max[None, :]) & (an == an_max[None, :])
+    win_batch = jnp.argmax(winner, axis=0)  # first winner; row 0 = local
+    return at_max, an_max, dt.max(axis=0), win_batch
+
+
+@jax.jit
+def dense_merge_lww(t, n):
+    """[R, S] plain LWW slots (registers): lexicographic (t, node) winner.
+    -> (t[S], n[S], win_batch[S])."""
+    t_max = t.max(axis=0)
+    n_cand = jnp.where(t == t_max[None, :], n, NEUTRAL_T)
+    n_max = n_cand.max(axis=0)
+    winner = (t == t_max[None, :]) & (n == n_max[None, :])
+    return t_max, n_max, jnp.argmax(winner, axis=0)
+
+
+@jax.jit
+def dense_max(cols):
+    """[R, S, C] pointwise max over R — envelopes."""
+    return cols.max(axis=0)

@@ -1,0 +1,100 @@
+"""Batched CRDT merge kernels: scatter/segment reductions on device.
+
+These lift the reference's per-key merge loops (src/type_counter.rs:59-91
+PN-Counter, src/crdt/lwwhash.rs Set/Dict element merges, src/object.rs:63-83
+envelopes) into data-parallel reductions over columnar row tensors:
+
+  * counter slots:  per-(key,node) LWW = segment-max on uuid, then a masked
+                    segment-max on value for the uuid tie;
+  * elements:       add side = lexicographic (time, node) segment-max in two
+                    scatter passes + winning-row recovery; del side = plain
+                    segment-max;
+  * envelopes:      pointwise max over aligned vectors.
+
+All timestamps are int64 (uuids use 63 bits: 41-bit ms << 22 | seq), so x64
+mode is required; this module enables it at import, before any tracing.
+
+Duplicate slot ids within one batch are the normal case (same key updated by
+many replicas in one snapshot window) — scatter-max handles them natively,
+which is why this is scatter and not a naive reshape-reduce.
+
+Rows are padded to power-of-two buckets so jit recompiles O(log n) times,
+never per batch size.  Padded rows carry t = NEUTRAL_T and a dummy slot id,
+so they lose every reduction and land in a slot that is sliced off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+# loses to every real timestamp (real uuids are >= 0; element add_t >= 0)
+NEUTRAL_T = -(1 << 62)
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@partial(jax.jit, static_argnames=("n_slots",))
+def merge_counters(slot_ids, vals, ts, cur_val, cur_t, n_slots: int):
+    """Merge incoming counter rows into per-slot current state.
+
+    slot_ids/vals/ts: incoming rows (padded; padded rows have ts=NEUTRAL_T
+    and slot_ids pointing at the dummy tail slot).
+    cur_val/cur_t: (n_slots,) current state; new slots carry (0, NEUTRAL_T).
+    -> (new_val, new_t) per slot.
+    """
+    t_max = cur_t.at[slot_ids].max(ts)
+    # value on the max-uuid write; max(value) breaks exact-uuid ties
+    cand_cur = jnp.where(cur_t == t_max, cur_val, NEUTRAL_T)
+    row_cand = jnp.where(ts == t_max[slot_ids], vals, NEUTRAL_T)
+    new_val = cand_cur.at[slot_ids].max(row_cand)
+    # slots never touched keep their value even if cur_t != t_max can't happen
+    return new_val, t_max
+
+
+@partial(jax.jit, static_argnames=("n_slots",))
+def merge_elems(slot_ids, add_t, add_node, del_t, cur_at, cur_an, cur_dt,
+                n_slots: int):
+    """Merge incoming element rows (set members / dict fields) into per-slot
+    current state.
+
+    -> (at, an, dt, win_row) per slot; win_row is the incoming row index
+    whose value should be taken, or -1 when the current write survives.
+    """
+    n = slot_ids.shape[0]
+    at_max = cur_at.at[slot_ids].max(add_t)
+    # lexicographic tie-break on writer node
+    cand_cur = jnp.where(cur_at == at_max, cur_an, NEUTRAL_T)
+    row_cand = jnp.where(add_t == at_max[slot_ids], add_node, NEUTRAL_T)
+    an_max = cand_cur.at[slot_ids].max(row_cand)
+    # recover the winning incoming row (unique: (t, node) identifies a write)
+    rows = jnp.arange(n, dtype=jnp.int64)
+    winner_rows = jnp.where(
+        (add_t == at_max[slot_ids]) & (add_node == an_max[slot_ids]), rows, -1)
+    win_row = jnp.full((n_slots,), -1, dtype=jnp.int64).at[slot_ids].max(winner_rows)
+    # the current write wins outright (or ties as the same write)
+    cur_wins = (cur_at == at_max) & (cur_an == an_max)
+    win_row = jnp.where(cur_wins, -1, win_row)
+    dt = cur_dt.at[slot_ids].max(del_t)
+    return at_max, an_max, dt, win_row
+
+
+@partial(jax.jit, static_argnames=("n_slots",))
+def scatter_max4(slot_ids, a, b, c, d, cur_a, cur_b, cur_c, cur_d, n_slots: int):
+    """Four aligned scatter-max reductions in one device call (key envelope
+    ct/mt/dt/expire merge; n_slots only pins the jit cache key)."""
+    del n_slots
+    return (cur_a.at[slot_ids].max(a), cur_b.at[slot_ids].max(b),
+            cur_c.at[slot_ids].max(c), cur_d.at[slot_ids].max(d))
+
+

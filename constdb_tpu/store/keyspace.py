@@ -59,8 +59,14 @@ class KeySpace:
         self.index: dict[bytes, int] = {}
         self.reg_val: list[Optional[bytes]] = []
 
+        # counter slots are indexed by an integer combo key
+        # (kid << NODE_RANK_BITS | node_rank) — int dict probes vectorize as
+        # C-speed list comprehensions in the batched engine
         self.cnt = _CntCols()
-        self.cnt_slots: dict[int, dict[int, int]] = {}
+        self.cnt_index: dict[int, int] = {}
+        self.cnt_rows_by_kid: dict[int, list[int]] = {}  # O(slots) per-key reads
+        self.node_rank: dict[int, int] = {}
+        self.node_ids: list[int] = []
 
         self.el = _ElCols()
         self.el_member: list[Optional[bytes]] = []
@@ -160,14 +166,28 @@ class KeySpace:
 
     # -------------------------------------------------------------- counters
 
+    NODE_RANK_BITS = 20  # up to ~1M distinct node ids per cluster lifetime
+
+    def rank_of(self, node: int) -> int:
+        """Dense rank for a node id (monotone in registration order)."""
+        r = self.node_rank.get(node)
+        if r is None:
+            r = len(self.node_ids)
+            if r >= (1 << self.NODE_RANK_BITS):
+                raise OverflowError("too many distinct node ids")
+            self.node_rank[node] = r
+            self.node_ids.append(node)
+        return r
+
     def counter_change(self, kid: int, node: int, delta: int, uuid: int) -> int:
         """LWW-gated per-node contribution; returns the new sum.  Advances
         the stored slot uuid (fixing reference type_counter.rs:37-51)."""
-        slots = self.cnt_slots.setdefault(kid, {})
-        row = slots.get(node, -1)
+        combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
+        row = self.cnt_index.get(combo, -1)
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=delta, uuid=uuid)
-            slots[node] = row
+            self.cnt_index[combo] = row
+            self.cnt_rows_by_kid.setdefault(kid, []).append(row)
             self.keys.cnt_sum[kid] += delta
         elif int(self.cnt.uuid[row]) < uuid:
             self.cnt.val[row] += delta
@@ -181,17 +201,19 @@ class KeySpace:
     def counter_slots(self, kid: int) -> list[tuple[int, int, int]]:
         """[(node, val, uuid)] for DESC / DEL / snapshot."""
         out = []
-        for node, row in self.cnt_slots.get(kid, {}).items():
-            out.append((node, int(self.cnt.val[row]), int(self.cnt.uuid[row])))
+        for row in self.cnt_rows_by_kid.get(kid, ()):
+            out.append((int(self.cnt.node[row]), int(self.cnt.val[row]),
+                        int(self.cnt.uuid[row])))
         return out
 
     def counter_merge_slot(self, kid: int, node: int, val: int, uuid: int) -> None:
         """State-merge of one foreign slot (used by the CPU merge engine)."""
-        slots = self.cnt_slots.setdefault(kid, {})
-        row = slots.get(node, -1)
+        combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
+        row = self.cnt_index.get(combo, -1)
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=val, uuid=uuid)
-            slots[node] = row
+            self.cnt_index[combo] = row
+            self.cnt_rows_by_kid.setdefault(kid, []).append(row)
             self.keys.cnt_sum[kid] += val
         else:
             v0, t0 = int(self.cnt.val[row]), int(self.cnt.uuid[row])

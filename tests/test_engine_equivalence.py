@@ -1,0 +1,137 @@
+"""Differential tests: the batched JAX engine must be bit-identical to the
+CPU reference engine on random multi-node CRDT states (SURVEY.md §7 "Exact
+tie semantics ... must be bit-identical between CPU and TPU engines or
+replicas diverge").
+"""
+
+import pytest
+
+from constdb_tpu.crdt import ENC_COUNTER, ENC_DICT, ENC_SET
+from constdb_tpu.engine import CpuMergeEngine, batch_from_keyspace
+from constdb_tpu.engine.tpu import TpuMergeEngine
+from constdb_tpu.store import KeySpace
+
+from test_merge_properties import gen_store
+
+
+@pytest.fixture(scope="module", params=["dense", "scatter"])
+def engines(request):
+    tpu = TpuMergeEngine()
+    # force the chooser: both device strategies must match the CPU engine
+    tpu.DENSE_FRACTION = 10**18 if request.param == "dense" else 0
+    return CpuMergeEngine(), tpu
+
+
+def both_sums(ks):
+    return {k: ks.counter_sum(kid) for k, kid in ks.index.items()
+            if ks.enc_of(kid) == ENC_COUNTER}
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_merge_into_empty_matches_cpu(engines, seed):
+    cpu, tpu = engines
+    src = gen_store(seed, node=1)
+    a, b = KeySpace(), KeySpace()
+    s1 = cpu.merge(a, batch_from_keyspace(src))
+    s2 = tpu.merge(b, batch_from_keyspace(src))
+    assert a.canonical() == b.canonical()
+    assert both_sums(a) == both_sums(b)
+    assert (s1.keys_seen, s1.keys_created) == (s2.keys_seen, s2.keys_created)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_merge_overlapping_states_matches_cpu(engines, seed):
+    cpu, tpu = engines
+    x = gen_store(seed, node=1)
+    y = gen_store(seed + 1000, node=2)
+    bx, by = batch_from_keyspace(x), batch_from_keyspace(y)
+
+    a = KeySpace()
+    cpu.merge(a, bx)
+    cpu.merge(a, by)
+    b = KeySpace()
+    tpu.merge(b, bx)
+    tpu.merge(b, by)
+    assert a.canonical() == b.canonical()
+    assert both_sums(a) == both_sums(b)
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_three_way_and_idempotent(engines, seed):
+    cpu, tpu = engines
+    batches = [batch_from_keyspace(gen_store(seed + i * 77, node=i + 1)) for i in range(3)]
+    a, b = KeySpace(), KeySpace()
+    for bt in batches + [batches[0]]:  # re-merge first batch: idempotence
+        cpu.merge(a, bt)
+        tpu.merge(b, bt)
+    assert a.canonical() == b.canonical()
+
+
+@pytest.mark.parametrize("seed", [2, 5])
+def test_gc_after_tpu_merge_matches_cpu(engines, seed):
+    cpu, tpu = engines
+    x = gen_store(seed, node=1)
+    y = gen_store(seed + 500, node=2)
+    a, b = KeySpace(), KeySpace()
+    for eng, ks in ((cpu, a), (tpu, b)):
+        eng.merge(ks, batch_from_keyspace(x))
+        eng.merge(ks, batch_from_keyspace(y))
+        ks.gc(40 << 22)  # horizon past every uuid in gen_store
+    assert a.canonical() == b.canonical()
+    # all dead elements must have been collected identically
+    for ks in (a, b):
+        for key, kid in ks.index.items():
+            if ks.enc_of(kid) in (ENC_SET, ENC_DICT):
+                for m, at, an, dt, v in ks.elem_all(kid):
+                    assert at >= dt, (key, m)
+
+
+def test_type_conflict_skipped_tpu():
+    tpu = TpuMergeEngine()
+    a, b = KeySpace(), KeySpace()
+    ka, _ = a.get_or_create(b"k", ENC_COUNTER, 5 << 22)
+    a.counter_change(ka, 1, 1, 5 << 22)
+    kb, _ = b.get_or_create(b"k", ENC_SET, 6 << 22)
+    b.elem_add(kb, b"m", None, 6 << 22, 2)
+    st = tpu.merge(a, batch_from_keyspace(b))
+    assert st.type_conflicts == 1
+    assert a.counter_sum(a.lookup(b"k")) == 1
+
+
+def test_empty_batch():
+    tpu = TpuMergeEngine()
+    ks = KeySpace()
+    st = tpu.merge(ks, batch_from_keyspace(KeySpace()))
+    assert st.keys_seen == 0
+
+
+def test_duplicate_slot_rows_in_one_batch():
+    """A batch built from a raw op stream can carry several rows for the same
+    (key, node) slot; the engine must LWW-reduce them, not keep the last
+    placement (regression: the dense path used to silently drop all but the
+    final row)."""
+    import numpy as np
+
+    from constdb_tpu.engine.base import ColumnarBatch
+
+    b = ColumnarBatch()
+    b.keys = [b"k"]
+    b.key_enc = np.array([0], np.int8)  # counter
+    b.key_ct = np.array([1 << 22], np.int64)
+    b.key_mt = np.array([0], np.int64)
+    b.key_dt = np.array([0], np.int64)
+    b.key_expire = np.array([0], np.int64)
+    b.reg_val = [None]
+    b.reg_t = np.zeros(1, np.int64)
+    b.reg_node = np.zeros(1, np.int64)
+    # newer write listed FIRST: last-placement would keep the stale value
+    b.cnt_ki = np.array([0, 0], np.int64)
+    b.cnt_node = np.array([7, 7], np.int64)
+    b.cnt_val = np.array([50, 3], np.int64)
+    b.cnt_uuid = np.array([9 << 22, 2 << 22], np.int64)
+    assert not b.rows_unique_per_slot
+
+    for eng in (CpuMergeEngine(), TpuMergeEngine()):
+        ks = KeySpace()
+        eng.merge(ks, b)
+        assert ks.counter_sum(ks.lookup(b"k")) == 50, eng.name
