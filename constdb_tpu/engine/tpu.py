@@ -95,17 +95,23 @@ class TpuMergeEngine:
                              dtype=_I64, count=n)
         missing = np.nonzero(kid_of < 0)[0]
         if len(missing):
-            # within one batch keys are unique, so bulk-create is safe
+            # a raw op-stream batch may repeat a key: create each unique key
+            # once and point every occurrence at the same row
+            by_key: dict = {}
+            for i in missing.tolist():
+                by_key.setdefault(batch.keys[i], []).append(i)
+            first = np.fromiter((poss[0] for poss in by_key.values()),
+                                dtype=_I64, count=len(by_key))
             rows = store.keys.append_block(
-                len(missing),
-                enc=batch.key_enc[missing], ct=batch.key_ct[missing], mt=0,
-                dt=batch.key_dt[missing], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
-            miss_keys = [batch.keys[i] for i in missing]
-            store.key_bytes.extend(miss_keys)
-            store.reg_val.extend([None] * len(missing))
-            index.update(zip(miss_keys, rows.tolist()))
-            kid_of[missing] = rows
-            st.keys_created += len(missing)
+                len(first),
+                enc=batch.key_enc[first], ct=batch.key_ct[first], mt=0,
+                dt=batch.key_dt[first], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
+            store.key_bytes.extend(by_key.keys())
+            store.reg_val.extend([None] * len(first))
+            index.update(zip(by_key.keys(), rows.tolist()))
+            for poss, row in zip(by_key.values(), rows.tolist()):
+                kid_of[poss] = row
+            st.keys_created += len(first)
 
         present = np.setdiff1d(np.arange(n), missing, assume_unique=True)
         if len(present):

@@ -1,0 +1,3 @@
+from .sharded import make_mesh, sharded_merge_step, shard_batch_arrays
+
+__all__ = ["make_mesh", "sharded_merge_step", "shard_batch_arrays"]

@@ -1,8 +1,11 @@
 """Test harness configuration.
 
-Tests run on CPU with a virtual 8-device platform so multi-chip sharding
-(parallel/) is exercised without TPU hardware; these env vars must be set
-before jax is imported anywhere.
+Tests prefer the virtual 8-device CPU platform so multi-chip sharding
+(parallel/) is exercised without TPU hardware.  If the axon TPU plugin was
+already bound by sitecustomize (it loads before any conftest), these env
+vars cannot take effect in-process — tests then run on the TPU, and the
+sharded-mesh suite re-launches itself in a subprocess with a clean
+environment (see tests/test_sharded_merge.py).
 """
 
 import os
@@ -15,3 +18,20 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+CPU_MESH_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "JAX_ENABLE_X64": "true",
+    "CONSTDB_MESH_RERUN": "1",  # recursion guard for the subprocess re-run
+}
+
+
+def cpu_mesh_subprocess_env() -> dict:
+    """Environment for re-running a test module on the virtual CPU mesh."""
+    env = dict(os.environ)
+    env.update(CPU_MESH_ENV)
+    # unset (not empty-string) so sitecustomize skips the TPU plugin
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env

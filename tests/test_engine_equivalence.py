@@ -135,3 +135,33 @@ def test_duplicate_slot_rows_in_one_batch():
         ks = KeySpace()
         eng.merge(ks, b)
         assert ks.counter_sum(ks.lookup(b"k")) == 50, eng.name
+
+
+def test_duplicate_keys_in_one_batch():
+    """A raw op-stream batch may list the same key twice; the engine must
+    resolve both to one store row (regression: bulk-create used to make two
+    rows and orphan one)."""
+    import numpy as np
+
+    from constdb_tpu.engine.base import ColumnarBatch
+
+    b = ColumnarBatch()
+    b.keys = [b"k", b"k"]
+    b.key_enc = np.array([0, 0], np.int8)
+    b.key_ct = np.array([1 << 22, 1 << 22], np.int64)
+    b.key_mt = np.zeros(2, np.int64)
+    b.key_dt = np.zeros(2, np.int64)
+    b.key_expire = np.zeros(2, np.int64)
+    b.reg_val = [None, None]
+    b.reg_t = np.zeros(2, np.int64)
+    b.reg_node = np.zeros(2, np.int64)
+    b.cnt_ki = np.array([0, 1], np.int64)
+    b.cnt_node = np.array([1, 2], np.int64)
+    b.cnt_val = np.array([5, 10], np.int64)
+    b.cnt_uuid = np.array([2 << 22, 3 << 22], np.int64)
+
+    for eng in (CpuMergeEngine(), TpuMergeEngine()):
+        ks = KeySpace()
+        eng.merge(ks, b)
+        assert ks.n_keys() == 1, eng.name
+        assert ks.counter_sum(ks.lookup(b"k")) == 15, eng.name

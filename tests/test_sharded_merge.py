@@ -1,0 +1,98 @@
+"""Sharded SPMD merge over a virtual 8-device mesh must equal the
+single-device dense kernels bit-for-bit."""
+
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from constdb_tpu.ops import dense as D
+from constdb_tpu.ops.segment import NEUTRAL_T
+from constdb_tpu.parallel import make_mesh, shard_batch_arrays, sharded_merge_step
+
+_HAVE_MESH = len(jax.devices()) >= 8
+
+needs_mesh = pytest.mark.skipif(
+    not _HAVE_MESH, reason="needs 8 devices (re-run via subprocess below)")
+
+
+def test_reruns_on_virtual_cpu_mesh_if_needed():
+    """When the TPU plugin owns this interpreter (1 device), the mesh tests
+    above are skipped — re-run this module in a subprocess on the virtual
+    8-device CPU platform so they always execute somewhere."""
+    if _HAVE_MESH:
+        return  # ran inline
+    import os
+
+    if os.environ.get("CONSTDB_MESH_RERUN"):
+        pytest.fail("virtual CPU mesh unavailable even in the clean-env "
+                    "subprocess — not recursing further")
+    from conftest import cpu_mesh_subprocess_env
+
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", __file__, "-q", "--no-header"],
+        env=cpu_mesh_subprocess_env(), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "6 passed" in r.stdout, r.stdout
+
+
+def _random_inputs(rng, R, S):
+    ts = np.where(rng.random((R, S)) < 0.3, NEUTRAL_T,
+                  rng.integers(1, 1000, (R, S)).astype(np.int64) << 22)
+    vals = rng.integers(-50, 50, (R, S)).astype(np.int64)
+    at = np.where(rng.random((R, S)) < 0.3, NEUTRAL_T,
+                  rng.integers(1, 500, (R, S)).astype(np.int64) << 22)
+    an = rng.integers(1, 9, (R, S)).astype(np.int64)
+    dt = rng.integers(0, 500, (R, S)).astype(np.int64) << 22
+    env = rng.integers(0, 1000, (R, S, 4)).astype(np.int64) << 22
+    return vals, ts, at, an, dt, env
+
+
+@needs_mesh
+@pytest.mark.parametrize("rep,seed", [(1, 0), (2, 1), (4, 2), (8, 3)])
+def test_matches_single_device(rep, seed):
+    R, S = 8, 256
+    rng = np.random.default_rng(seed)
+    vals, ts, at, an, dt, env = _random_inputs(rng, R, S)
+
+    mesh = make_mesh(8, rep=rep)
+    step = sharded_merge_step(mesh)
+    d_in = shard_batch_arrays(mesh, vals, ts, at, an, dt, env)
+    V, T, AT, AN, DT, WIN, ENV, touched = jax.device_get(step(*d_in))
+
+    v1, t1 = jax.device_get(D.dense_merge_counters(vals, ts))
+    a1, n1, d1, w1 = jax.device_get(D.dense_merge_elems(at, an, dt))
+    e1 = jax.device_get(D.dense_max(env))
+
+    np.testing.assert_array_equal(V, v1)
+    np.testing.assert_array_equal(T, t1)
+    np.testing.assert_array_equal(AT, a1)
+    np.testing.assert_array_equal(AN, n1)
+    np.testing.assert_array_equal(DT, d1)
+    np.testing.assert_array_equal(ENV, e1)
+    # winner indices must agree wherever a real winner exists
+    np.testing.assert_array_equal(WIN, w1)
+    assert touched == np.sum(t1 > NEUTRAL_T)
+
+
+@needs_mesh
+def test_row0_wins_ties_across_rep_shards():
+    """The local-state row (global row 0) must win exact (t, node) ties even
+    when the tying replica row lives on another rep shard."""
+    R, S = 8, 128
+    at = np.full((R, S), NEUTRAL_T, np.int64)
+    an = np.zeros((R, S), np.int64)
+    dt = np.zeros((R, S), np.int64)
+    at[0], an[0] = 5 << 22, 3   # local state
+    at[7], an[7] = 5 << 22, 3   # identical write from a replica on shard 3
+    vals = np.zeros((R, S), np.int64)
+    ts = np.full((R, S), NEUTRAL_T, np.int64)
+    env = np.zeros((R, S, 4), np.int64)
+
+    mesh = make_mesh(8, rep=4)
+    step = sharded_merge_step(mesh)
+    out = jax.device_get(step(*shard_batch_arrays(mesh, vals, ts, at, an, dt, env)))
+    WIN = out[5]
+    assert (WIN == 0).all()
