@@ -29,6 +29,21 @@ broken — SURVEY.md §"Known reference defects"):
   * Dict merge is implemented (the reference's panics, lwwhash.rs:176-181).
   * Counter.change advances the stored per-node uuid (the reference never
     does after first insert, type_counter.rs:37-51).
+  * Counter slots are cumulative-total registers, not deltas: a slot holds
+    the writer node's LIFETIME total as an LWW register (total @ uuid), and
+    counter deletes record the delete-observed total as a second LWW
+    register (base @ delete-uuid, max-base on exact ties); the visible
+    value is Σ over slots of (total - base).  Every component is an LWW
+    assignment, so replication is idempotent, reorder-safe, and identical
+    to state merges.  (The reference's `delcnt` replays negated deltas —
+    cmd.rs:233-254 — which requires exactly-once in-order delivery and
+    still diverges when a delete and concurrent increments interleave
+    differently on different replicas.)
+  * element add/rem are pure pointwise ops — adds always LWW-merge into the
+    add side and dels always max into the del side — instead of the
+    reference's drop-if-older gates (lwwhash.rs:87-128), so the op path and
+    the state-merge path compute the same function and replicas that saw
+    different interleavings converge bit-identically.
   * envelope times (ct/mt/dt) merge as max for ALL encodings (the reference
     only does so for Bytes, keeping first-merged otherwise).
   * expire times merge as max (latest expiry wins) — the reference's
@@ -46,6 +61,11 @@ ENC_DICT = 4
 ENC_SET = 5
 
 ENC_NAMES = {ENC_COUNTER: "Counter", ENC_BYTES: "Bytes", ENC_DICT: "LWWDict", ENC_SET: "LWWSet"}
+
+# "never written" timestamp sentinel: loses to every real timestamp (real
+# uuids are >= 0).  Single definition shared by the store layer and the
+# device kernels (ops/segment.py re-exports it).
+NEUTRAL_T = -(1 << 62)
 
 
 def lww_wins(t_a: int, node_a: int, t_b: int, node_b: int) -> bool:
@@ -96,10 +116,14 @@ def merge_elem(add_a: int, anode_a: int, del_a: int,
 
 
 def updated_at(ct: int, mt: int, dt: int, uuid: int) -> tuple[int, int, int]:
-    """Envelope bump on a local write: mt advances; a write at/after the
-    delete time resurrects the key (reference object.rs:34-48)."""
-    if uuid > mt:
-        mt = uuid
-    if ct < dt <= uuid:
-        ct = uuid  # created again
-    return ct, mt, dt
+    """Envelope bump on a data write (local or replicated).
+
+    Redesigned from the reference's resurrect-only rule (object.rs:34-48,
+    `ct = uuid` iff ct < dt <= uuid), which is order-dependent: replicas that
+    interleave the same write/delete ops differently end with different
+    create_times.  Here ct is simply the max over all data-write uuids and dt
+    the max over all delete uuids, so `alive = ct >= dt` becomes the
+    element-level add-wins rule lifted to keys and every envelope component
+    is a plain max — commutative, associative, idempotent, and identical
+    between the op path and the state-merge path (merge_envelope)."""
+    return max(ct, uuid), max(mt, uuid), dt

@@ -43,11 +43,13 @@ class ColumnarBatch:
     reg_val: list = field(default_factory=list)
     reg_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     reg_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
-    # counter slots
+    # counter slots: (lifetime total @ uuid) + (delete-observed base @ base_t)
     cnt_ki: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     cnt_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     cnt_val: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     cnt_uuid: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    cnt_base: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    cnt_base_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     # elements (set members / dict fields)
     el_ki: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     el_member: list = field(default_factory=list)
@@ -117,6 +119,8 @@ def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True) -> ColumnarB
     b.cnt_node = ks.cnt.node.copy()
     b.cnt_val = ks.cnt.val.copy()
     b.cnt_uuid = ks.cnt.uuid.copy()
+    b.cnt_base = ks.cnt.base.copy()
+    b.cnt_base_t = ks.cnt.base_t.copy()
 
     live = ks.el.kid >= 0
     b.el_ki = ks.el.kid[live].copy()

@@ -58,7 +58,8 @@ class CpuMergeEngine:
             if kid < 0:
                 continue
             store.counter_merge_slot(kid, int(batch.cnt_node[r]),
-                                     int(batch.cnt_val[r]), int(batch.cnt_uuid[r]))
+                                     int(batch.cnt_val[r]), int(batch.cnt_uuid[r]),
+                                     int(batch.cnt_base[r]), int(batch.cnt_base_t[r]))
             st.counter_rows += 1
 
         for r in range(len(batch.el_ki)):

@@ -78,6 +78,10 @@ class TpuMergeEngine:
         for b, _ in resolved:
             for i, key in enumerate(b.del_keys):
                 store.record_key_delete(key, int(b.del_t[i]))
+        # slot merges bypass the incremental sum cache — re-derive it in one
+        # vectorized pass (envelope-only merges cannot change counter sums)
+        if any(len(b.cnt_ki) for b, _ in resolved):
+            store.recompute_counter_sums()
         return st
 
     # ------------------------------------------------------- key resolution
@@ -113,17 +117,16 @@ class TpuMergeEngine:
                 kid_of[poss] = row
             st.keys_created += len(first)
 
-        present = np.setdiff1d(np.arange(n), missing, assume_unique=True)
-        if len(present):
-            conflicts = store.keys.enc[kid_of[present]] != batch.key_enc[present]
-            bad = present[conflicts]
-            if len(bad):
-                for i in bad:
-                    log.error("type conflict merging key %r: local=%s incoming=%s",
-                              batch.keys[i], int(store.keys.enc[kid_of[i]]),
-                              int(batch.key_enc[i]))
-                st.type_conflicts += len(bad)
-                kid_of[bad] = -1
+        # conflict check over ALL positions: duplicate occurrences of a key
+        # created above must also match the enc the first occurrence chose
+        bad = np.nonzero(store.keys.enc[kid_of] != batch.key_enc)[0]
+        if len(bad):
+            for i in bad:
+                log.error("type conflict merging key %r: local=%s incoming=%s",
+                          batch.keys[i], int(store.keys.enc[kid_of[i]]),
+                          int(batch.key_enc[i]))
+            st.type_conflicts += len(bad)
+            kid_of[bad] = -1
         return kid_of
 
     # ------------------------------------------------- dense/scatter chooser
@@ -268,7 +271,7 @@ class TpuMergeEngine:
 
     def _merge_counter_rows(self, store: KeySpace, resolved,
                             st: MergeStats) -> None:
-        staged = []  # (rows, val, uuid)
+        staged = []  # (rows, total, uuid, base, base_t)
         for b, kid_of in resolved:
             if not len(b.cnt_ki):
                 continue
@@ -284,44 +287,48 @@ class TpuMergeEngine:
                                 dtype=_I64, count=len(uniq_nodes))
             combos = (kid_arr[keep] << _RANK_BITS) | ranks[inv]
             rows = self._resolve_cnt_rows(store, combos)
-            staged.append((rows, b.cnt_val[keep], b.cnt_uuid[keep]))
+            staged.append((rows, b.cnt_val[keep], b.cnt_uuid[keep],
+                           b.cnt_base[keep], b.cnt_base_t[keep]))
         if not staged:
             return
         S_ = store.cnt.n
-        total = sum(len(r) for r, _, _ in staged)
-        old_val = store.cnt.val.copy()
+        total = sum(len(r) for r, *_ in staged)
 
-        if self._use_dense(total, S_, len(staged), 2):
+        # both slot pairs — (total @ uuid) and (base @ base_t) — are plain
+        # per-slot LWW-with-max-tie merges; run the same kernel twice
+        if self._use_dense(total, S_, len(staged), 4):
             s_pad = K.next_pow2(S_)
-            vals = self._dense_stack(store.cnt.val, [(r, v) for r, v, _ in staged],
-                                     0, s_pad)
-            ts = self._dense_stack(store.cnt.uuid, [(r, t) for r, _, t in staged],
-                                   K.NEUTRAL_T, s_pad)
-            new_val, new_t = (np.asarray(a)[:S_] for a in
-                              self._jax.device_get(D.dense_merge_counters(vals, ts)))
-            store.cnt.val[:] = new_val
-            store.cnt.uuid[:] = new_t
-            delta = new_val - old_val
-            changed = np.nonzero(delta)[0]
-            np.add.at(store.keys.cnt_sum, store.cnt.kid[changed], delta[changed])
-            return
+            for vcol, tcol, vi, ti in (("val", "uuid", 1, 2),
+                                       ("base", "base_t", 3, 4)):
+                vals = self._dense_stack(store.cnt.col(vcol),
+                                         [(s[0], s[vi]) for s in staged], 0, s_pad)
+                ts = self._dense_stack(store.cnt.col(tcol),
+                                       [(s[0], s[ti]) for s in staged],
+                                       K.NEUTRAL_T, s_pad)
+                new_val, new_t = (np.asarray(a)[:S_] for a in
+                                  self._jax.device_get(D.dense_merge_counters(vals, ts)))
+                store.cnt.col(vcol)[:] = new_val
+                store.cnt.col(tcol)[:] = new_t
+            return  # sums re-derived in one pass by merge_many
 
-        all_rows = np.concatenate([r for r, _, _ in staged])
+        all_rows = np.concatenate([s[0] for s in staged])
         trows, slot_idx = np.unique(all_rows, return_inverse=True)
-        cur_val = store.cnt.val[trows].copy()
         n_slots = K.next_pow2(len(trows) + 1)
         n_rows = K.next_pow2(len(all_rows))
-        out = K.merge_counters(
-            _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
-            _pad(np.concatenate([v for _, v, _ in staged]), n_rows, 0),
-            _pad(np.concatenate([t for _, _, t in staged]), n_rows, K.NEUTRAL_T),
-            _pad(cur_val, n_slots, 0),
-            _pad(store.cnt.uuid[trows], n_slots, K.NEUTRAL_T),
-            n_slots)
-        new_val, new_t = (a[: len(trows)] for a in self._jax.device_get(out))
-        store.cnt.val[trows] = new_val
-        store.cnt.uuid[trows] = new_t
-        np.add.at(store.keys.cnt_sum, store.cnt.kid[trows], new_val - cur_val)
+        slot_ids = _pad(slot_idx.astype(_I64), n_rows, n_slots - 1)
+        for vcol, tcol, vi, ti in (("val", "uuid", 1, 2),
+                                   ("base", "base_t", 3, 4)):
+            out = K.merge_counters(
+                slot_ids,
+                _pad(np.concatenate([s[vi] for s in staged]), n_rows, 0),
+                _pad(np.concatenate([s[ti] for s in staged]), n_rows, K.NEUTRAL_T),
+                _pad(store.cnt.col(vcol)[trows], n_slots, 0),
+                _pad(store.cnt.col(tcol)[trows], n_slots, K.NEUTRAL_T),
+                n_slots)
+            new_val, new_t = (a[: len(trows)] for a in self._jax.device_get(out))
+            store.cnt.col(vcol)[trows] = new_val
+            store.cnt.col(tcol)[trows] = new_t
+        # sums re-derived in one pass by merge_many
 
     def _resolve_cnt_rows(self, store: KeySpace, combos: np.ndarray) -> np.ndarray:
         """(kid, node) combo keys -> store cnt rows, bulk-creating missing
@@ -336,7 +343,7 @@ class TpuMergeEngine:
                 miss_combos & ((1 << _RANK_BITS) - 1)]
             new_rows = store.cnt.append_block(
                 len(miss_combos), kid=miss_combos >> _RANK_BITS,
-                node=nodes, val=0, uuid=K.NEUTRAL_T)
+                node=nodes, val=0, uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
             cnt_index.update(zip(miss_combos.tolist(), new_rows.tolist()))
             by_kid = store.cnt_rows_by_kid
             for combo, row in zip((miss_combos >> _RANK_BITS).tolist(),

@@ -33,8 +33,9 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-# loses to every real timestamp (real uuids are >= 0; element add_t >= 0)
-NEUTRAL_T = -(1 << 62)
+# loses to every real timestamp (real uuids are >= 0; element add_t >= 0);
+# canonical definition lives in the jax-free crdt layer
+from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
 
 def next_pow2(n: int) -> int:

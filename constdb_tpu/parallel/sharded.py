@@ -31,10 +31,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 from ..ops.segment import NEUTRAL_T  # noqa: E402
 
-try:  # jax >= 0.4.35
+try:  # jax >= 0.8: top-level function
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
-except ImportError:  # pragma: no cover
-    from jax.shard_map import shard_map
 
 
 def make_mesh(n_devices: Optional[int] = None, rep: int = 1) -> Mesh:

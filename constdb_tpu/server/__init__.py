@@ -1,0 +1,16 @@
+"""Server core: node state, command dispatch, repl-log, event bus, IO loop.
+
+The control plane of a constdb-tpu node (capability parity with reference
+src/server.rs, src/cmd.rs, src/link.rs).  Compute-heavy bulk merges are
+delegated to engine/ (the MergeEngine boundary); this package is the
+single-writer command executor around it.
+"""
+
+from .node import Node
+from .repl_log import ReplLog
+from .events import EventBus, EVENT_REPLICATED, EVENT_REPLICA_ACKED, EVENT_DELETED
+
+__all__ = [
+    "Node", "ReplLog", "EventBus",
+    "EVENT_REPLICATED", "EVENT_REPLICA_ACKED", "EVENT_DELETED",
+]

@@ -1,0 +1,583 @@
+"""Command dispatch table and handlers — the node's client API surface.
+
+Capability parity with the reference's command layer (reference src/cmd.rs
+static COMMANDS table + Cmd::exec, src/type_counter.rs, src/type_set.rs,
+src/type_hash.rs), over the columnar KeySpace instead of per-key heap
+objects.
+
+Dispatch contract (reference src/cmd.rs:43-63):
+  * client commands mint a fresh HLC uuid; replicated commands run with the
+    ORIGINATOR's (nodeid, uuid) and are never re-replicated.
+  * on success, WRITE commands without NO_REPLICATE are appended verbatim to
+    the repl_log; NO_REPLICATE handlers may push rewritten commands
+    themselves (DEL rewrites into delcnt/delbytes/delset/deldict —
+    reference src/cmd.rs:220-296).
+  * REPL_ONLY commands are rejected from clients; CLIENT_ONLY commands are
+    rejected from the replication stream (an enforcement the reference
+    documents but does not code — src/cmd.rs:220 comment).
+
+Deliberate fixes over the reference (documented in crdt/semantics.py):
+  * SPOP replicates the deterministic rewrite `srem key <member>` instead of
+    replaying the random pop on every replica (reference type_set.rs:85-117
+    would diverge).
+  * uuid minting is write-only (the reference's `flags | COMMAND_WRITE > 0`
+    precedence bug makes every command a write — src/cmd.rs:49).
+  * applying a replicated command advances the local HLC past the origin
+    uuid, so later local writes sort after everything already seen.
+  * EXPIRE/EXPIREAT/TTL exist (the reference ships the expiry machinery with
+    no command — SURVEY.md §"Known reference defects"); expiry merges as
+    max, so EXPIRE extends but never shortens a TTL.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Optional, TYPE_CHECKING
+
+from ..crdt import semantics as S
+from ..errors import (CstError, InvalidRequestMsg, UnknownCmd, UnknownSubCmd,
+                      WrongArity)
+from ..resp.message import (Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, OK,
+                            as_bytes, as_int, as_uint)
+from ..utils.hlc import now_ms, SEQ_BITS
+
+if TYPE_CHECKING:
+    from .node import Node
+
+# --- command flags (parity: reference src/cmd.rs:80-85) ---
+CMD_READONLY = 1
+CMD_WRITE = 2
+CMD_CTRL = 4
+CMD_NO_REPLICATE = 8
+CMD_NO_REPLY = 16
+CMD_REPL_ONLY = 32
+CMD_CLIENT_ONLY = 64
+
+
+class Command:
+    __slots__ = ("name", "handler", "flags")
+
+    def __init__(self, name: bytes, handler: Callable, flags: int):
+        self.name = name
+        self.handler = handler
+        self.flags = flags
+
+    @property
+    def is_write(self) -> bool:
+        return bool(self.flags & CMD_WRITE)
+
+
+COMMANDS: dict[bytes, Command] = {}
+
+
+def register(name: str, flags: int):
+    def deco(fn):
+        cmd = Command(name.encode(), fn, flags)
+        COMMANDS[cmd.name] = cmd
+        return fn
+    return deco
+
+
+class ArgIter:
+    """Arity-checked argument cursor (parity: reference NextArg,
+    src/cmd.rs:348-397)."""
+
+    __slots__ = ("items", "pos", "cmd")
+
+    def __init__(self, items: list, cmd: str = ""):
+        self.items = items
+        self.pos = 0
+        self.cmd = cmd
+
+    def _next(self) -> Msg:
+        if self.pos >= len(self.items):
+            raise WrongArity(self.cmd)
+        m = self.items[self.pos]
+        self.pos += 1
+        return m
+
+    def next_bytes(self) -> bytes:
+        return as_bytes(self._next())
+
+    def next_int(self) -> int:
+        return as_int(self._next())
+
+    def next_uint(self) -> int:
+        return as_uint(self._next())
+
+    def next_str(self) -> str:
+        return self.next_bytes().decode("utf-8", "replace")
+
+    @property
+    def has_more(self) -> bool:
+        return self.pos < len(self.items)
+
+    def rest_bytes(self) -> list[bytes]:
+        out = []
+        while self.has_more:
+            out.append(self.next_bytes())
+        return out
+
+
+class ExecCtx:
+    """Per-execution context: who wrote, at what HLC time, via which path."""
+
+    __slots__ = ("uuid", "nodeid", "from_repl", "client")
+
+    def __init__(self, uuid: int, nodeid: int, from_repl: bool, client=None):
+        self.uuid = uuid
+        self.nodeid = nodeid
+        self.from_repl = from_repl
+        self.client = client
+
+
+def execute(node: "Node", req, client=None) -> Msg:
+    """Client-path dispatch (reference Cmd::exec, src/cmd.rs:43-53)."""
+    items = req.items if isinstance(req, Arr) else list(req)
+    if not items:
+        return Err(b"empty command")
+    try:
+        name = as_bytes(items[0]).lower()
+    except CstError as e:
+        return Err(e.resp_error())
+    cmd = COMMANDS.get(name)
+    if cmd is None:
+        return Err(UnknownCmd(name.decode("utf-8", "replace")).resp_error())
+    if cmd.flags & CMD_REPL_ONLY:
+        return Err(b"this command can only be sent by replicas")
+    node.stats.cmds_processed += 1
+    uuid = node.hlc.tick(cmd.is_write)
+    ctx = ExecCtx(uuid, node.node_id, False, client)
+    args = ArgIter(items[1:], name.decode())
+    try:
+        reply = cmd.handler(node, ctx, args)
+    except CstError as e:
+        return Err(e.resp_error())
+    if cmd.is_write and not (cmd.flags & CMD_NO_REPLICATE):
+        node.replicate_cmd(uuid, name, items[1:])
+    return reply
+
+
+def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
+                     uuid: int) -> Msg:
+    """Replication-path dispatch with the originator's identity
+    (reference Cmd::exec_detail with repl=false, pull.rs:184-235)."""
+    cmd = COMMANDS.get(name.lower())
+    if cmd is None:
+        raise UnknownCmd(name.decode("utf-8", "replace"))
+    if cmd.flags & CMD_CLIENT_ONLY:
+        raise InvalidRequestMsg(f"'{name.decode()}' cannot come from a replica")
+    node.stats.cmds_replicated += 1
+    node.hlc.observe(uuid)
+    ctx = ExecCtx(uuid, origin_nodeid, True, None)
+    return cmd.handler(node, ctx, ArgIter(args, name.decode()))
+
+
+# ====================================================================
+# generic commands (reference src/cmd.rs:141-346)
+# ====================================================================
+
+@register("get", CMD_READONLY)
+def get_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return NIL
+    enc = ks.enc_of(kid)
+    if enc == S.ENC_COUNTER:
+        return Int(ks.counter_sum(kid))
+    if enc == S.ENC_BYTES:
+        v = ks.register_get(kid)
+        return Bulk(v if v is not None else b"")
+    raise _invalid_type()
+
+
+def _invalid_type():
+    from ..errors import InvalidType
+    return InvalidType()
+
+
+@register("set", CMD_WRITE)
+def set_command(node, ctx, args):
+    key = args.next_bytes()
+    val = args.next_bytes()
+    kid, _created = node.ks.get_or_create(key, S.ENC_BYTES, ctx.uuid)
+    if node.ks.register_set(kid, val, ctx.uuid, ctx.nodeid):
+        return OK
+    return Int(0)  # stale write ignored (reference cmd.rs:199-201)
+
+
+@register("desc", CMD_READONLY)
+def desc_command(node, ctx, args):
+    key = args.next_bytes()
+    kid = node.ks.query(key, ctx.uuid)
+    if kid < 0:
+        return NIL
+    d = node.ks.describe(kid)
+    return Arr([Bulk(f"{k}: {v}") for k, v in d.items()])
+
+
+@register("del", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY)
+def del_command(node, ctx, args):
+    """Rewrites itself into type-specific REPL_ONLY tombstone commands
+    (reference src/cmd.rs:220-296)."""
+    key = args.next_bytes()
+    ks = node.ks
+    uuid = ctx.uuid
+    kid = ks.query(key, uuid)
+    if kid < 0:
+        return Int(0)
+    enc = ks.enc_of(kid)
+    ct, mt, dt = ks.envelope(kid)
+    deleted = 0
+    if enc in (S.ENC_COUNTER, S.ENC_BYTES):
+        # no deletion while unseen later modifications exist (reference
+        # policy for client-originated deletes, cmd.rs:232-235)
+        if mt <= uuid and ct >= dt:
+            ks.keys.dt[kid] = uuid
+            ks.keys.mt[kid] = uuid
+            ks.record_key_delete(key, uuid)
+            deleted = 1
+            if enc == S.ENC_COUNTER:
+                # record the observed totals as per-slot bases (absolute
+                # assignments — the reference's negated-delta scheme,
+                # cmd.rs:233-254, diverges when the delete and concurrent
+                # increments interleave differently across replicas)
+                rep = [Bulk(key)]
+                for slot_node, total, _t, _b, _bt in ks.counter_slots(kid):
+                    ks.counter_set_base(kid, slot_node, total, uuid)
+                    rep.append(Int(slot_node))
+                    rep.append(Int(total))
+                node.replicate_cmd(uuid, b"delcnt", rep)
+            else:
+                node.replicate_cmd(uuid, b"delbytes", [Bulk(key)])
+    elif enc in (S.ENC_SET, S.ENC_DICT):
+        members = [m for m, *_ in ks.elem_all(kid)]
+        for m in members:
+            ks.elem_rem(kid, m, uuid)
+        if ct >= dt and uuid > ct:
+            deleted = 1
+        ks.set_delete_time(kid, uuid)
+        ks.record_key_delete(key, uuid)
+        node.replicate_cmd(uuid, b"delset" if enc == S.ENC_SET else b"deldict",
+                           [Bulk(key)])
+    return Int(deleted)
+
+
+@register("delbytes", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def delbytes_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.index.get(key, -1)
+    if kid < 0:
+        # unlike the reference (cmd.rs:298-317 creates a LIVE empty key),
+        # an unknown key materializes already-tombstoned: ct=0 < dt=uuid
+        kid = ks.create_key(key, S.ENC_BYTES, 0)
+    elif ks.enc_of(kid) != S.ENC_BYTES:
+        raise _invalid_type()
+    ks.set_delete_time(kid, ctx.uuid)
+    ks.record_key_delete(key, ctx.uuid)
+    return NO_REPLY
+
+
+@register("node", CMD_CTRL)
+def node_command(node, ctx, args):
+    sub = args.next_bytes().lower()
+    if sub == b"id":
+        if not args.has_more:
+            return Int(node.node_id)
+        v = args.next_int()
+        if v <= 0:
+            return Err(b"id must be greater than 0")
+        node.node_id = v
+        return OK
+    if sub == b"alias":
+        if not args.has_more:
+            return Bulk(node.alias.encode())
+        node.alias = args.next_str()
+        return OK
+    return Err(b"unsupported command")
+
+
+@register("repllog", CMD_CTRL)
+def repllog_command(node, ctx, args):
+    sub = args.next_str().lower()
+    if sub == "at":
+        e = node.repl_log.at(args.next_uint())
+        return node.repl_log.entry_as_msg(e) if e else NIL
+    if sub == "uuids":
+        return Arr([Int(u) for u in node.repl_log.uuids()])
+    raise UnknownSubCmd(sub, "REPLLOG")
+
+
+@register("client", CMD_CTRL)
+def client_command(node, ctx, args):
+    sub = args.next_str().lower()
+    if sub == "threadid":
+        return Bulk(str(threading.get_ident()).encode())
+    raise UnknownSubCmd(sub, "CLIENT")
+
+
+# ====================================================================
+# counter commands (reference src/type_counter.rs:142-205)
+# ====================================================================
+
+def _counter_step(node, ctx, args, delta: int) -> Msg:
+    """INCR/DECR: bump the local slot's lifetime total and replicate the
+    new ABSOLUTE total (idempotent LWW assignment on the wire — see
+    KeySpace.counter_change)."""
+    key = args.next_bytes()
+    kid, _ = node.ks.get_or_create(key, S.ENC_COUNTER, ctx.uuid)
+    v, total = node.ks.counter_change(kid, ctx.nodeid, delta, ctx.uuid)
+    node.ks.updated_at(kid, ctx.uuid)
+    node.replicate_cmd(ctx.uuid, b"cntset", [Bulk(key), Int(total)])
+    return Int(v)
+
+
+@register("incr", CMD_WRITE | CMD_NO_REPLICATE)
+def incr_command(node, ctx, args):
+    return _counter_step(node, ctx, args, 1)
+
+
+@register("decr", CMD_WRITE | CMD_NO_REPLICATE)
+def decr_command(node, ctx, args):
+    return _counter_step(node, ctx, args, -1)
+
+
+@register("cntset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def cntset_command(node, ctx, args):
+    """Replicated counter write: assign the originator's lifetime total."""
+    key = args.next_bytes()
+    total = args.next_int()
+    kid, _ = node.ks.get_or_create(key, S.ENC_COUNTER, ctx.uuid)
+    node.ks.counter_set_total(kid, ctx.nodeid, total, ctx.uuid)
+    node.ks.updated_at(kid, ctx.uuid)
+    return NO_REPLY
+
+
+@register("delcnt", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def delcnt_command(node, ctx, args):
+    """Counter delete: tombstone the key and assign each listed slot's
+    delete-observed base (visible value becomes total - base)."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.index.get(key, -1)
+    if kid < 0:
+        # materialize already-tombstoned (ct=0 < dt) so bases still register
+        kid = ks.create_key(key, S.ENC_COUNTER, 0)
+    elif ks.enc_of(kid) != S.ENC_COUNTER:
+        raise _invalid_type()
+    ks.set_delete_time(kid, ctx.uuid)
+    ks.record_key_delete(key, ctx.uuid)
+    while args.has_more:
+        slot_node = args.next_uint()
+        base = args.next_int()
+        ks.counter_set_base(kid, slot_node, base, ctx.uuid)
+    return NO_REPLY
+
+
+# ====================================================================
+# set commands (reference src/type_set.rs)
+# ====================================================================
+
+@register("sadd", CMD_WRITE)
+def sadd_command(node, ctx, args):
+    key = args.next_bytes()
+    members = args.rest_bytes()
+    if not members:
+        raise WrongArity("sadd")
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_SET, ctx.uuid)
+    cnt = sum(ks.elem_add(kid, m, None, ctx.uuid, ctx.nodeid) for m in members)
+    dt = int(ks.keys.dt[kid])
+    if ctx.uuid < dt:
+        # a concurrent key-level delete from another replica wins
+        # (reference type_set.rs:35-39)
+        for m in members:
+            ks.elem_rem(kid, m, dt)
+        cnt = 0
+    ks.updated_at(kid, ctx.uuid)
+    return Int(cnt)
+
+
+@register("srem", CMD_WRITE)
+def srem_command(node, ctx, args):
+    key = args.next_bytes()
+    members = args.rest_bytes()
+    if not members:
+        raise WrongArity("srem")
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_SET, ctx.uuid)
+    cnt = sum(ks.elem_rem(kid, m, ctx.uuid) for m in members)
+    ks.updated_at(kid, ctx.uuid)
+    return Int(cnt)
+
+
+@register("smembers", CMD_READONLY)
+def smembers_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return NIL
+    if ks.enc_of(kid) != S.ENC_SET:
+        raise _invalid_type()
+    return Arr([Bulk(m) for m, _v, _t in ks.elem_live(kid)])
+
+
+@register("spop", CMD_WRITE | CMD_NO_REPLICATE)
+def spop_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return NIL
+    if ks.enc_of(kid) != S.ENC_SET:
+        raise _invalid_type()
+    live = [m for m, _v, _t in ks.elem_live(kid)]
+    if not live:
+        return NIL
+    member = live[random.randrange(len(live))]
+    ks.elem_rem(kid, member, ctx.uuid)
+    ks.updated_at(kid, ctx.uuid)
+    # deterministic rewrite so every replica pops the SAME member
+    node.replicate_cmd(ctx.uuid, b"srem", [Bulk(key), Bulk(member)])
+    return Bulk(member)
+
+
+def _del_collection(node, ctx, args, enc: int) -> Msg:
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.index.get(key, -1)
+    if kid < 0:
+        kid = ks.create_key(key, enc, 0)
+    elif ks.enc_of(kid) != enc:
+        raise _invalid_type()
+    for m, *_ in list(ks.elem_all(kid)):
+        ks.elem_rem(kid, m, ctx.uuid)
+    ks.set_delete_time(kid, ctx.uuid)
+    ks.record_key_delete(key, ctx.uuid)
+    return NO_REPLY
+
+
+@register("delset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def delset_command(node, ctx, args):
+    return _del_collection(node, ctx, args, S.ENC_SET)
+
+
+# ====================================================================
+# hash commands (reference src/type_hash.rs)
+# ====================================================================
+
+@register("hset", CMD_WRITE)
+def hset_command(node, ctx, args):
+    key = args.next_bytes()
+    kvs = []
+    while args.has_more:
+        f = args.next_bytes()
+        kvs.append((f, args.next_bytes()))
+    if not kvs:
+        raise WrongArity("hset")
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_DICT, ctx.uuid)
+    cnt = sum(ks.elem_add(kid, f, v, ctx.uuid, ctx.nodeid) for f, v in kvs)
+    dt = int(ks.keys.dt[kid])
+    if ctx.uuid < dt:
+        # concurrent key-level delete wins (reference type_hash.rs:38-43)
+        for f, _v in kvs:
+            ks.elem_rem(kid, f, dt)
+        cnt = 0
+    ks.updated_at(kid, ctx.uuid)
+    return Int(cnt)
+
+
+@register("hget", CMD_READONLY)
+def hget_command(node, ctx, args):
+    key = args.next_bytes()
+    field = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return NIL
+    if ks.enc_of(kid) != S.ENC_DICT:
+        raise _invalid_type()
+    v = ks.elem_get(kid, field)
+    return Bulk(v) if v is not None else NIL
+
+
+@register("hgetall", CMD_READONLY)
+def hgetall_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return NIL
+    if ks.enc_of(kid) != S.ENC_DICT:
+        raise _invalid_type()
+    return Arr([Arr([Bulk(f), Bulk(v if v is not None else b"")])
+                for f, v, _t in ks.elem_live(kid)])
+
+
+@register("hdel", CMD_WRITE)
+def hdel_command(node, ctx, args):
+    key = args.next_bytes()
+    fields = args.rest_bytes()
+    if not fields:
+        raise WrongArity("hdel")
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_DICT, ctx.uuid)
+    cnt = sum(ks.elem_rem(kid, f, ctx.uuid) for f in fields)
+    ks.updated_at(kid, ctx.uuid)
+    return Int(cnt)
+
+
+@register("deldict", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def deldict_command(node, ctx, args):
+    return _del_collection(node, ctx, args, S.ENC_DICT)
+
+
+# ====================================================================
+# expiry (capability completion: the reference ships the machinery with no
+# command — SURVEY.md §"Known reference defects"; db.rs:53-71)
+# ====================================================================
+
+@register("expire", CMD_WRITE | CMD_NO_REPLICATE)
+def expire_command(node, ctx, args):
+    key = args.next_bytes()
+    secs = args.next_uint()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return Int(0)
+    exp_uuid = (now_ms() + secs * 1000) << SEQ_BITS
+    ks.expire_at(key, exp_uuid)
+    # replicate the ABSOLUTE expiry so replicas agree on the deadline
+    node.replicate_cmd(ctx.uuid, b"expireat", [Bulk(key), Int(exp_uuid)])
+    return Int(1)
+
+
+@register("expireat", CMD_WRITE)
+def expireat_command(node, ctx, args):
+    key = args.next_bytes()
+    exp_uuid = args.next_uint()
+    ks = node.ks
+    kid = ks.index.get(key, -1)
+    if kid < 0:
+        return Int(0)
+    ks.expire_at(key, exp_uuid)
+    return Int(1)
+
+
+@register("ttl", CMD_READONLY)
+def ttl_command(node, ctx, args):
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return Int(-2)
+    exp = int(ks.keys.expire[kid])
+    if exp == 0:
+        return Int(-1)
+    return Int(max(0, (exp >> SEQ_BITS) - now_ms()) // 1000)

@@ -1,0 +1,100 @@
+"""Node: the single-writer state of one constdb-tpu process.
+
+Capability parity with the reference's `Server` struct (reference
+src/server.rs:27-53): node identity, HLC uuid source, keyspace, repl-log
+ring, event bus, replica membership, GC.  All mutation happens on one
+asyncio event loop (the reference's main-thread discipline, server.rs:128-131);
+IO concurrency lives in server/io.py, bulk merge compute in engine/.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..engine.cpu import CpuMergeEngine
+from ..store.keyspace import KeySpace
+from ..utils.hlc import HLC
+from .events import EVENT_REPLICATED, EventBus
+from .repl_log import ReplLog
+
+
+@dataclass
+class NodeStats:
+    """Per-node counters folded into INFO (reference src/stats.rs)."""
+
+    cmds_processed: int = 0
+    cmds_replicated: int = 0
+    net_in_bytes: int = 0
+    net_out_bytes: int = 0
+    connections_accepted: int = 0
+    current_clients: int = 0
+    merges: int = 0
+    merge_rows: int = 0
+    gc_freed: int = 0
+    start_time: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+
+class Node:
+    def __init__(self, node_id: int = 0, alias: str = "", addr: str = "",
+                 engine=None, repl_log_cap: int = ReplLog.DEFAULT_CAP,
+                 clock=None):
+        self.node_id = node_id
+        self.alias = alias
+        self.addr = addr
+        self.hlc = HLC() if clock is None else HLC(clock)
+        self.ks = KeySpace()
+        self.repl_log = ReplLog(repl_log_cap)
+        self.events = EventBus()
+        self.engine = engine if engine is not None else CpuMergeEngine()
+        self.stats = NodeStats()
+        # replica membership/manager — attached by replica.ReplicaManager;
+        # None for a standalone node (tests, cli tooling)
+        self.replicas = None
+
+    # ------------------------------------------------------------ execution
+
+    def execute(self, req, client=None):
+        """One client command, fully (parse → run → replicate)."""
+        from .commands import execute
+        return execute(self, req, client)
+
+    def apply_replicated(self, name: bytes, args: list, origin_nodeid: int,
+                         uuid: int):
+        """One command from a peer's replication stream."""
+        from .commands import apply_replicated
+        return apply_replicated(self, name, args, origin_nodeid, uuid)
+
+    def replicate_cmd(self, uuid: int, name: bytes, args: list) -> None:
+        """Append to the repl_log and wake pushers (reference
+        src/server.rs:270-288)."""
+        self.repl_log.push(uuid, name, args)
+        self.events.trigger(EVENT_REPLICATED, uuid)
+
+    # ------------------------------------------------------------------- GC
+
+    def gc_horizon(self) -> int:
+        """Tombstones at or below this uuid are collectable: every live peer's
+        stream has passed it (reference replica/replica.rs:87-89 min over
+        uuid_he_sent; standalone nodes collect up to their own clock)."""
+        if self.replicas is not None:
+            m = self.replicas.min_uuid()
+            if m is not None:
+                return m
+        return self.hlc.current
+
+    def gc(self) -> int:
+        freed = self.ks.gc(self.gc_horizon())
+        self.stats.gc_freed += freed
+        return freed
+
+    # ------------------------------------------------------------ merge path
+
+    def merge_batch(self, batch) -> None:
+        """Bulk CRDT merge via the configured MergeEngine (snapshot ingest /
+        replica catch-up — the reference's per-key db.merge_entry loop)."""
+        st = self.engine.merge(self.ks, batch)
+        self.stats.merges += 1
+        self.stats.merge_rows += batch.n_rows
+        return st

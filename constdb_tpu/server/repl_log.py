@@ -1,0 +1,95 @@
+"""Replication log: byte-capped ring of locally-executed write commands.
+
+Capability parity with the reference's repl_log (reference
+src/server.rs:35-38 ring + cap, 270-288 push/evict, 290-379 queries with
+binary search by uuid).  Entries are only ever appended with strictly
+increasing uuids (the HLC guarantees this for local writes), so lookups are
+binary searches over a deque of sorted uuids.
+
+The ring additionally tracks `evicted_up_to` — the uuid of the newest entry
+ever evicted — so partial-resync eligibility is exact: a peer resuming from
+uuid `u` can be served incrementally iff `u >= evicted_up_to` (the reference
+infers this more loosely in push.rs:95-110).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right
+from collections import deque
+from typing import Optional
+
+from ..resp.message import Arr, Msg, msg_size
+
+
+class ReplEntry:
+    __slots__ = ("uuid", "prev_uuid", "name", "args", "size")
+
+    def __init__(self, uuid: int, prev_uuid: int, name: bytes, args: list, size: int):
+        self.uuid = uuid
+        self.prev_uuid = prev_uuid
+        self.name = name
+        self.args = args
+        self.size = size
+
+
+class ReplLog:
+    # parity: reference src/server.rs:81 (size-based cap, 1_024_000 bytes)
+    DEFAULT_CAP = 1_024_000
+
+    def __init__(self, cap_bytes: int = DEFAULT_CAP):
+        self.cap = cap_bytes
+        self._entries: deque[ReplEntry] = deque()
+        self._uuids: deque[int] = deque()  # parallel, for bisect
+        self._bytes = 0
+        self.evicted_up_to = 0  # uuid of the newest evicted entry (0 = none)
+        self.last_uuid = 0      # newest uuid ever pushed (survives eviction)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def first_uuid(self) -> int:
+        return self._uuids[0] if self._uuids else 0
+
+    def push(self, uuid: int, name: bytes, args: list) -> None:
+        if uuid <= self.last_uuid:
+            raise ValueError(f"repl_log uuids must be increasing: {uuid} <= {self.last_uuid}")
+        size = len(name) + sum(msg_size(a) for a in args)
+        self._entries.append(ReplEntry(uuid, self.last_uuid, name, args, size))
+        self._uuids.append(uuid)
+        self._bytes += size
+        self.last_uuid = uuid
+        while self._bytes > self.cap and len(self._entries) > 1:
+            ev = self._entries.popleft()
+            self._uuids.popleft()
+            self._bytes -= ev.size
+            self.evicted_up_to = ev.uuid
+
+    def can_resume_from(self, uuid: int) -> bool:
+        """Is an incremental stream starting after `uuid` gap-free?
+        (partial vs full sync decision — reference push.rs:95-110)."""
+        return uuid >= self.evicted_up_to
+
+    def next_after(self, uuid: int) -> Optional[ReplEntry]:
+        """The oldest entry with uuid > `uuid` (the next frame to push)."""
+        i = bisect_right(self._uuids, uuid)
+        return self._entries[i] if i < len(self._entries) else None
+
+    def at(self, uuid: int) -> Optional[ReplEntry]:
+        """Exact-uuid lookup (REPLLOG AT — reference server.rs:318-350)."""
+        i = bisect_left(self._uuids, uuid)
+        if i < len(self._uuids) and self._uuids[i] == uuid:
+            return self._entries[i]
+        return None
+
+    def uuids(self) -> list[int]:
+        return list(self._uuids)
+
+    def entry_as_msg(self, e: ReplEntry) -> Msg:
+        """The stored command as a RESP array (REPLLOG AT reply)."""
+        from ..resp.message import Bulk
+        return Arr([Bulk(e.name), *e.args])

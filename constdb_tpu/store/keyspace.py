@@ -43,8 +43,12 @@ class _KeyCols(Columns):
 
 
 class _CntCols(Columns):
+    # val  = the writer node's LIFETIME cumulative total (LWW register @ uuid)
+    # base = the total observed by the latest counter delete (LWW @ base_t)
+    # visible contribution of a slot = val - base
     def __init__(self) -> None:
-        super().__init__({"kid": _I64, "node": _I64, "val": _I64, "uuid": _I64}, cap=4096)
+        super().__init__({"kid": _I64, "node": _I64, "val": _I64, "uuid": _I64,
+                          "base": _I64, "base_t": _I64}, cap=4096)
 
 
 class _ElCols(Columns):
@@ -116,17 +120,17 @@ class KeySpace:
         if kid < 0:
             return -1
         exp = int(self.keys.expire[kid])
-        if exp:
-            ct, dt = int(self.keys.ct[kid]), int(self.keys.dt[kid])
-            if ct >= dt and ct < exp <= uuid:
-                # a due expiry is a plain key-level delete at `exp`.  (The
-                # reference also calls updated_at here, which resurrects the
-                # key it just expired — db.rs:53-66, its own assertion at
-                # db.rs:154 is commented out.  Fixed.)
-                self.keys.dt[kid] = exp
-                if exp > int(self.keys.mt[kid]):
-                    self.keys.mt[kid] = exp
-                self.record_key_delete(key, exp)
+        if exp and exp <= uuid and int(self.keys.dt[kid]) < exp:
+            # a due expiry is a plain key-level delete at `exp`: dt advances
+            # to exp and the usual `ct >= dt` rule decides visibility, so a
+            # data write after the deadline resurrects the key (add-wins).
+            # (The reference instead calls updated_at here, resurrecting the
+            # key it just expired — db.rs:53-66, its own assertion at
+            # db.rs:154 is commented out.  Fixed.)
+            self.keys.dt[kid] = exp
+            if exp > int(self.keys.mt[kid]):
+                self.keys.mt[kid] = exp
+            self.record_key_delete(key, exp)
         return kid
 
     def alive(self, kid: int) -> bool:
@@ -179,47 +183,94 @@ class KeySpace:
             self.node_ids.append(node)
         return r
 
-    def counter_change(self, kid: int, node: int, delta: int, uuid: int) -> int:
-        """LWW-gated per-node contribution; returns the new sum.  Advances
-        the stored slot uuid (fixing reference type_counter.rs:37-51)."""
+    NEUTRAL_T = S.NEUTRAL_T  # "never written" timestamp for either LWW pair
+
+    def _cnt_row(self, kid: int, node: int) -> int:
+        """Existing or fresh (both pairs unwritten) slot row."""
         combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
         row = self.cnt_index.get(combo, -1)
         if row < 0:
-            row = self.cnt.append(kid=kid, node=node, val=delta, uuid=uuid)
+            row = self.cnt.append(kid=kid, node=node, val=0, uuid=self.NEUTRAL_T,
+                                  base=0, base_t=self.NEUTRAL_T)
             self.cnt_index[combo] = row
             self.cnt_rows_by_kid.setdefault(kid, []).append(row)
-            self.keys.cnt_sum[kid] += delta
-        elif int(self.cnt.uuid[row]) < uuid:
+        return row
+
+    def counter_change(self, kid: int, node: int, delta: int, uuid: int) -> tuple[int, int]:
+        """Local INCR/DECR on the caller's own slot: the cumulative lifetime
+        total advances by `delta` at `uuid`.  -> (new visible sum, new total).
+
+        Counter model (diverges deliberately from the reference's delta
+        scheme, type_counter.rs + cmd.rs:233-254, which requires exactly-once
+        in-order delivery and still diverges around deletes): a slot is a
+        single-writer LWW register holding the writer's lifetime total, plus
+        a delete-observed `base` LWW register; the visible contribution is
+        total - base.  Every component is an LWW assignment, so replication
+        is idempotent, reorder-safe, and bit-identical to state merges.
+        """
+        row = self._cnt_row(kid, node)
+        if uuid > int(self.cnt.uuid[row]):
             self.cnt.val[row] += delta
             self.cnt.uuid[row] = uuid
             self.keys.cnt_sum[kid] += delta
-        return int(self.keys.cnt_sum[kid])
+        return int(self.keys.cnt_sum[kid]), int(self.cnt.val[row])
+
+    def counter_set_total(self, kid: int, node: int, total: int, uuid: int) -> None:
+        """Replicated total assignment (CNTSET): LWW on uuid."""
+        row = self._cnt_row(kid, node)
+        if uuid > int(self.cnt.uuid[row]):
+            self.keys.cnt_sum[kid] += total - int(self.cnt.val[row])
+            self.cnt.val[row] = total
+            self.cnt.uuid[row] = uuid
+
+    def counter_set_base(self, kid: int, node: int, base: int, base_t: int) -> None:
+        """Delete-observed base assignment (DELCNT): LWW on delete time,
+        max-base on exact ties (concurrent deletes on different nodes can
+        mint the same uuid — must mirror merge_counter_slot's tie rule)."""
+        row = self._cnt_row(kid, node)
+        b0, bt0 = int(self.cnt.base[row]), int(self.cnt.base_t[row])
+        if base_t > bt0 or (base_t == bt0 and base > b0):
+            self.keys.cnt_sum[kid] -= base - b0
+            self.cnt.base[row] = base
+            self.cnt.base_t[row] = base_t
 
     def counter_sum(self, kid: int) -> int:
         return int(self.keys.cnt_sum[kid])
 
-    def counter_slots(self, kid: int) -> list[tuple[int, int, int]]:
-        """[(node, val, uuid)] for DESC / DEL / snapshot."""
+    def counter_slots(self, kid: int) -> list[tuple[int, int, int, int, int]]:
+        """[(node, total, uuid, base, base_t)] for DESC / DEL / snapshot."""
         out = []
         for row in self.cnt_rows_by_kid.get(kid, ()):
             out.append((int(self.cnt.node[row]), int(self.cnt.val[row]),
-                        int(self.cnt.uuid[row])))
+                        int(self.cnt.uuid[row]), int(self.cnt.base[row]),
+                        int(self.cnt.base_t[row])))
         return out
 
-    def counter_merge_slot(self, kid: int, node: int, val: int, uuid: int) -> None:
-        """State-merge of one foreign slot (used by the CPU merge engine)."""
-        combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
-        row = self.cnt_index.get(combo, -1)
-        if row < 0:
-            row = self.cnt.append(kid=kid, node=node, val=val, uuid=uuid)
-            self.cnt_index[combo] = row
-            self.cnt_rows_by_kid.setdefault(kid, []).append(row)
-            self.keys.cnt_sum[kid] += val
-        else:
-            v0, t0 = int(self.cnt.val[row]), int(self.cnt.uuid[row])
-            v1, t1 = S.merge_counter_slot(v0, t0, val, uuid)
-            self.cnt.val[row], self.cnt.uuid[row] = v1, t1
+    def recompute_counter_sums(self) -> None:
+        """Vectorized re-derivation of every key's sum cache (used by the
+        batched engines after bulk slot merges)."""
+        n = self.cnt.n
+        sums = np.zeros(self.keys.n, dtype=_I64)
+        if n:
+            np.add.at(sums, self.cnt.kid[:n],
+                      self.cnt.val[:n] - self.cnt.base[:n])
+        self.keys.cnt_sum[: self.keys.n] = sums
+
+    def counter_merge_slot(self, kid: int, node: int, total: int, uuid: int,
+                           base: int, base_t: int) -> None:
+        """State-merge of one foreign slot (CPU merge engine): both LWW
+        pairs merge independently (max-total on exact uuid ties)."""
+        row = self._cnt_row(kid, node)
+        v0, t0 = int(self.cnt.val[row]), int(self.cnt.uuid[row])
+        v1, t1 = S.merge_counter_slot(v0, t0, total, uuid)
+        if (v1, t1) != (v0, t0):
             self.keys.cnt_sum[kid] += v1 - v0
+            self.cnt.val[row], self.cnt.uuid[row] = v1, t1
+        b0, bt0 = int(self.cnt.base[row]), int(self.cnt.base_t[row])
+        b1, bt1 = S.merge_counter_slot(b0, bt0, base, base_t)
+        if (b1, bt1) != (b0, bt0):
+            self.keys.cnt_sum[kid] -= b1 - b0
+            self.cnt.base[row], self.cnt.base_t[row] = b1, bt1
 
     # ------------------------------------------------------------- registers
 
@@ -247,42 +298,47 @@ class KeySpace:
 
     def elem_add(self, kid: int, member: bytes, val: Optional[bytes],
                  uuid: int, node: int) -> bool:
-        """SADD member / HSET field.  Rejects stale writes (op-level rule:
-        reference lwwhash.rs:87-107, with (t, node) tie-break)."""
+        """SADD member / HSET field: pure pointwise add-side LWW write, so
+        the op path and the state-merge path (elem_merge) compute the same
+        function.  (The reference instead DROPS adds older than the del time
+        or the stored add time — lwwhash.rs:87-107 — which leaves replicas
+        that saw different op interleavings with different hidden state.)
+        Returns True iff the member became visible by this op."""
         ems = self.elems.setdefault(kid, {})
         row = ems.get(member, -1)
         if row < 0:
             row = self._el_new_row(kid, member, val, uuid, node)
             ems[member] = row
-            return True
-        if int(self.el.del_t[row]) > uuid:
-            return False
+            return True  # del_t == 0 → visible
         at, an = int(self.el.add_t[row]), int(self.el.add_node[row])
-        if S.lww_wins(at, an, uuid, node):
-            return False
-        was_alive = S.elem_alive(at, int(self.el.del_t[row]))
-        self.el.add_t[row], self.el.add_node[row] = uuid, node
-        self.el_val[row] = val
-        return not was_alive
+        dt = int(self.el.del_t[row])
+        was_alive = S.elem_alive(at, dt)
+        if not S.lww_wins(at, an, uuid, node):
+            self.el.add_t[row], self.el.add_node[row] = uuid, node
+            self.el_val[row] = val
+            at = uuid
+        return S.elem_alive(at, dt) and not was_alive
 
     def elem_rem(self, kid: int, member: bytes, uuid: int) -> bool:
-        """SREM member / HDEL field (reference lwwhash.rs:109-128)."""
+        """SREM member / HDEL field: pure pointwise del-side max (see
+        elem_add; reference lwwhash.rs:109-128 drops dels older than the
+        stored add time).  Returns True iff the member became invisible."""
         ems = self.elems.setdefault(kid, {})
         row = ems.get(member, -1)
         if row < 0:
+            # record the tombstone, but an absent member was not "removed"
             row = self._el_new_row(kid, member, None, 0, 0)
             self.el.del_t[row] = uuid
             ems[member] = row
             self._enqueue_garbage(uuid, self.key_bytes[kid], member)
-            return True
-        at = int(self.el.add_t[row])
-        if at > uuid:
             return False
-        was_alive = S.elem_alive(at, int(self.el.del_t[row]))
-        if uuid > int(self.el.del_t[row]):
-            self.el.del_t[row] = uuid
-        self._enqueue_garbage(uuid, self.key_bytes[kid], member)
-        return was_alive
+        at, dt = int(self.el.add_t[row]), int(self.el.del_t[row])
+        was_alive = S.elem_alive(at, dt)
+        if uuid > dt:
+            self.el.del_t[row] = dt = uuid
+            if at < dt:
+                self._enqueue_garbage(dt, self.key_bytes[kid], member)
+        return was_alive and not S.elem_alive(at, dt)
 
     def elem_get(self, kid: int, member: bytes) -> Optional[bytes]:
         """Live dict-field value or None."""

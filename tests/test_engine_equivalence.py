@@ -129,6 +129,8 @@ def test_duplicate_slot_rows_in_one_batch():
     b.cnt_node = np.array([7, 7], np.int64)
     b.cnt_val = np.array([50, 3], np.int64)
     b.cnt_uuid = np.array([9 << 22, 2 << 22], np.int64)
+    b.cnt_base = np.zeros(2, np.int64)
+    b.cnt_base_t = np.full(2, KeySpace.NEUTRAL_T, np.int64)
     assert not b.rows_unique_per_slot
 
     for eng in (CpuMergeEngine(), TpuMergeEngine()):
@@ -159,6 +161,8 @@ def test_duplicate_keys_in_one_batch():
     b.cnt_node = np.array([1, 2], np.int64)
     b.cnt_val = np.array([5, 10], np.int64)
     b.cnt_uuid = np.array([2 << 22, 3 << 22], np.int64)
+    b.cnt_base = np.zeros(2, np.int64)
+    b.cnt_base_t = np.full(2, KeySpace.NEUTRAL_T, np.int64)
 
     for eng in (CpuMergeEngine(), TpuMergeEngine()):
         ks = KeySpace()

@@ -12,32 +12,46 @@ def t(ms, seq=0):
 class TestCounter:
     def test_change_and_sum(self):
         ks = KeySpace()
+        NT = KeySpace.NEUTRAL_T
         kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
-        assert ks.counter_change(kid, 1, 1, t(2)) == 1
-        assert ks.counter_change(kid, 1, 1, t(3)) == 2
-        assert ks.counter_change(kid, 2, -1, t(3)) == 1
-        assert sorted(ks.counter_slots(kid)) == [(1, 2, t(3)), (2, -1, t(3))]
+        assert ks.counter_change(kid, 1, 1, t(2)) == (1, 1)
+        assert ks.counter_change(kid, 1, 1, t(3)) == (2, 2)
+        assert ks.counter_change(kid, 2, -1, t(3)) == (1, -1)
+        assert sorted(ks.counter_slots(kid)) == [
+            (1, 2, t(3), 0, NT), (2, -1, t(3), 0, NT)]
 
     def test_stale_change_ignored(self):
         # fixed semantics: stored slot uuid advances, so an older uuid is stale
         ks = KeySpace()
         kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
         ks.counter_change(kid, 1, 1, t(5))
-        assert ks.counter_change(kid, 1, 100, t(4)) == 1  # ignored
-        assert ks.counter_change(kid, 1, 1, t(6)) == 2
+        assert ks.counter_change(kid, 1, 100, t(4))[0] == 1  # ignored
+        assert ks.counter_change(kid, 1, 1, t(6))[0] == 2
 
     def test_merge_slot_lww(self):
+        NT = KeySpace.NEUTRAL_T
         ks = KeySpace()
         kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
         ks.counter_change(kid, 1, 5, t(5))
-        ks.counter_merge_slot(kid, 1, 9, t(4))   # older: ignored
+        ks.counter_merge_slot(kid, 1, 9, t(4), 0, NT)   # older: ignored
         assert ks.counter_sum(kid) == 5
-        ks.counter_merge_slot(kid, 1, 9, t(6))   # newer: replaces
+        ks.counter_merge_slot(kid, 1, 9, t(6), 0, NT)   # newer: replaces
         assert ks.counter_sum(kid) == 9
-        ks.counter_merge_slot(kid, 1, 7, t(6))   # tie: max value
+        ks.counter_merge_slot(kid, 1, 7, t(6), 0, NT)   # tie: max value
         assert ks.counter_sum(kid) == 9
-        ks.counter_merge_slot(kid, 2, 3, t(2))   # new node
+        ks.counter_merge_slot(kid, 2, 3, t(2), 0, NT)   # new node
         assert ks.counter_sum(kid) == 12
+
+    def test_delete_base_subtracts(self):
+        ks = KeySpace()
+        kid, _ = ks.get_or_create(b"c", ENC_COUNTER, t(1))
+        ks.counter_change(kid, 1, 3, t(2))
+        ks.counter_set_base(kid, 1, 3, t(5))   # delete observed total 3
+        assert ks.counter_sum(kid) == 0
+        ks.counter_change(kid, 1, 1, t(6))     # revive: counts from 0
+        assert ks.counter_sum(kid) == 1
+        ks.counter_set_base(kid, 1, 2, t(4))   # older delete: ignored
+        assert ks.counter_sum(kid) == 1
 
 
 class TestRegister:
