@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched CRDT snapshot-merge throughput.
+
+Scenario (BASELINE.json north-star): a node catches up by merging R replica
+snapshots of an N-key mixed keyspace (PN-counters, LWW registers, ORSets)
+into an empty local store — the bulk path the reference walks one key at a
+time via `DB::merge_entry` → `Object::merge` (reference src/db.rs:31-43,
+src/object.rs:63-83).
+
+Prints ONE JSON line:
+  {"metric": "snapshot_merge_keys_per_sec", "value": <TPU-engine keys/sec>,
+   "unit": "keys/sec", "vs_baseline": <speedup over the CPU MergeEngine>}
+
+Sizing knobs (env): CONSTDB_BENCH_KEYS (default 1_000_000),
+CONSTDB_BENCH_REPLICAS (default 8), CONSTDB_BENCH_CPU_KEYS (default 100_000).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from constdb_tpu.crdt import semantics as S
+from constdb_tpu.engine.base import ColumnarBatch
+from constdb_tpu.engine.cpu import CpuMergeEngine
+from constdb_tpu.store.keyspace import KeySpace
+from constdb_tpu.utils.hlc import SEQ_BITS
+
+_I64 = np.int64
+MS0 = 1_700_000_000_000  # fixed epoch so uuids look like real HLC values
+
+
+def _uuids(rng, n, span_ms=600_000):
+    return ((MS0 + rng.integers(0, span_ms, n)) << SEQ_BITS) | rng.integers(
+        0, 1 << 10, n)
+
+
+def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
+                  members_per_set: int = 4):
+    """R snapshot batches over one mixed N-key keyspace.
+
+    40% counters / 30% registers / 30% sets.  Immutable columns (key bytes,
+    enc, member bytes) are built once and shared across batches — replica
+    snapshots of the same keyspace really do share this data.
+    """
+    rng = np.random.default_rng(seed)
+    keys = [b"k%010d" % i for i in range(n_keys)]
+    enc = np.empty(n_keys, dtype=np.int8)
+    n_cnt = int(n_keys * 0.4)
+    n_reg = int(n_keys * 0.3)
+    n_set = n_keys - n_cnt - n_reg
+    enc[:n_cnt] = S.ENC_COUNTER
+    enc[n_cnt:n_cnt + n_reg] = S.ENC_BYTES
+    enc[n_cnt + n_reg:] = S.ENC_SET
+
+    reg_pool = [b"v%06d" % i for i in range(1024)]
+    reg_idx = rng.integers(0, len(reg_pool), n_reg)
+    member_pool = [b"m%04d" % i for i in range(4096)]
+
+    set_ki = np.repeat(np.arange(n_cnt + n_reg, n_keys, dtype=_I64),
+                       members_per_set)
+    member_idx = rng.integers(0, len(member_pool), len(set_ki))
+    el_member = [member_pool[i] for i in member_idx]
+    el_val = [None] * len(set_ki)
+
+    batches = []
+    for r in range(n_replicas):
+        b = ColumnarBatch()
+        b.rows_unique_per_slot = True
+        b.keys = keys
+        b.key_enc = enc
+        b.key_ct = _uuids(rng, n_keys)
+        b.key_mt = b.key_ct + (rng.integers(0, 1000, n_keys) << SEQ_BITS)
+        # ~2% of keys tombstoned later than their create time
+        dt = np.where(rng.random(n_keys) < 0.02,
+                      b.key_mt + (1 << SEQ_BITS), 0)
+        b.key_dt = dt.astype(_I64)
+        b.key_expire = np.zeros(n_keys, dtype=_I64)
+
+        b.reg_val = [None] * n_cnt + [reg_pool[i] for i in reg_idx] + \
+                    [None] * n_set
+        b.reg_t = np.zeros(n_keys, dtype=_I64)
+        b.reg_t[n_cnt:n_cnt + n_reg] = _uuids(rng, n_reg)
+        b.reg_node = np.zeros(n_keys, dtype=_I64)
+        b.reg_node[n_cnt:n_cnt + n_reg] = r + 1
+
+        # each replica snapshot carries that replica's own counter slot
+        b.cnt_ki = np.arange(n_cnt, dtype=_I64)
+        b.cnt_node = np.full(n_cnt, r + 1, dtype=_I64)
+        b.cnt_val = rng.integers(-1000, 1000, n_cnt).astype(_I64)
+        b.cnt_uuid = _uuids(rng, n_cnt)
+        b.cnt_base = np.zeros(n_cnt, dtype=_I64)
+        b.cnt_base_t = np.full(n_cnt, S.NEUTRAL_T, dtype=_I64)
+
+        b.el_ki = set_ki
+        b.el_member = el_member
+        b.el_val = el_val
+        b.el_add_t = _uuids(rng, len(set_ki))
+        b.el_add_node = np.full(len(set_ki), r + 1, dtype=_I64)
+        b.el_del_t = np.where(rng.random(len(set_ki)) < 0.1,
+                              _uuids(rng, len(set_ki)), 0).astype(_I64)
+        batches.append(b)
+    return batches
+
+
+def time_engine(engine, batches, repeats: int = 2) -> float:
+    """Best wall-time over `repeats` full merges into a fresh empty store."""
+    best = float("inf")
+    for _ in range(repeats):
+        store = KeySpace()
+        t0 = time.perf_counter()
+        if hasattr(engine, "merge_many"):
+            engine.merge_many(store, batches)
+        else:
+            for b in batches:
+                engine.merge(store, b)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    n_keys = int(os.environ.get("CONSTDB_BENCH_KEYS", 1_000_000))
+    n_rep = int(os.environ.get("CONSTDB_BENCH_REPLICAS", 8))
+    n_cpu = min(n_keys, int(os.environ.get("CONSTDB_BENCH_CPU_KEYS", 100_000)))
+
+    print(f"[bench] workload: {n_keys} keys x {n_rep} replicas "
+          f"(cpu baseline on {n_cpu} keys)", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    cpu_batches = make_workload(n_cpu, n_rep, seed=7)
+    cpu_t = time_engine(CpuMergeEngine(), cpu_batches, repeats=1)
+    cpu_rate = n_cpu / cpu_t
+    print(f"[bench] cpu engine: {cpu_t:.3f}s on {n_cpu} keys "
+          f"= {cpu_rate:,.0f} keys/s (workload gen+run "
+          f"{time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+    import jax
+    print(f"[bench] jax backend: {jax.default_backend()} "
+          f"devices={jax.devices()}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    batches = make_workload(n_keys, n_rep, seed=7)
+    print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    eng = TpuMergeEngine()
+    tpu_t = time_engine(eng, batches, repeats=2)
+    rate = n_keys / tpu_t
+    print(f"[bench] tpu engine: {tpu_t:.3f}s on {n_keys} keys "
+          f"= {rate:,.0f} keys/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "snapshot_merge_keys_per_sec",
+        "value": round(rate, 1),
+        "unit": "keys/sec",
+        "vs_baseline": round(rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
