@@ -1,0 +1,7 @@
+"""Persistence: columnar snapshot format + background dump orchestration."""
+
+from .snapshot import (NodeMeta, ReplicaRecord, SnapshotLoader, SnapshotWriter,
+                       dump_keyspace, load_snapshot)
+
+__all__ = ["NodeMeta", "ReplicaRecord", "SnapshotLoader", "SnapshotWriter",
+           "dump_keyspace", "load_snapshot"]
