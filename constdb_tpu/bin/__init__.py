@@ -1,0 +1,1 @@
+"""Executable entry points (reference bin/: server.rs, cli.rs, test.rs)."""

@@ -1,0 +1,94 @@
+"""constdb-tpu-cli: interactive RESP client.
+
+Capability parity with the reference CLI (reference bin/cli.rs:12-104):
+line → words → command, pretty-printed reply, readline history, `exit`.
+
+Usage: python -m constdb_tpu.bin.cli [-H host] [-p port]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+import sys
+
+from ..resp.codec import RespParser, encode_msg
+from ..resp.message import Arr, Bulk, Err, Int, Msg, Nil, Simple
+
+try:
+    import readline  # noqa: F401  (history + line editing)
+except ImportError:
+    pass
+
+
+def render(m: Msg, indent: int = 0) -> str:
+    pad = "  " * indent
+    if isinstance(m, Nil):
+        return pad + "(nil)"
+    if isinstance(m, Simple):
+        return pad + m.val.decode("utf-8", "replace")
+    if isinstance(m, Err):
+        return pad + "(error) " + m.val.decode("utf-8", "replace")
+    if isinstance(m, Int):
+        return pad + f"(integer) {m.val}"
+    if isinstance(m, Bulk):
+        return pad + f'"{m.val.decode("utf-8", "replace")}"'
+    if isinstance(m, Arr):
+        if not m.items:
+            return pad + "(empty array)"
+        return "\n".join(f"{pad}{i + 1}) {render(x, 0)}"
+                         if not isinstance(x, Arr)
+                         else f"{pad}{i + 1})\n{render(x, indent + 1)}"
+                         for i, x in enumerate(m.items))
+    return pad + repr(m)
+
+
+async def repl(host: str, port: int) -> None:
+    reader, writer = await asyncio.open_connection(host, port)
+    parser = RespParser()
+    prompt = f"{host}:{port}> "
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, input, prompt)
+        except (EOFError, KeyboardInterrupt):
+            break
+        line = line.strip()
+        if not line:
+            continue
+        if line.lower() in ("exit", "quit"):
+            break
+        try:
+            words = shlex.split(line)
+        except ValueError as e:
+            print(f"(parse error) {e}")
+            continue
+        writer.write(encode_msg(Arr([Bulk(w.encode()) for w in words])))
+        await writer.drain()
+        while (msg := parser.next_msg()) is None:
+            data = await reader.read(1 << 16)
+            if not data:
+                print("(connection closed)")
+                return
+            parser.feed(data)
+        print(render(msg))
+    writer.close()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="constdb-tpu-cli")
+    ap.add_argument("-H", "--host", default="127.0.0.1")
+    ap.add_argument("-p", "--port", type=int, default=9001)
+    ns = ap.parse_args(argv)
+    try:
+        asyncio.run(repl(ns.host, ns.port))
+    except (KeyboardInterrupt, ConnectionError) as e:
+        if isinstance(e, ConnectionError):
+            print(f"could not connect to {ns.host}:{ns.port}: {e}",
+                  file=sys.stderr)
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,119 @@
+"""constdb-tpu-server: run one node.
+
+Capability parity with the reference server binary (reference bin/server.rs
+→ lib.rs `run_server`): config, logging, bind, cron, serve until signalled.
+Background snapshot dumps replace the reference's fork()-COW scheme with the
+capture-on-loop / encode-on-thread pipeline (persist/snapshot.py), and the
+snapshot is reloaded on boot — the reference restarts empty (SURVEY.md §5.4).
+
+Usage: python -m constdb_tpu.bin.server [config.toml] [--port N] ...
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+import sys
+
+from ..conf import Config, build_engine, load_config
+from ..persist.snapshot import NodeMeta, dump_keyspace
+from ..server.io import ServerApp, start_node
+from ..server.node import Node
+
+log = logging.getLogger("constdb_tpu.server")
+
+
+def setup_logging(cfg: Config) -> None:
+    level = getattr(logging, cfg.log_level.upper(), logging.INFO)
+    fmt = "%(asctime)s %(levelname)s %(filename)s:%(lineno)d - %(message)s"
+    if cfg.log and cfg.log != "console":
+        logging.basicConfig(level=level, format=fmt, filename=cfg.log)
+    else:
+        logging.basicConfig(level=level, format=fmt)
+
+
+async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
+    """Periodic background dump (fork-free; see persist/snapshot.py)."""
+    from ..engine.base import batch_from_keyspace
+    from ..persist.snapshot import SnapshotWriter, batch_chunks
+    import io as _io
+    import os
+
+    while True:
+        await asyncio.sleep(cfg.snapshot_interval)
+        node = app.node
+        capture = batch_from_keyspace(node.ks)  # consistent: on the loop
+        meta = NodeMeta(node_id=node.node_id, alias=node.alias,
+                        addr=app.advertised_addr,
+                        repl_last_uuid=node.repl_log.last_uuid)
+        records = node.replicas.records()
+        path = cfg.snapshot_path
+
+        def write() -> None:
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "wb") as f:
+                w = SnapshotWriter(f)
+                w.write_node(meta)
+                w.write_replicas(records)
+                for chunk in batch_chunks(capture, cfg.snapshot_chunk_keys):
+                    w.write_chunk(chunk)
+                w.finish()
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+        try:
+            await asyncio.to_thread(write)
+            log.info("background snapshot written to %s", path)
+        except OSError as e:
+            log.error("background snapshot failed: %s", e)
+
+
+async def amain(cfg: Config) -> None:
+    node = Node(node_id=cfg.node_id, alias=cfg.node_alias,
+                engine=build_engine(cfg.engine),
+                repl_log_cap=cfg.repl_log_cap)
+    app = await start_node(
+        node, host=cfg.ip, port=cfg.port,
+        advertised_addr=cfg.addr, work_dir=cfg.work_dir,
+        heartbeat=float(cfg.replica_heartbeat_frequency),
+        reconnect_delay=float(cfg.replica_gossip_frequency) / 3.0,
+        snapshot_chunk_keys=cfg.snapshot_chunk_keys,
+        snapshot_path=cfg.snapshot_path)
+    log.info("constdb-tpu node %d (engine=%s) serving on %s",
+             node.node_id, node.engine.name, app.advertised_addr)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    crons = []
+    if cfg.snapshot_interval > 0 and cfg.snapshot_path:
+        crons.append(asyncio.create_task(snapshot_cron(app, cfg)))
+    await stop.wait()
+    for t in crons:
+        t.cancel()
+    if cfg.snapshot_path:
+        # final synchronous dump so a clean restart resumes warm
+        dump_keyspace(cfg.snapshot_path, node.ks,
+                      NodeMeta(node_id=node.node_id, alias=node.alias,
+                               addr=app.advertised_addr,
+                               repl_last_uuid=node.repl_log.last_uuid),
+                      node.replicas.records(),
+                      chunk_keys=cfg.snapshot_chunk_keys)
+        log.info("final snapshot written to %s", cfg.snapshot_path)
+    await app.close()
+
+
+def main(argv=None) -> None:
+    cfg = load_config(argv)
+    setup_logging(cfg)
+    try:
+        asyncio.run(amain(cfg))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,180 @@
+"""constdb-tpu-test: black-box convergence harness against LIVE servers.
+
+Capability parity with the reference's integration binary (reference
+bin/test.rs:16-437, SURVEY.md §4): connect to ≥3 running nodes as a client,
+form the mesh with MEET, then drive randomized concurrent workloads with a
+local oracle model and assert convergence.  Unlike the reference it polls
+for convergence (DESC-based state compare) instead of sleeping fixed
+durations.
+
+Usage:
+  python -m constdb_tpu.bin.server --port 9001 &
+  python -m constdb_tpu.bin.server --port 9002 &
+  python -m constdb_tpu.bin.server --port 9003 &
+  python -m constdb_tpu.bin.test --replicas 127.0.0.1:9001 \
+      127.0.0.1:9002 127.0.0.1:9003
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import random
+import sys
+import time
+
+from ..resp.codec import RespParser, encode_msg
+from ..resp.message import Arr, Bulk, Err, Int, Msg, Nil
+
+
+class Conn:
+    def __init__(self) -> None:
+        self.reader = None
+        self.writer = None
+        self.parser = RespParser()
+
+    async def connect(self, addr: str) -> "Conn":
+        host, port = addr.rsplit(":", 1)
+        self.reader, self.writer = await asyncio.open_connection(host, int(port))
+        return self
+
+    async def cmd(self, *parts) -> Msg:
+        items = [Bulk(p if isinstance(p, bytes) else str(p).encode())
+                 for p in parts]
+        self.writer.write(encode_msg(Arr(items)))
+        await self.writer.drain()
+        while (m := self.parser.next_msg()) is None:
+            data = await self.reader.read(1 << 16)
+            if not data:
+                raise ConnectionError("EOF")
+            self.parser.feed(data)
+        if isinstance(m, Err):
+            raise RuntimeError(m.val.decode())
+        return m
+
+
+async def poll_equal(conns, probe, timeout: float = 30.0):
+    """Wait until `probe(conn)` returns the same value on every node."""
+    deadline = time.monotonic() + timeout
+    while True:
+        vals = [await probe(c) for c in conns]
+        if all(repr(v) == repr(vals[0]) for v in vals[1:]):
+            return vals[0]
+        if time.monotonic() > deadline:
+            raise AssertionError(f"no convergence: {vals}")
+        await asyncio.sleep(0.25)
+
+
+async def test_counters(conns, rng, n_ops):
+    oracle = 0
+    for _ in range(n_ops):
+        c = rng.choice(conns)
+        if rng.random() < 0.5:
+            await c.cmd("incr", "t:cnt")
+            oracle += 1
+        else:
+            await c.cmd("decr", "t:cnt")
+            oracle -= 1
+    got = await poll_equal(conns, lambda c: c.cmd("get", "t:cnt"))
+    assert got == Int(oracle), f"counter oracle {oracle} != {got}"
+    print(f"  counters: {n_ops} ops -> {oracle} on all nodes ✓")
+
+
+async def test_bytes(conns, rng, n_ops):
+    keys = [f"t:b{i}" for i in range(5)]
+    for _ in range(n_ops):
+        c = rng.choice(conns)
+        k = rng.choice(keys)
+        if rng.random() < 0.85:
+            await c.cmd("set", k, f"v{rng.randrange(10_000)}")
+        else:
+            await c.cmd("del", k)
+        await asyncio.sleep(0.002)  # ms-spaced: program order == LWW order
+    for k in keys:
+        await poll_equal(conns, lambda c, k=k: c.cmd("get", k))
+    print(f"  bytes: {n_ops} ops converged on {len(keys)} keys ✓")
+
+
+async def test_set(conns, rng, n_ops):
+    members = [f"m{i}" for i in range(16)]
+    oracle: set[bytes] = set()
+    for _ in range(n_ops):
+        c = rng.choice(conns)
+        m = rng.choice(members)
+        if rng.random() < 0.65:
+            await c.cmd("sadd", "t:s", m)
+            oracle.add(m.encode())
+        else:
+            await c.cmd("srem", "t:s", m)
+            oracle.discard(m.encode())
+        await asyncio.sleep(0.002)
+
+    async def probe(c):
+        got = await c.cmd("smembers", "t:s")
+        return sorted(i.val for i in got.items) if isinstance(got, Arr) else got
+
+    got = await poll_equal(conns, probe)
+    assert got == sorted(oracle), f"set oracle mismatch: {got} != {sorted(oracle)}"
+    print(f"  set: {n_ops} ops, {len(oracle)} members on all nodes ✓")
+
+
+async def test_dict(conns, rng, n_ops):
+    fields = [f"f{i}" for i in range(12)]
+    oracle: dict[bytes, bytes] = {}
+    for _ in range(n_ops):
+        c = rng.choice(conns)
+        f = rng.choice(fields)
+        if rng.random() < 0.7:
+            v = f"v{rng.randrange(10_000)}"
+            await c.cmd("hset", "t:h", f, v)
+            oracle[f.encode()] = v.encode()
+        else:
+            await c.cmd("hdel", "t:h", f)
+            oracle.pop(f.encode(), None)
+        await asyncio.sleep(0.002)
+
+    async def probe(c):
+        got = await c.cmd("hgetall", "t:h")
+        if not isinstance(got, Arr):
+            return got
+        return sorted((kv.items[0].val, kv.items[1].val) for kv in got.items)
+
+    got = await poll_equal(conns, probe)
+    assert got == sorted(oracle.items()), "dict oracle mismatch"
+    print(f"  dict: {n_ops} ops, {len(oracle)} fields on all nodes ✓")
+
+
+async def amain(addrs: list[str], n_ops: int, seed: int) -> None:
+    rng = random.Random(seed)
+    conns = [await Conn().connect(a) for a in addrs]
+    print(f"connected to {len(conns)} nodes")
+
+    # topology: r1 meets r2; r3.. meet r2 (transitive join closes the mesh)
+    await conns[0].cmd("meet", addrs[1])
+    for c in conns[2:]:
+        await c.cmd("meet", addrs[1])
+    await poll_equal(conns, lambda c: c.cmd("get", "__mesh_probe"))
+    print("mesh formed")
+
+    await test_counters(conns, rng, n_ops)
+    await test_bytes(conns, rng, n_ops)
+    await test_set(conns, rng, n_ops)
+    await test_dict(conns, rng, n_ops)
+    print("ALL TESTS PASSED")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="constdb-tpu-test")
+    ap.add_argument("--replicas", nargs="+", required=True,
+                    help="host:port of ≥2 running nodes")
+    ap.add_argument("--ops", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=42)
+    ns = ap.parse_args(argv)
+    if len(ns.replicas) < 2:
+        print("need at least 2 replicas", file=sys.stderr)
+        sys.exit(2)
+    asyncio.run(amain(ns.replicas, ns.ops, ns.seed))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,88 @@
+"""Configuration: TOML file + command-line overrides.
+
+Capability parity with the reference's config system (reference
+src/conf.rs:10-88 `OriginConfig`→`Config` with defaults, src/server.yml clap
+args): a TOML file selected by `--config` plus flag overrides, frozen into a
+`Config` dataclass at boot.  Fields keep the reference's names where the
+concept carries over; TPU-specific fields are new.
+
+Unlike the reference, `replica_heartbeat_frequency` is actually WIRED to the
+pusher heartbeat (the reference parses-but-ignores it — conf.rs:81-82,
+SURVEY.md §"Known reference defects").
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import tomllib
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    # reference fields (src/conf.rs:63-88)
+    daemon: bool = False          # accepted; daemonization itself is left to
+    node_id: int = 0              # the process supervisor (systemd/k8s)
+    node_alias: str = ""
+    ip: str = "127.0.0.1"
+    port: int = 9001
+    threads: int = 1              # IO concurrency is asyncio; kept for parity
+    log: str = "console"          # "console" | path to a log file
+    work_dir: str = "./"
+    tcp_backlog: int = 1024
+    replica_heartbeat_frequency: int = 4   # seconds (wired, unlike reference)
+    replica_gossip_frequency: int = 15     # seconds between reconnect dials
+    # new (TPU build)
+    addr: str = ""                # advertised address, default ip:port
+    engine: str = "auto"          # "auto" | "tpu" | "cpu"
+    snapshot_path: str = ""       # load on boot + background dump target
+    snapshot_interval: int = 0    # seconds between background dumps (0 = off)
+    snapshot_chunk_keys: int = 1 << 16
+    repl_log_cap: int = 1_024_000  # reference src/server.rs:81
+    log_level: str = "info"
+
+
+def load_config(argv: list[str] | None = None) -> Config:
+    """`constdb-tpu-server [config.toml] [-h HOST] [-p PORT] ...`
+    (reference bin/server.rs + server.yml arg spec)."""
+    ap = argparse.ArgumentParser(prog="constdb-tpu-server",
+                                 description="constdb-tpu node")
+    ap.add_argument("config", nargs="?", help="TOML config file")
+    ap.add_argument("--host", "-H", dest="ip")
+    ap.add_argument("--port", "-p", type=int)
+    ap.add_argument("--node-id", type=int, dest="node_id")
+    ap.add_argument("--alias", dest="node_alias")
+    ap.add_argument("--addr", help="advertised address (host:port)")
+    ap.add_argument("--work-dir", dest="work_dir")
+    ap.add_argument("--engine", choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--snapshot", dest="snapshot_path")
+    ap.add_argument("--snapshot-interval", type=int, dest="snapshot_interval")
+    ap.add_argument("--log-level", dest="log_level")
+    ns = ap.parse_args(argv)
+
+    cfg = Config()
+    if ns.config:
+        with open(ns.config, "rb") as f:
+            data = tomllib.load(f)
+        for field in dataclasses.fields(Config):
+            if field.name in data:
+                setattr(cfg, field.name, data[field.name])
+    for field in dataclasses.fields(Config):
+        v = getattr(ns, field.name, None)
+        if v is not None:
+            setattr(cfg, field.name, v)
+    return cfg
+
+
+def build_engine(kind: str):
+    """'auto' prefers the TPU engine when a device backend initializes."""
+    if kind in ("auto", "tpu"):
+        try:
+            from .engine.tpu import TpuMergeEngine
+            return TpuMergeEngine()
+        except Exception:
+            if kind == "tpu":
+                raise
+    from .engine.cpu import CpuMergeEngine
+    return CpuMergeEngine()

@@ -2,14 +2,20 @@
 
 Two device strategies, picked per CRDT family by batch density:
 
-  * dense (the fast path, ops/dense.py): the host pad-aligns every batch's
-    rows into the store's dense row space — [R+1, S] tensors with the local
-    state as row 0 — and the device reduces over the R axis elementwise.
-    No scatter (XLA TPU scatter serializes colliding updates), one transfer
-    each way.  Chosen when the batches cover a meaningful fraction of the
-    store (snapshot ingest, replica catch-up).
+  * bulk (the fast path, ops/bulk.py): each batch ships as COMPACT rows
+    (int32 slot ids + value columns) and folds into full per-slot device
+    state, one gather→merge→scatter kernel call per batch.  State is
+    donated between calls (never re-uploaded), uploads are async (batch
+    b+1 transfers while b merges), and when every touched slot is brand
+    new — snapshot ingest into an empty region — the initial state is
+    materialized ON device and only the merged block downloads.
   * scatter (ops/segment.py): touched-slot gather + scatter-max kernels.
-    Chosen for sparse merges (steady-state replication trickle).
+    Chosen for sparse merges (steady-state replication trickle) where
+    uploading the full state would dwarf the rows.
+
+Batches whose rows are NOT unique per slot (raw op streams) always take the
+scatter path — its reductions tolerate intra-batch collisions; the bulk
+kernels require `rows_unique_per_slot` (one scatter per slot per call).
 
 Host staging is bulk/vectorized (list-comp index probes, block appends,
 `dict.update`); the only remaining per-row Python is new element-row index
@@ -26,7 +32,7 @@ import logging
 import numpy as np
 
 from ..crdt import semantics as S
-from ..ops import dense as D
+from ..ops import bulk as B
 from ..ops import segment as K
 from ..store.keyspace import KeySpace
 from .base import ColumnarBatch, MergeStats
@@ -34,22 +40,23 @@ from .base import ColumnarBatch, MergeStats
 log = logging.getLogger(__name__)
 
 _I64 = np.int64
+_I32 = np.int32
 _RANK_BITS = KeySpace.NODE_RANK_BITS
 
 
 def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    arr = np.asarray(arr)
     if len(arr) == size:
-        return np.asarray(arr)
-    out = np.full(size, fill, dtype=np.asarray(arr).dtype)
+        return arr
+    out = np.full((size,) + arr.shape[1:], fill, dtype=arr.dtype)
     out[: len(arr)] = arr
     return out
 
 
 class TpuMergeEngine:
     name = "tpu"
-    # dense when staged rows cover >= 1/DENSE_FRACTION of the slot space
-    DENSE_FRACTION = 8
-    MEM_LIMIT = 6 << 30  # bytes of [R, S] staging we allow on device
+    # bulk when staged rows cover >= 1/BULK_FRACTION of the slot region
+    BULK_FRACTION = 8
 
     def __init__(self) -> None:
         import jax  # ensure a backend exists before we advertise ourselves
@@ -67,9 +74,10 @@ class TpuMergeEngine:
         are associative + commutative, so all batches merge in one device
         pass per CRDT family."""
         st = MergeStats()
-        # the dense path places each batch row once per slot, which is only
-        # a merge if slots are unique within every batch
-        self._dense_ok = all(b.rows_unique_per_slot for b in batches)
+        # the bulk path scatters each slot once per batch, which is only a
+        # merge if slots are unique within every batch
+        self._unique_ok = all(b.rows_unique_per_slot for b in batches)
+        self._n0_keys = store.keys.n
         resolved = [(b, self._resolve_keys(store, b, st)) for b in batches]
         self._merge_envelopes(store, resolved)
         self._merge_registers(store, resolved)
@@ -129,29 +137,42 @@ class TpuMergeEngine:
             kid_of[bad] = -1
         return kid_of
 
-    # ------------------------------------------------- dense/scatter chooser
+    # --------------------------------------------------- bulk-path plumbing
 
-    def _use_dense(self, total_rows: int, n_slots: int, n_batches: int,
-                   n_cols: int) -> bool:
-        if not getattr(self, "_dense_ok", False):
-            return False
-        if total_rows * self.DENSE_FRACTION < n_slots:
-            return False
-        # _dense_stack pads both axes to powers of two — budget the real size
-        mem = K.next_pow2(n_batches + 1) * K.next_pow2(max(n_slots, 1)) * 8 * n_cols
-        return mem <= self.MEM_LIMIT
+    def _use_bulk(self, total_rows: int, region: int) -> bool:
+        return (self._unique_ok and region > 0
+                and total_rows * self.BULK_FRACTION >= region)
 
     @staticmethod
-    def _dense_stack(cur: np.ndarray, staged: list[tuple[np.ndarray, np.ndarray]],
-                     neutral, s_pad: int) -> np.ndarray:
-        """[Rp, Sp] tensor: row 0 = current column, one row per batch with
-        its values placed at its positions, neutral elsewhere."""
-        r_pad = K.next_pow2(len(staged) + 1)
-        out = np.full((r_pad, s_pad), neutral, dtype=_I64)
-        out[0, : len(cur)] = cur
-        for r, (pos, col) in enumerate(staged):
-            out[r + 1, pos] = col
-        return out
+    def _bulk_region(staged_rows: list[np.ndarray], n0: int, n: int
+                     ) -> tuple[int, int, bool]:
+        """-> (base, size, all_new): the slot region the kernels operate on.
+        When every staged row is brand new (>= n0, the pre-merge table size)
+        only the new block [n0, n) participates — its initial state is
+        neutral and can be materialized on device with zero upload."""
+        lo = min(int(r.min()) for r in staged_rows if len(r))
+        if lo >= n0:
+            return n0, n - n0, True
+        return 0, n, False
+
+    def _upload_batch(self, rows: np.ndarray, base: int, sp: int,
+                      cols: list[tuple[np.ndarray, int]]):
+        """Async-upload one batch: int32 ids (padded with distinct
+        out-of-range slots) + padded value columns."""
+        put = self._jax.device_put
+        n = len(rows)
+        np_ = K.next_pow2(max(n, 1))
+        idx = np.empty(np_, dtype=_I32)
+        idx[:n] = rows - base
+        if np_ > n:
+            idx[n:] = sp + np.arange(np_ - n, dtype=_I32)
+        return [put(idx)] + [put(_pad(c, np_, fill)) for c, fill in cols]
+
+    def _state_up(self, col: np.ndarray, base: int, size: int, sp: int,
+                  fill: int, all_new: bool):
+        if all_new:
+            return B.device_full(sp, fill)
+        return self._jax.device_put(_pad(col[base:base + size], sp, fill))
 
     # ------------------------------------------------------------ envelopes
 
@@ -166,20 +187,29 @@ class TpuMergeEngine:
         if not staged:
             return
         total = sum(len(p) for p, _ in staged)
-        S_ = store.keys.n
-        if self._use_dense(total, S_, len(staged), 4):
-            s_pad = K.next_pow2(S_)
-            cols = np.stack([
-                self._dense_stack(cur, [(p, c[i]) for p, c in staged],
-                                  K.NEUTRAL_T, s_pad)
-                for i, cur in enumerate((store.keys.ct, store.keys.mt,
-                                         store.keys.dt, store.keys.expire))
-            ], axis=-1)  # [Rp, Sp, 4]
-            out = np.asarray(self._jax.device_get(D.dense_max(cols)))
-            store.keys.ct[:] = out[:S_, 0]
-            store.keys.mt[:] = out[:S_, 1]
-            store.keys.dt[:] = out[:S_, 2]
-            store.keys.expire[:] = out[:S_, 3]
+        n = store.keys.n
+        base, size, all_new = self._bulk_region([p for p, _ in staged],
+                                                self._n0_keys, n)
+
+        if self._use_bulk(total, size):
+            sp = K.next_pow2(size)
+            if all_new:
+                state = self._jax.numpy.zeros((sp, 4), dtype=self._jax.numpy.int64)
+            else:
+                cols = np.stack([store.keys.ct[base:n], store.keys.mt[base:n],
+                                 store.keys.dt[base:n],
+                                 store.keys.expire[base:n]], axis=-1)
+                state = self._jax.device_put(_pad(cols, sp, 0))
+            dev = [self._upload_batch(
+                p, base, sp, [(np.stack(c, axis=-1), 0)])
+                for p, c in staged]
+            for idx, c in dev:
+                state = B.bulk_max(state, idx, c)
+            out = np.asarray(self._jax.device_get(state))[:size]
+            store.keys.ct[base:n] = out[:, 0]
+            store.keys.mt[base:n] = out[:, 1]
+            store.keys.dt[base:n] = out[:, 2]
+            store.keys.expire[base:n] = out[:, 3]
             return
         # scatter path over touched slots
         kv = np.concatenate([p for p, _ in staged])
@@ -218,29 +248,28 @@ class TpuMergeEngine:
                                [b.reg_val[i] for i in idx]))
         if not staged:
             return
-        S_ = store.keys.n
         total = sum(len(p) for p, *_ in staged)
-        if self._use_dense(total, S_, len(staged), 2):
-            s_pad = K.next_pow2(S_)
-            t = self._dense_stack(store.keys.rv_t,
-                                  [(p, t) for p, t, _, _ in staged],
-                                  K.NEUTRAL_T, s_pad)
-            n = self._dense_stack(store.keys.rv_node,
-                                  [(p, nn) for p, _, nn, _ in staged],
-                                  K.NEUTRAL_T, s_pad)
-            t_m, n_m, win = (np.asarray(a) for a in
-                             self._jax.device_get(D.dense_merge_lww(t, n)))
-            store.keys.rv_t[:] = t_m[:S_]
-            store.keys.rv_node[:] = n_m[:S_]
+        n = store.keys.n
+        base, size, all_new = self._bulk_region([p for p, *_ in staged],
+                                                self._n0_keys, n)
+
+        if self._use_bulk(total, size):
+            sp = K.next_pow2(size)
+            t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
+            nd = self._state_up(store.keys.rv_node, base, size, sp, 0, all_new)
+            dev = [self._upload_batch(p, base, sp,
+                                      [(bt, K.NEUTRAL_T), (bn, K.NEUTRAL_T)])
+                   for p, bt, bn, _ in staged]
+            wins = []
+            for idx, bt, bn in dev:
+                t, nd, win = B.bulk_lww(t, nd, idx, bt, bn)
+                wins.append(win)
+            store.keys.rv_t[base:n] = np.asarray(t)[:size]
+            store.keys.rv_node[base:n] = np.asarray(nd)[:size]
             reg_val = store.reg_val
-            for r, (pos, _, _, vals) in enumerate(staged):
-                slots_w = np.nonzero(win[:S_] == r + 1)[0]
-                if not len(slots_w):
-                    continue
-                inv = np.full(S_, -1, dtype=_I64)
-                inv[pos] = np.arange(len(pos), dtype=_I64)
-                for s_ in slots_w:
-                    reg_val[int(s_)] = vals[int(inv[s_])]
+            for (pos, _, _, vals), win in zip(staged, wins):
+                for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
+                    reg_val[int(pos[j])] = vals[int(j)]
             return
         # scatter path: registers are LWW slots — reuse the element add-side
         # kernel with a zero del side
@@ -254,7 +283,7 @@ class TpuMergeEngine:
         out = K.merge_elems(
             _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
             _pad(np.concatenate([t for _, t, _, _ in staged]), n_rows, K.NEUTRAL_T),
-            _pad(np.concatenate([n for _, _, n, _ in staged]), n_rows, K.NEUTRAL_T),
+            _pad(np.concatenate([n_ for _, _, n_, _ in staged]), n_rows, K.NEUTRAL_T),
             np.zeros(n_rows, dtype=_I64),
             _pad(store.keys.rv_t[trows], n_slots, 0),
             _pad(store.keys.rv_node[trows], n_slots, 0),
@@ -271,6 +300,7 @@ class TpuMergeEngine:
 
     def _merge_counter_rows(self, store: KeySpace, resolved,
                             st: MergeStats) -> None:
+        n0 = store.cnt.n
         staged = []  # (rows, total, uuid, base, base_t)
         for b, kid_of in resolved:
             if not len(b.cnt_ki):
@@ -291,24 +321,29 @@ class TpuMergeEngine:
                            b.cnt_base[keep], b.cnt_base_t[keep]))
         if not staged:
             return
-        S_ = store.cnt.n
+        n = store.cnt.n
         total = sum(len(r) for r, *_ in staged)
+        base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
 
-        # both slot pairs — (total @ uuid) and (base @ base_t) — are plain
-        # per-slot LWW-with-max-tie merges; run the same kernel twice
-        if self._use_dense(total, S_, len(staged), 4):
-            s_pad = K.next_pow2(S_)
-            for vcol, tcol, vi, ti in (("val", "uuid", 1, 2),
-                                       ("base", "base_t", 3, 4)):
-                vals = self._dense_stack(store.cnt.col(vcol),
-                                         [(s[0], s[vi]) for s in staged], 0, s_pad)
-                ts = self._dense_stack(store.cnt.col(tcol),
-                                       [(s[0], s[ti]) for s in staged],
-                                       K.NEUTRAL_T, s_pad)
-                new_val, new_t = (np.asarray(a)[:S_] for a in
-                                  self._jax.device_get(D.dense_merge_counters(vals, ts)))
-                store.cnt.col(vcol)[:] = new_val
-                store.cnt.col(tcol)[:] = new_t
+        if self._use_bulk(total, size):
+            sp = K.next_pow2(size)
+            val = self._state_up(store.cnt.val, base, size, sp, 0, all_new)
+            uuid = self._state_up(store.cnt.uuid, base, size, sp,
+                                  K.NEUTRAL_T, all_new)
+            cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
+            cbt = self._state_up(store.cnt.base_t, base, size, sp,
+                                 K.NEUTRAL_T, all_new)
+            dev = [self._upload_batch(
+                r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
+                              (bt, K.NEUTRAL_T)])
+                for r, v, u, bb, bt in staged]
+            for idx, v, u, bb, bt in dev:
+                val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
+                                                     idx, v, u, bb, bt)
+            store.cnt.val[base:n] = np.asarray(val)[:size]
+            store.cnt.uuid[base:n] = np.asarray(uuid)[:size]
+            store.cnt.base[base:n] = np.asarray(cb)[:size]
+            store.cnt.base_t[base:n] = np.asarray(cbt)[:size]
             return  # sums re-derived in one pass by merge_many
 
         all_rows = np.concatenate([s[0] for s in staged])
@@ -356,6 +391,8 @@ class TpuMergeEngine:
 
     def _merge_elem_rows(self, store: KeySpace, resolved,
                          st: MergeStats) -> None:
+        n0 = store.el.n
+        free_before = len(store.el_free)
         staged = []  # (rows, at, an, dt, vals, has_vals)
         elems = store.elems
         for b, kid_of in resolved:
@@ -383,34 +420,41 @@ class TpuMergeEngine:
                            any(v is not None for v in vals)))
         if not staged:
             return
-        S_ = store.el.n
+        n = store.el.n
         total = sum(len(r) for r, *_ in staged)
-        old_dt = store.el.del_t.copy()
+        base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
+        if all_new and len(store.el_free) != free_before:
+            # recycled free-list rows break the contiguous-new-block argument
+            base, size, all_new = 0, n, False
 
-        if self._use_dense(total, S_, len(staged), 3):
-            s_pad = K.next_pow2(S_)
-            at = self._dense_stack(store.el.add_t, [(r, a) for r, a, *_ in staged],
-                                   K.NEUTRAL_T, s_pad)
-            an = self._dense_stack(store.el.add_node,
-                                   [(r, x) for r, _, x, *_ in staged],
-                                   K.NEUTRAL_T, s_pad)
-            dt = self._dense_stack(store.el.del_t,
-                                   [(r, d) for r, _, _, d, *_ in staged], 0, s_pad)
-            m_at, m_an, m_dt, win = (np.asarray(a)[:S_] for a in
-                                     self._jax.device_get(D.dense_merge_elems(at, an, dt)))
-            store.el.add_t[:] = m_at
-            store.el.add_node[:] = m_an
-            store.el.del_t[:] = m_dt
+        if self._use_bulk(total, size):
+            sp = K.next_pow2(size)
+            old_dt = (np.zeros(size, dtype=_I64) if all_new
+                      else store.el.del_t[base:n].copy())
+            at = self._state_up(store.el.add_t, base, size, sp, 0, all_new)
+            an = self._state_up(store.el.add_node, base, size, sp, 0, all_new)
+            dt = self._state_up(store.el.del_t, base, size, sp, 0, all_new)
+            dev = [self._upload_batch(
+                r, base, sp, [(a, K.NEUTRAL_T), (x, K.NEUTRAL_T), (d, 0)])
+                for r, a, x, d, _, _ in staged]
+            wins = []
+            for idx, a, x, d in dev:
+                at, an, dt, win = B.bulk_elems(at, an, dt, idx, a, x, d)
+                wins.append(win)
+            m_at = np.asarray(at)[:size]
+            m_an = np.asarray(an)[:size]
+            m_dt = np.asarray(dt)[:size]
+            store.el.add_t[base:n] = m_at
+            store.el.add_node[base:n] = m_an
+            store.el.del_t[base:n] = m_dt
             el_val = store.el_val
-            for r, (pos, _, _, _, vals, has_vals) in enumerate(staged):
-                slots_w = np.nonzero(win == r + 1)[0]
-                if not len(slots_w) or not has_vals:
+            for (pos, _, _, _, vals, has_vals), win in zip(staged, wins):
+                if not has_vals:
                     continue
-                inv = np.full(S_, -1, dtype=_I64)
-                inv[pos] = np.arange(len(pos), dtype=_I64)
-                for s_ in slots_w:
-                    el_val[int(s_)] = vals[int(inv[s_])]
-            self._enqueue_elem_garbage(store, np.arange(S_), m_at, m_dt, old_dt)
+                for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
+                    el_val[int(pos[j])] = vals[int(j)]
+            self._enqueue_elem_garbage(store, np.arange(base, n), m_at, m_dt,
+                                       old_dt)
             return
 
         all_rows = np.concatenate([r for r, *_ in staged])
@@ -418,7 +462,7 @@ class TpuMergeEngine:
         for _, _, _, _, v, _ in staged:
             vals_flat.extend(v)
         trows, slot_idx = np.unique(all_rows, return_inverse=True)
-        cur_dt = old_dt[trows]
+        cur_dt = store.el.del_t[trows].copy()
         n_slots = K.next_pow2(len(trows) + 1)
         n_rows = K.next_pow2(len(all_rows))
         out = K.merge_elems(

@@ -1,0 +1,375 @@
+"""Per-peer replication link: dial/adopt, sync handshake, pull+push loops.
+
+Capability parity with the reference's `Replica` link + `Puller`/`Pusher`
+state machines (reference src/replica/replica.rs:155-359, pull.rs, push.rs),
+redesigned for one asyncio loop instead of tokio IO threads + main thread:
+the loop IS the single-writer exec thread, so apply/push steps simply run
+inline between awaits.
+
+Wire protocol (RESP frames on one TCP stream, symmetric after handshake):
+  dialer:   *[sync, 0, node_id, alias, my_addr, resume_uuid]
+  acceptor: *[sync, 1, node_id, alias, my_addr, resume_uuid]
+  then each side concurrently pushes its own stream and pulls the peer's:
+    *[fullsync, size, repl_last_uuid]  + `size` raw snapshot bytes
+    *[partsync]
+    *[replicate, origin_nodeid, prev_uuid, uuid, cmd, args...]
+    *[replack, uuid, now_ms]
+
+Sync decision (reference push.rs:91-111): partial iff the peer's resume
+uuid is still gap-free in my repl_log; decided PER ROUND, so a pusher that
+falls off its own ring mid-stream recovers by re-sending a full snapshot
+(the reference leaves this case as a TODO — pull.rs:167-172).
+
+Connection ownership: one link per peer address.  The link dials when it
+has no live connection; an inbound SYNC for the same address *adopts* its
+connection into the link, closing any previous one.  Replication is
+idempotent (watermark dup-skip), so a brief double-connection race is
+harmless.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import os
+import random
+from typing import Optional, TYPE_CHECKING
+
+from ..errors import CstError, ReplicateCommandsLost
+from ..persist.snapshot import (NodeMeta, SnapshotLoader, SnapshotWriter,
+                                batch_chunks)
+from ..engine.base import batch_from_keyspace
+from ..resp.codec import RespParser, encode_msg
+from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
+from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
+from ..utils.hlc import now_ms
+from .manager import ReplicaMeta
+
+if TYPE_CHECKING:
+    from ..server.io import ServerApp
+
+log = logging.getLogger(__name__)
+
+SYNC = b"sync"
+FULLSYNC = b"fullsync"
+PARTSYNC = b"partsync"
+REPLICATE = b"replicate"
+REPLACK = b"replack"
+
+_READ_CHUNK = 1 << 16
+
+
+class ReplicaLink:
+    """Drives replication with one peer.  `start()` begins the dial loop;
+    `adopt()` installs an inbound connection."""
+
+    def __init__(self, app: "ServerApp", meta: ReplicaMeta):
+        self.app = app
+        self.node = app.node
+        self.meta = meta
+        meta.link = self
+        self.closing = False
+        self._dial_task: Optional[asyncio.Task] = None
+        self._serve_task: Optional[asyncio.Task] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._dial_task is None or self._dial_task.done():
+            self._dial_task = asyncio.create_task(self._dial_loop())
+
+    async def stop(self) -> None:
+        self.closing = True
+        for t in (self._dial_task, self._serve_task):
+            if t is not None and not t.done():
+                t.cancel()
+        await self._close_conn()
+        self.meta.link = None
+
+    @property
+    def connected(self) -> bool:
+        return self._serve_task is not None and not self._serve_task.done()
+
+    async def _close_conn(self) -> None:
+        w, self._writer = self._writer, None
+        if w is not None:
+            try:
+                w.close()
+                await w.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    # ----------------------------------------------------------------- dial
+
+    async def _dial_loop(self) -> None:
+        """Reconnect-forever with backoff (reference
+        replica/replica.rs:254-271, 5s retry)."""
+        while not self.closing and self.meta.alive:
+            if not self.connected:
+                try:
+                    await self._dial_once()
+                except (ConnectionError, OSError, CstError,
+                        asyncio.TimeoutError) as e:
+                    log.debug("dial %s failed: %s", self.meta.addr, e)
+            delay = self.app.reconnect_delay
+            await asyncio.sleep(delay * (0.8 + 0.4 * random.random()))
+
+    async def _dial_once(self) -> None:
+        host, port = self.meta.addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            writer.write(encode_msg(Arr([
+                Bulk(SYNC), Int(0), Int(self.node.node_id),
+                Bulk(self.node.alias.encode()),
+                Bulk(self.app.advertised_addr.encode()),
+                Int(self.meta.uuid_he_sent)])))
+            await writer.drain()
+            parser = RespParser()
+            msg = await _read_msg(reader, parser,
+                                  timeout=self.app.handshake_timeout)
+            peer_resume = self._check_sync_reply(msg)
+        except BaseException:
+            writer.close()
+            raise
+        self._install(reader, writer, parser, peer_resume)
+
+    def _check_sync_reply(self, msg) -> int:
+        items = msg.items if isinstance(msg, Arr) else None
+        if not items or as_bytes(items[0]).lower() != SYNC or \
+                as_int(items[1]) != 1:
+            raise CstError(f"bad sync reply from {self.meta.addr}: {msg!r}")
+        self.meta.node_id = as_int(items[2])
+        self.meta.alias = as_bytes(items[3]).decode("utf-8", "replace")
+        return as_int(items[5])
+
+    # ---------------------------------------------------------------- adopt
+
+    def adopt(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+              parser: RespParser, peer_resume: int) -> None:
+        """Install an inbound connection (the passive side of SYNC —
+        reference replica.rs:16-40 steals the client's Conn)."""
+        self._install(reader, writer, parser, peer_resume)
+
+    def _install(self, reader, writer, parser, peer_resume: int) -> None:
+        old_task, old_writer = self._serve_task, self._writer
+        self._writer = writer
+        self._serve_task = asyncio.create_task(
+            self._serve(reader, writer, parser, peer_resume))
+        if old_task is not None and not old_task.done():
+            old_task.cancel()
+        if old_writer is not None:
+            old_writer.close()
+
+    # ---------------------------------------------------------------- serve
+
+    async def _serve(self, reader, writer, parser, peer_resume: int) -> None:
+        push = asyncio.create_task(self._push_loop(writer, peer_resume))
+        try:
+            await self._pull_loop(reader, parser)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+            log.debug("link %s dropped: %s", self.meta.addr, e)
+        except ReplicateCommandsLost as e:
+            log.warning("link %s: %s — forcing full resync", self.meta.addr, e)
+        except CstError as e:
+            log.warning("link %s protocol error: %s", self.meta.addr, e)
+        except asyncio.CancelledError:
+            raise
+        finally:
+            push.cancel()
+            if self._writer is writer:
+                self._writer = None
+            writer.close()
+
+    # ----------------------------------------------------------------- push
+
+    async def _push_loop(self, writer, peer_resume: int) -> None:
+        """Outbound half (reference push.rs): full-vs-partial, then stream
+        repl_log frames; REPLACK heartbeat."""
+        node = self.node
+        meta = self.meta
+        consumer = node.events.new_consumer(EVENT_REPLICATED)
+        try:
+            synced = False  # peer_resume not yet honored
+            last_ack = 0.0
+            while True:
+                if not synced or not node.repl_log.can_resume_from(
+                        meta.uuid_i_sent):
+                    resume = peer_resume if not synced else meta.uuid_i_sent
+                    if node.repl_log.can_resume_from(resume):
+                        writer.write(encode_msg(Arr([Bulk(PARTSYNC)])))
+                        meta.uuid_i_sent = resume
+                    else:
+                        await self._send_snapshot(writer)
+                    synced = True
+
+                sent = 0
+                while (e := node.repl_log.next_after(meta.uuid_i_sent)) is not None:
+                    writer.write(encode_msg(Arr([
+                        Bulk(REPLICATE), Int(node.node_id), Int(e.prev_uuid),
+                        Int(e.uuid), Bulk(e.name), *e.args])))
+                    meta.uuid_i_sent = e.uuid
+                    sent += 1
+                    if sent % 64 == 0:
+                        await writer.drain()  # backpressure + yield
+
+                now = asyncio.get_running_loop().time()
+                if (meta.uuid_he_sent > meta.uuid_he_acked
+                        or now - last_ack >= self.app.heartbeat):
+                    # beacon: with the log fully drained, every uuid this
+                    # node will EVER stream from now on exceeds its current
+                    # HLC — peers may advance their pull watermark to it, so
+                    # idle nodes don't pin the cluster GC horizon at 0
+                    drained = meta.uuid_i_sent >= node.repl_log.last_uuid
+                    beacon = node.hlc.current if drained else 0
+                    writer.write(encode_msg(Arr([
+                        Bulk(REPLACK), Int(meta.uuid_he_sent), Int(now_ms()),
+                        Int(beacon)])))
+                    meta.uuid_he_acked = meta.uuid_he_sent
+                    last_ack = now
+                await writer.drain()
+                await consumer.wait(timeout=self.app.heartbeat)
+        except (ConnectionError, OSError) as e:
+            log.debug("push %s dropped: %s", self.meta.addr, e)
+        finally:
+            consumer.close()
+
+    async def _send_snapshot(self, writer) -> None:
+        """Fork-free full sync: capture the columnar state on the loop
+        (consistent — single-writer), encode+compress on a worker thread,
+        stream length-prefixed bytes (reference push.rs:34-71 +
+        server.rs:221-250, minus the fork)."""
+        node = self.node
+        capture = batch_from_keyspace(node.ks)
+        repl_last = node.repl_log.last_uuid
+        meta_hdr = NodeMeta(node_id=node.node_id, alias=node.alias,
+                            addr=self.app.advertised_addr,
+                            repl_last_uuid=repl_last)
+        records = node.replicas.records()
+        chunk_keys = self.app.snapshot_chunk_keys
+
+        def encode() -> bytes:
+            buf = io.BytesIO()
+            w = SnapshotWriter(buf)
+            w.write_node(meta_hdr)
+            w.write_replicas(records)
+            for chunk in batch_chunks(capture, chunk_keys):
+                w.write_chunk(chunk)
+            w.finish()
+            return buf.getvalue()
+
+        blob = await asyncio.to_thread(encode)
+        self.node.stats.extra["last_snapshot_bytes"] = len(blob)
+        self.node.stats.extra["full_syncs_sent"] = \
+            self.node.stats.extra.get("full_syncs_sent", 0) + 1
+        writer.write(encode_msg(Arr([Bulk(FULLSYNC), Int(len(blob)),
+                                     Int(repl_last)])))
+        for off in range(0, len(blob), _READ_CHUNK):
+            writer.write(blob[off:off + _READ_CHUNK])
+            await writer.drain()
+        self.meta.uuid_i_sent = repl_last
+
+    # ----------------------------------------------------------------- pull
+
+    async def _pull_loop(self, reader, parser) -> None:
+        """Inbound half (reference pull.rs): apply replicate frames with
+        watermark checks; load snapshots through the MergeEngine."""
+        while True:
+            msg = await _read_msg(reader, parser)
+            items = msg.items if isinstance(msg, Arr) else None
+            if not items:
+                raise CstError(f"unexpected frame from {self.meta.addr}: {msg!r}")
+            kind = as_bytes(items[0]).lower()
+            if kind == REPLICATE:
+                self._apply_replicate(items)
+            elif kind == REPLACK:
+                uuid = as_int(items[1])
+                if uuid > self.meta.uuid_i_acked:
+                    self.meta.uuid_i_acked = uuid
+                    self.node.events.trigger(EVENT_REPLICA_ACKED, uuid)
+                if len(items) > 3:
+                    beacon = as_int(items[3])
+                    if beacon > self.meta.uuid_he_sent:
+                        # peer's stream is complete below its beacon
+                        self.meta.uuid_he_sent = beacon
+                        self.node.hlc.observe(beacon)
+            elif kind == FULLSYNC:
+                await self._receive_snapshot(reader, parser,
+                                             size=as_int(items[1]),
+                                             repl_last=as_int(items[2]))
+            elif kind == PARTSYNC:
+                pass  # stream continues from our requested resume point
+            else:
+                raise CstError(f"unknown repl frame {kind!r}")
+
+    def _apply_replicate(self, items) -> None:
+        """(reference pull.rs:184-235 apply_his_replicates)"""
+        meta = self.meta
+        origin = as_int(items[1])
+        prev_uuid = as_int(items[2])
+        uuid = as_int(items[3])
+        name = as_bytes(items[4])
+        if uuid <= meta.uuid_he_sent:
+            return  # duplicate (reconnect overlap) — idempotent skip
+        if prev_uuid > meta.uuid_he_sent:
+            raise ReplicateCommandsLost(
+                f"{self.meta.addr}: gap {meta.uuid_he_sent} -> {prev_uuid}")
+        self.node.apply_replicated(name, items[5:], origin, uuid)
+        meta.uuid_he_sent = uuid
+
+    async def _receive_snapshot(self, reader, parser, size: int,
+                                repl_last: int) -> None:
+        """Download to a spill file, then stream chunks through the
+        MergeEngine, yielding between chunks to keep the loop live
+        (reference pull.rs:35-85, at columnar scale)."""
+        path = os.path.join(self.app.work_dir,
+                            f"snapshot.{self.meta.addr.replace(':', '_')}")
+        with open(path, "wb") as f:
+            remaining = size
+            while remaining > 0:
+                got = parser.take_raw(min(remaining, _READ_CHUNK))
+                if not got:
+                    got = await reader.read(min(remaining, _READ_CHUNK))
+                    if not got:
+                        raise ConnectionError("EOF during snapshot download")
+                f.write(got)
+                remaining -= len(got)
+        node = self.node
+        applied_rows = 0
+        with open(path, "rb") as f:
+            for kind, payload in SnapshotLoader(f):
+                if kind == "node":
+                    if payload.node_id and not self.meta.node_id:
+                        self.meta.node_id = payload.node_id
+                elif kind == "replicas":
+                    # transitive mesh join (reference pull.rs:136-153)
+                    node.replicas.merge_records(
+                        payload, my_addr=self.app.advertised_addr)
+                else:
+                    node.merge_batch(payload)
+                    applied_rows += payload.n_rows
+                    await asyncio.sleep(0)
+        if repl_last > self.meta.uuid_he_sent:
+            self.meta.uuid_he_sent = repl_last
+        node.hlc.observe(repl_last)
+        log.info("loaded snapshot from %s: %d rows", self.meta.addr,
+                 applied_rows)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+async def _read_msg(reader: asyncio.StreamReader, parser: RespParser,
+                    timeout: Optional[float] = None):
+    """Next complete RESP message from the stream."""
+    while True:
+        msg = parser.next_msg()
+        if msg is not None:
+            return msg
+        coro = reader.read(_READ_CHUNK)
+        data = await (asyncio.wait_for(coro, timeout) if timeout else coro)
+        if not data:
+            raise ConnectionError("EOF")
+        parser.feed(data)

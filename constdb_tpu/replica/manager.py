@@ -1,0 +1,149 @@
+"""Replica membership + progress watermarks.
+
+Capability parity with the reference's `ReplicaManager`
+(reference src/replica/replica.rs:16-128): membership is itself a CRDT —
+an add/del LWW map keyed by peer address — so MEET/FORGET replicate and
+merge like any other write, and snapshot REPLICAS sections from different
+peers converge.  Each row also carries the four progress watermarks that
+drive partial-resync decisions and the GC horizon.
+
+Watermarks (reference ReplicaMeta, replica/replica.rs:131-147):
+  uuid_i_sent  — newest entry of MY repl_log I have pushed to this peer
+  uuid_i_acked — newest of MY uuids this peer has REPLACKed
+  uuid_he_sent — newest of HIS uuids I have applied (my pull progress;
+                 doubles as the resume point I request on reconnect)
+  uuid_he_acked — newest of his uuids I last REPLACKed back to him
+
+GC horizon: the reference uses min(uuid_he_sent) (replica/replica.rs:87-89),
+which only proves peer CLOCKS advanced.  We take
+min(uuid_i_acked, uuid_he_sent) per live peer: uuid_i_acked proves the peer
+actually holds my stream — including my tombstones — past the horizon, so
+physically dropping those tombstones is safe; uuid_he_sent keeps the bound
+conservative for tombstones I merged from third parties.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from ..persist.snapshot import ReplicaRecord
+
+
+@dataclass
+class ReplicaMeta:
+    addr: str
+    node_id: int = 0
+    alias: str = ""
+    add_t: int = 0
+    del_t: int = 0
+    uuid_i_sent: int = 0
+    uuid_i_acked: int = 0
+    uuid_he_sent: int = 0
+    uuid_he_acked: int = 0
+    # runtime attachment (not replicated): the live link driving this peer
+    link: object = field(default=None, repr=False, compare=False)
+
+    @property
+    def alive(self) -> bool:
+        return self.add_t >= self.del_t
+
+    def record(self) -> ReplicaRecord:
+        return ReplicaRecord(self.addr, self.node_id, self.alias, self.add_t,
+                             self.del_t, self.uuid_he_sent, self.uuid_he_acked)
+
+
+class ReplicaManager:
+    def __init__(self) -> None:
+        self.peers: dict[str, ReplicaMeta] = {}
+        # hook: called with (addr, meta) when a NEW live peer appears through
+        # a merge (transitive mesh join — reference pull.rs:136-153)
+        self.on_new_peer: Optional[Callable[[ReplicaMeta], None]] = None
+
+    # ------------------------------------------------------------ membership
+
+    def get(self, addr: str) -> Optional[ReplicaMeta]:
+        return self.peers.get(addr)
+
+    def add(self, addr: str, uuid: int, node_id: int = 0,
+            alias: str = "") -> ReplicaMeta:
+        """MEET: (re-)register a peer at time `uuid` (add-side LWW)."""
+        m = self.peers.get(addr)
+        if m is None:
+            m = ReplicaMeta(addr, node_id=node_id, alias=alias, add_t=uuid)
+            self.peers[addr] = m
+        else:
+            if uuid > m.add_t:
+                m.add_t = uuid
+            if node_id:
+                m.node_id = node_id
+            if alias:
+                m.alias = alias
+        return m
+
+    def forget(self, addr: str, uuid: int) -> bool:
+        """FORGET: tombstone a peer (del-side LWW).  Registered as a real
+        command, unlike the reference (replica.rs:77-86 defines `forget` but
+        never registers it — SURVEY.md §"Known reference defects")."""
+        m = self.peers.get(addr)
+        if m is None:
+            m = ReplicaMeta(addr)
+            self.peers[addr] = m
+        if uuid > m.del_t:
+            m.del_t = uuid
+            return True
+        return False
+
+    def live_peers(self) -> list[ReplicaMeta]:
+        return [m for m in self.peers.values() if m.alive]
+
+    def merge_records(self, rows: Iterable[ReplicaRecord],
+                      my_addr: str = "") -> list[ReplicaMeta]:
+        """Merge a REPLICAS snapshot section (LWW per addr); returns peers
+        that became live-and-new (candidates for transitive MEET)."""
+        fresh = []
+        for r in rows:
+            if r.addr == my_addr:
+                continue
+            m = self.peers.get(addr := r.addr)
+            if m is None:
+                m = ReplicaMeta(addr)
+                self.peers[addr] = m
+                is_new = True
+            else:
+                is_new = not m.alive
+            if r.add_t > m.add_t:
+                m.add_t = r.add_t
+            if r.del_t > m.del_t:
+                m.del_t = r.del_t
+            if r.node_id:
+                m.node_id = r.node_id
+            if r.alias and not m.alias:
+                m.alias = r.alias
+            if is_new and m.alive:
+                fresh.append(m)
+        for m in fresh:
+            if self.on_new_peer is not None:
+                self.on_new_peer(m)
+        return fresh
+
+    def records(self) -> list[ReplicaRecord]:
+        """Membership dump for the snapshot REPLICAS section."""
+        return [m.record() for m in self.peers.values()]
+
+    # -------------------------------------------------------------- horizon
+
+    def min_uuid(self) -> Optional[int]:
+        """GC tombstone horizon (see module docstring); None when no live
+        peers (standalone nodes collect up to their own clock)."""
+        live = self.live_peers()
+        if not live:
+            return None
+        return min(min(m.uuid_i_acked, m.uuid_he_sent) for m in live)
+
+    # ------------------------------------------------------------- REPLICAS
+
+    def describe(self) -> list[tuple[str, ReplicaMeta]]:
+        """Rows for the REPLICAS command (reference
+        replica/replica.rs:63-85)."""
+        return sorted(self.peers.items())

@@ -78,6 +78,20 @@ class RespParser:
     def buffered(self) -> int:
         return len(self._buf) - self._pos
 
+    def take_raw(self, n: int) -> bytes:
+        """Up to n RAW bytes from the internal buffer.  Snapshot transfer
+        interleaves length-delimited raw byte runs with RESP frames on one
+        stream (reference src/conn/reader.rs:104-121 `save_to_file`); the
+        parser may have buffered past the frame boundary, so the raw run
+        must drain from here before reading the socket directly."""
+        end = min(self._pos + n, len(self._buf))
+        data = bytes(self._buf[self._pos:end])
+        self._pos = end
+        if self._pos >= _COMPACT_THRESHOLD:
+            del self._buf[: self._pos]
+            self._pos = 0
+        return data
+
     def next_msg(self) -> Optional[Msg]:
         """One complete message, or None if more bytes are needed.
         Raises InvalidRequestMsg on malformed input."""

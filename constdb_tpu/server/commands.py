@@ -581,3 +581,8 @@ def ttl_command(node, ctx, args):
     if exp == 0:
         return Int(-1)
     return Int(max(0, (exp >> SEQ_BITS) - now_ms()) // 1000)
+
+
+# membership + observability commands register themselves against this table
+from ..replica import commands as _replica_commands  # noqa: E402,F401
+from . import info as _info_commands  # noqa: E402,F401

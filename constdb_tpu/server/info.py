@@ -1,0 +1,129 @@
+"""INFO command: Redis-style sections over node + process + device metrics.
+
+Capability parity with the reference's stats layer (reference src/stats.rs:
+global atomics folded into `Metrics`, INFO sections Server/Clients/Memory/
+Stats/Replication/CPU/Keyspace, stats.rs:287-305).  The reference's
+allocator-integrated memory gauge (jemalloc wrapper, lib.rs:63-78) maps here
+to host RSS plus the JAX device HBM accounting (`device.memory_stats()`) —
+the TPU-native equivalent called out in SURVEY.md §2.1.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+import numpy as np
+
+from ..crdt import semantics as S
+from ..resp.message import Bulk
+from .commands import CMD_READONLY, register
+
+
+def _section_server(node, out):
+    out.append(("node_id", node.node_id))
+    out.append(("node_alias", node.alias))
+    app = getattr(node, "app", None)
+    if app is not None:
+        out.append(("tcp_addr", app.advertised_addr))
+    out.append(("process_id", os.getpid()))
+    up = time.time() - (node.stats.start_time or time.time())
+    out.append(("uptime_in_seconds", int(up)))
+    out.append(("current_uuid", node.hlc.current))
+
+
+def _section_clients(node, out):
+    out.append(("connected_clients", node.stats.current_clients))
+    out.append(("total_connections_received", node.stats.connections_accepted))
+
+
+def _section_memory(node, out):
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out.append(("used_memory_rss", ru.ru_maxrss * 1024))
+    try:
+        dev = node.engine._devices[0]
+        ms = dev.memory_stats() or {}
+        if "bytes_in_use" in ms:
+            out.append(("device_hbm_in_use", ms["bytes_in_use"]))
+        if "bytes_limit" in ms:
+            out.append(("device_hbm_limit", ms["bytes_limit"]))
+        out.append(("device", str(dev)))
+    except (AttributeError, RuntimeError, IndexError):
+        pass
+
+
+def _section_stats(node, out):
+    st = node.stats
+    out.append(("total_commands_processed", st.cmds_processed))
+    out.append(("total_commands_replicated", st.cmds_replicated))
+    out.append(("total_net_input_bytes", st.net_in_bytes))
+    out.append(("total_net_output_bytes", st.net_out_bytes))
+    out.append(("merge_batches", st.merges))
+    out.append(("merge_rows", st.merge_rows))
+    out.append(("gc_freed", st.gc_freed))
+    for k, v in sorted(st.extra.items()):
+        out.append((k, v))
+
+
+def _section_replication(node, out):
+    peers = node.replicas.describe() if node.replicas else []
+    live = [m for _, m in peers if m.alive]
+    out.append(("connected_replicas", sum(
+        1 for m in live if m.link is not None and m.link.connected)))
+    out.append(("known_replicas", len(peers)))
+    rl = node.repl_log
+    out.append(("repl_log_entries", len(rl)))
+    out.append(("repl_log_bytes", rl.total_bytes))
+    out.append(("repl_log_first_uuid", rl.first_uuid))
+    out.append(("repl_log_last_uuid", rl.last_uuid))
+    horizon = node.replicas.min_uuid() if node.replicas else None
+    out.append(("gc_horizon_uuid", horizon if horizon is not None else ""))
+    for i, (addr, m) in enumerate(peers):
+        state = "connected" if (m.link is not None and m.link.connected) \
+            else ("alive" if m.alive else "forgotten")
+        out.append((f"replica{i}",
+                    f"addr={addr},node_id={m.node_id},state={state},"
+                    f"i_sent={m.uuid_i_sent},i_acked={m.uuid_i_acked},"
+                    f"he_sent={m.uuid_he_sent},he_acked={m.uuid_he_acked}"))
+
+
+def _section_keyspace(node, out):
+    ks = node.ks
+    n = ks.keys.n
+    out.append(("keys", n))
+    if n:
+        counts = np.bincount(ks.keys.enc[:n].astype(np.int64), minlength=8)
+        out.append(("counters", int(counts[S.ENC_COUNTER])))
+        out.append(("registers", int(counts[S.ENC_BYTES])))
+        out.append(("dicts", int(counts[S.ENC_DICT])))
+        out.append(("sets", int(counts[S.ENC_SET])))
+    out.append(("counter_slots", ks.cnt.n))
+    out.append(("element_rows", ks.el.n - len(ks.el_free)))
+    out.append(("pending_tombstones", len(ks.garbage)))
+
+
+SECTIONS = {
+    "server": _section_server,
+    "clients": _section_clients,
+    "memory": _section_memory,
+    "stats": _section_stats,
+    "replication": _section_replication,
+    "keyspace": _section_keyspace,
+}
+
+
+@register("info", CMD_READONLY)
+def info_command(node, ctx, args):
+    """(reference stats.rs:287-305)"""
+    want = args.next_str().lower() if args.has_more else None
+    lines = []
+    for name, fn in SECTIONS.items():
+        if want is not None and name != want:
+            continue
+        lines.append(f"# {name.capitalize()}")
+        rows: list = []
+        fn(node, rows)
+        lines.extend(f"{k}:{v}" for k, v in rows)
+        lines.append("")
+    return Bulk("\r\n".join(lines).encode())

@@ -1,0 +1,252 @@
+"""TCP server: RESP client connections + replica handshake + cron.
+
+Capability parity with the reference's accept loop / Link scheduling / cron
+(reference src/server.rs:94-146, src/link.rs), mapped onto one asyncio
+event loop: the loop is the single-writer exec thread (the reference's main
+thread, server.rs:128-131); per-connection coroutines are its IO threads.
+Parsing happens in the connection coroutine, execution inline — the mpsc
+hand-off the reference needs between thread pools simply disappears.
+
+A client connection that sends `SYNC` is upgraded to a replica link
+(reference replica.rs:16-40: sync_command steals the client's Conn)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+from ..errors import CstError
+from ..replica.link import ReplicaLink, SYNC
+from ..replica.manager import ReplicaManager, ReplicaMeta
+from ..resp.codec import RespParser, encode_into
+from ..resp.message import Arr, Bulk, Err, Int, NoReply, as_bytes, as_int
+from .node import Node
+
+log = logging.getLogger(__name__)
+
+_READ_CHUNK = 1 << 16
+
+
+class ServerApp:
+    """One node's process: listener, replica links, cron, config knobs."""
+
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0,
+                 advertised_addr: str = "", work_dir: str = ".",
+                 heartbeat: float = 4.0, reconnect_delay: float = 5.0,
+                 handshake_timeout: float = 10.0,
+                 snapshot_chunk_keys: int = 1 << 16,
+                 gc_interval: float = 1.0,
+                 snapshot_path: str = ""):
+        self.node = node
+        node.app = self
+        if node.replicas is None:
+            node.replicas = ReplicaManager()
+        node.replicas.on_new_peer = self.ensure_link
+        self.host = host
+        self.port = port
+        self._advertised = advertised_addr
+        self.work_dir = work_dir
+        self.heartbeat = heartbeat
+        self.reconnect_delay = reconnect_delay
+        self.handshake_timeout = handshake_timeout
+        self.snapshot_chunk_keys = snapshot_chunk_keys
+        self.gc_interval = gc_interval
+        self.snapshot_path = snapshot_path
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._cron_task: Optional[asyncio.Task] = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def advertised_addr(self) -> str:
+        return self._advertised or f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        os.makedirs(self.work_dir, exist_ok=True)
+        if not self.node.node_id:
+            # CRDT tie-breaks hinge on distinct writer node ids; an operator
+            # who skips `node_id` in the config must not get three identical
+            # writers (the reference defaults to 0 for everyone — conf.rs:63)
+            import random as _random
+            self.node.node_id = _random.SystemRandom().randrange(1, 1 << 31)
+            log.info("auto-assigned node_id %d", self.node.node_id)
+        self.node.stats.start_time = time.time()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._cron_task = asyncio.create_task(self._cron())
+        # reconnect links for membership restored from a snapshot
+        for m in self.node.replicas.live_peers():
+            self.ensure_link(m)
+        log.info("node %d listening on %s", self.node.node_id,
+                 self.advertised_addr)
+
+    async def close(self) -> None:
+        if self._cron_task is not None:
+            self._cron_task.cancel()
+        for m in list(self.node.replicas.peers.values()):
+            if isinstance(m.link, ReplicaLink):
+                await m.link.stop()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        await self._server.serve_forever()
+
+    # ----------------------------------------------------------------- cron
+
+    async def _cron(self) -> None:
+        """(reference server.rs:134-146: 100ms tick — advance uuid, gc)"""
+        last_gc = 0.0
+        while True:
+            await asyncio.sleep(0.1)
+            self.node.hlc.tick(False)
+            now = asyncio.get_running_loop().time()
+            if now - last_gc >= self.gc_interval:
+                self.node.gc()
+                last_gc = now
+
+    # ---------------------------------------------------------------- links
+
+    def ensure_link(self, meta: ReplicaMeta) -> None:
+        """Spawn (or keep) the dialing link for a live peer."""
+        if not meta.alive or meta.addr == self.advertised_addr:
+            return
+        if isinstance(meta.link, ReplicaLink):
+            meta.link.start()
+            return
+        ReplicaLink(self, meta).start()
+
+    async def drop_link(self, meta: ReplicaMeta) -> None:
+        if isinstance(meta.link, ReplicaLink):
+            await meta.link.stop()
+
+    # ----------------------------------------------------------- connection
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        self.node.stats.connections_accepted += 1
+        self.node.stats.current_clients += 1
+        parser = RespParser()
+        out = bytearray()
+        upgraded = False
+        try:
+            while True:
+                data = await reader.read(_READ_CHUNK)
+                if not data:
+                    break
+                self.node.stats.net_in_bytes += len(data)
+                parser.feed(data)
+                while (msg := parser.next_msg()) is not None:
+                    if self._is_sync(msg):
+                        self._upgrade_to_replica(msg, reader, writer, parser)
+                        upgraded = True
+                        break
+                    reply = self.node.execute(msg)
+                    if not isinstance(reply, NoReply):
+                        encode_into(out, reply)
+                if upgraded:
+                    return  # connection now owned by the replica link
+                if out:
+                    self.node.stats.net_out_bytes += len(out)
+                    writer.write(bytes(out))
+                    out.clear()
+                    await writer.drain()
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        except CstError as e:
+            try:
+                writer.write(encode_msg_err(e))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        finally:
+            self.node.stats.current_clients -= 1
+            self._conn_tasks.discard(task)
+            # an upgraded connection is owned by its replica link now
+            if not upgraded and not writer.is_closing():
+                writer.close()
+
+    @staticmethod
+    def _is_sync(msg) -> bool:
+        return (isinstance(msg, Arr) and msg.items
+                and isinstance(msg.items[0], Bulk)
+                and msg.items[0].val.lower() == SYNC)
+
+    def _upgrade_to_replica(self, msg, reader, writer, parser) -> None:
+        """Passive handshake: register/refresh the peer, reply `sync 1`,
+        hand the connection to its link."""
+        items = msg.items
+        try:
+            role = as_int(items[1])
+            peer_id = as_int(items[2])
+            peer_alias = as_bytes(items[3]).decode("utf-8", "replace")
+            peer_addr = as_bytes(items[4]).decode("utf-8", "replace")
+            peer_resume = as_int(items[5])
+        except (IndexError, CstError):
+            writer.write(b"-malformed sync\r\n")
+            writer.close()
+            return
+        if role != 0 or peer_addr == self.advertised_addr:
+            writer.write(b"-bad sync role or self-sync\r\n")
+            writer.close()
+            return
+        node = self.node
+        prev = node.replicas.get(peer_addr)
+        newly_met = prev is None or not prev.alive
+        meta = node.replicas.add(peer_addr, node.hlc.tick(True),
+                                 node_id=peer_id, alias=peer_alias)
+        if newly_met:
+            # replicate the introduction so the whole mesh learns this peer
+            # even when every sync is partial and no snapshot (with its
+            # REPLICAS section) ever flows — the reference only propagates
+            # membership through full syncs (pull.rs:136-153), which leaves
+            # hub-and-spoke topologies permanently partitioned
+            node.execute([Bulk(b"meet"), Bulk(peer_addr.encode())])
+        writer.write(encode_msg_arr([
+            Bulk(SYNC), Int(1), Int(node.node_id), Bulk(node.alias.encode()),
+            Bulk(self.advertised_addr.encode()), Int(meta.uuid_he_sent)]))
+        link = meta.link if isinstance(meta.link, ReplicaLink) else \
+            ReplicaLink(self, meta)
+        link.adopt(reader, writer, parser, peer_resume)
+        link.start()  # dial loop doubles as the reconnect supervisor
+
+
+def encode_msg_arr(items) -> bytes:
+    out = bytearray()
+    encode_into(out, Arr(items))
+    return bytes(out)
+
+
+def encode_msg_err(e: CstError) -> bytes:
+    out = bytearray()
+    encode_into(out, Err(e.resp_error()))
+    return bytes(out)
+
+
+async def start_node(node: Node, **kwargs) -> ServerApp:
+    """Convenience: build + start a ServerApp (optionally restoring the
+    boot snapshot — a capability the reference lacks, SURVEY.md §5.4)."""
+    app = ServerApp(node, **kwargs)
+    if app.snapshot_path and os.path.exists(app.snapshot_path):
+        from ..persist.snapshot import load_snapshot
+        meta, records = load_snapshot(app.snapshot_path, node.ks,
+                                      engine=node.engine)
+        if meta.node_id and not node.node_id:
+            node.node_id = meta.node_id
+        node.hlc.observe(meta.repl_last_uuid)
+        node.replicas.merge_records(records, my_addr=app.advertised_addr)
+        log.info("restored snapshot %s (%d keys)", app.snapshot_path,
+                 node.ks.n_keys())
+    await app.start()
+    return app

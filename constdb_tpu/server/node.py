@@ -49,9 +49,10 @@ class Node:
         self.events = EventBus()
         self.engine = engine if engine is not None else CpuMergeEngine()
         self.stats = NodeStats()
-        # replica membership/manager — attached by replica.ReplicaManager;
-        # None for a standalone node (tests, cli tooling)
-        self.replicas = None
+        from ..replica.manager import ReplicaManager
+        self.replicas = ReplicaManager()
+        # the ServerApp driving this node's IO, when one exists
+        self.app = None
 
     # ------------------------------------------------------------ execution
 

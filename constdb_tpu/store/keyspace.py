@@ -446,8 +446,13 @@ class KeySpace:
             elif enc == S.ENC_BYTES:
                 content = self.register_state(kid)
             else:
+                # a del_t at or below add_t is semantically inert (visibility
+                # and every future max-merge are unchanged by zeroing it), and
+                # GC timing legitimately leaves different inert values on
+                # different replicas — normalize so canonical state converges
                 content = frozenset(
-                    (m, at, an, dlt, v) for m, at, an, dlt, v in self.elem_all(kid)
+                    (m, at, an, dlt if dlt > at else 0, v)
+                    for m, at, an, dlt, v in self.elem_all(kid)
                 )
             out[key] = (enc, ct, mt, dt, int(self.keys.expire[kid]), content)
         return out

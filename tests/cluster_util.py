@@ -1,0 +1,113 @@
+"""In-process cluster harness for replication tests.
+
+The reference tests multi-node behavior black-box against live processes
+driven by a client with a local oracle (reference bin/test.rs, SURVEY.md §4).
+This harness keeps the black-box client-over-TCP shape but runs every node
+in ONE asyncio loop and replaces convergence *sleeps* with convergence
+*polling* on canonical state — deterministic and fast.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from constdb_tpu.resp.codec import RespParser, encode_msg
+from constdb_tpu.resp.message import Arr, Bulk, Msg
+from constdb_tpu.server.io import ServerApp, start_node
+from constdb_tpu.server.node import Node
+
+FAST = dict(heartbeat=0.15, reconnect_delay=0.25, gc_interval=0.2)
+
+
+async def make_cluster(n: int, work_dir: str, engine=None,
+                       repl_log_cap: int = 1_024_000, **kw) -> list[ServerApp]:
+    apps = []
+    for i in range(n):
+        node = Node(node_id=i + 1, alias=f"n{i + 1}", engine=engine,
+                    repl_log_cap=repl_log_cap)
+        opts = {**FAST, **kw}
+        apps.append(await start_node(node, host="127.0.0.1", port=0,
+                                     work_dir=work_dir, **opts))
+    return apps
+
+
+async def close_cluster(apps) -> None:
+    for app in apps:
+        await app.close()
+
+
+class Client:
+    """Minimal RESP client (the reference's constdb-cli/test transport)."""
+
+    def __init__(self) -> None:
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.parser = RespParser()
+
+    async def connect(self, addr: str) -> "Client":
+        host, port = addr.rsplit(":", 1)
+        self.reader, self.writer = await asyncio.open_connection(host, int(port))
+        return self
+
+    async def cmd(self, *parts) -> Msg:
+        items = [Bulk(p if isinstance(p, bytes) else str(p).encode())
+                 for p in parts]
+        self.writer.write(encode_msg(Arr(items)))
+        await self.writer.drain()
+        while True:
+            msg = self.parser.next_msg()
+            if msg is not None:
+                return msg
+            data = await asyncio.wait_for(self.reader.read(1 << 16), 10.0)
+            if not data:
+                raise ConnectionError("EOF")
+            self.parser.feed(data)
+
+    async def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+async def converge(apps, timeout: float = 15.0, poll: float = 0.05) -> None:
+    """Poll until every node's canonical CRDT state is identical."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        canons = [app.node.ks.canonical() for app in apps]
+        if all(c == canons[0] for c in canons[1:]):
+            return
+        if loop.time() > deadline:
+            diff_keys = set()
+            for c in canons[1:]:
+                for k in set(c) | set(canons[0]):
+                    if c.get(k) != canons[0].get(k):
+                        diff_keys.add(k)
+            raise AssertionError(
+                f"no convergence after {timeout}s; {len(diff_keys)} keys "
+                f"differ, e.g. {sorted(diff_keys)[:5]}")
+        await asyncio.sleep(poll)
+
+
+async def full_mesh(apps, timeout: float = 15.0) -> None:
+    """Wait until every node has a connected link to every other."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    want = {app.advertised_addr for app in apps}
+    while True:
+        ok = True
+        for app in apps:
+            peers = {m.addr for m in app.node.replicas.live_peers()
+                     if m.link is not None and m.link.connected}
+            if want - {app.advertised_addr} - peers:
+                ok = False
+                break
+        if ok:
+            return
+        if loop.time() > deadline:
+            raise AssertionError("mesh did not fully connect")
+        await asyncio.sleep(0.05)

@@ -1,0 +1,322 @@
+"""Multi-node replication: topology, sync, convergence with oracles.
+
+Port of the reference's integration strategy (reference bin/test.rs,
+SURVEY.md §4) to an in-process asyncio cluster: randomized concurrent
+workloads against ≥3 live nodes with a local oracle model, convergence
+asserted by polling canonical CRDT state instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from constdb_tpu.resp.message import Arr, Bulk, Int, Nil, Simple
+
+from cluster_util import Client, close_cluster, converge, full_mesh, make_cluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ---------------------------------------------------------------- topology
+
+def test_meet_and_transitive_join(tmp_path):
+    async def main():
+        apps = await make_cluster(3, str(tmp_path))
+        try:
+            c1 = await Client().connect(apps[0].advertised_addr)
+            # pre-existing data on n1 must reach late joiners via full sync
+            await c1.cmd("set", "boot", "v1")
+            await c1.cmd("incr", "hits")
+            # n1 meets n2
+            assert await c1.cmd("meet", apps[1].advertised_addr) == Simple(b"OK")
+            await converge(apps[:2])
+            # n3 meets n2 only — it must discover n1 transitively
+            c3 = await Client().connect(apps[2].advertised_addr)
+            assert await c3.cmd("meet", apps[1].advertised_addr) == Simple(b"OK")
+            await full_mesh(apps)
+            await converge(apps)
+            assert await c3.cmd("get", "boot") == Bulk(b"v1")
+            assert await c3.cmd("get", "hits") == Int(1)
+            await c1.close()
+            await c3.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+def test_replicas_and_forget(tmp_path):
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        try:
+            c1 = await Client().connect(apps[0].advertised_addr)
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await full_mesh(apps)
+            rows = await c1.cmd("replicas")
+            assert isinstance(rows, Arr) and len(rows.items) == 1
+            assert rows.items[0].items[3] == Bulk(b"alive")
+            # forget propagates to the peer too (replicated write)
+            assert await c1.cmd("forget", apps[1].advertised_addr) == Int(1)
+            rows = await c1.cmd("replicas")
+            assert rows.items[0].items[3] == Bulk(b"forgotten")
+            await c1.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+# -------------------------------------------------------------- convergence
+
+async def _mesh3(tmp_path, **kw):
+    apps = await make_cluster(3, str(tmp_path), **kw)
+    c = [await Client().connect(a.advertised_addr) for a in apps]
+    await c[0].cmd("meet", apps[1].advertised_addr)
+    await c[2].cmd("meet", apps[1].advertised_addr)
+    await full_mesh(apps)
+    return apps, c
+
+
+def test_counters_converge(tmp_path):
+    """(reference bin/test.rs:123-191 test_counters)"""
+    async def main():
+        apps, c = await _mesh3(tmp_path)
+        rng = random.Random(5)
+        try:
+            oracle = 0
+            for _ in range(300):
+                cli = c[rng.randrange(3)]
+                if rng.random() < 0.5:
+                    await cli.cmd("incr", "cnt")
+                    oracle += 1
+                else:
+                    await cli.cmd("decr", "cnt")
+                    oracle -= 1
+            await converge(apps)
+            for cli in c:
+                assert await cli.cmd("get", "cnt") == Int(oracle)
+            # interleave DEL: all nodes must still agree afterwards
+            for i in range(60):
+                cli = c[rng.randrange(3)]
+                if i % 10 == 9:
+                    await cli.cmd("del", "cnt")
+                else:
+                    await cli.cmd("incr", "cnt")
+            await converge(apps)
+            vals = {repr(await cli.cmd("get", "cnt")) for cli in c}
+            assert len(vals) == 1
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_bytes_converge(tmp_path):
+    """(reference bin/test.rs:193-220 test_bytes)"""
+    async def main():
+        apps, c = await _mesh3(tmp_path)
+        rng = random.Random(7)
+        keys = [f"b{i}" for i in range(5)]
+        try:
+            for _ in range(150):
+                cli = c[rng.randrange(3)]
+                k = rng.choice(keys)
+                if rng.random() < 0.85:
+                    await cli.cmd("set", k, f"v{rng.randrange(1000)}")
+                else:
+                    await cli.cmd("del", k)
+                await asyncio.sleep(0.002)  # ensure HLC ms advances: program
+                # order == uuid order, so the LWW winner is the last writer
+            await converge(apps)
+            for k in keys:
+                vals = {repr(await cli.cmd("get", k)) for cli in c}
+                assert len(vals) == 1, f"{k}: {vals}"
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_set_converge_with_oracle(tmp_path):
+    """(reference bin/test.rs:222-306 test_set)"""
+    async def main():
+        apps, c = await _mesh3(tmp_path)
+        rng = random.Random(11)
+        oracle: set[bytes] = set()
+        members = [b"m%d" % i for i in range(12)]
+        try:
+            for _ in range(200):
+                cli = c[rng.randrange(3)]
+                m = rng.choice(members)
+                if rng.random() < 0.65:
+                    await cli.cmd("sadd", b"s", m)
+                    oracle.add(m)
+                else:
+                    await cli.cmd("srem", b"s", m)
+                    oracle.discard(m)
+                await asyncio.sleep(0.002)
+            await converge(apps)
+            for cli in c:
+                got = await cli.cmd("smembers", b"s")
+                assert isinstance(got, Arr)
+                assert {i.val for i in got.items} == oracle
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_dict_converge_with_oracle(tmp_path):
+    """(reference bin/test.rs:308-398 test_dict)"""
+    async def main():
+        apps, c = await _mesh3(tmp_path)
+        rng = random.Random(13)
+        oracle: dict[bytes, bytes] = {}
+        fields = [b"f%d" % i for i in range(10)]
+        try:
+            for _ in range(200):
+                cli = c[rng.randrange(3)]
+                f = rng.choice(fields)
+                if rng.random() < 0.7:
+                    v = b"v%d" % rng.randrange(1000)
+                    await cli.cmd("hset", b"h", f, v)
+                    oracle[f] = v
+                else:
+                    await cli.cmd("hdel", b"h", f)
+                    oracle.pop(f, None)
+                await asyncio.sleep(0.002)
+            await converge(apps)
+            for cli in c:
+                got = await cli.cmd("hgetall", b"h")
+                assert isinstance(got, Arr)
+                pairs = {kv.items[0].val: kv.items[1].val for kv in got.items}
+                assert pairs == oracle
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
+# ------------------------------------------------------------ sync variants
+
+def test_full_sync_large_keyspace(tmp_path):
+    """A joiner pulls a multi-chunk snapshot through the MergeEngine."""
+    async def main():
+        apps = await make_cluster(2, str(tmp_path), snapshot_chunk_keys=128)
+        try:
+            n1 = apps[0].node
+            c1 = await Client().connect(apps[0].advertised_addr)
+            for i in range(700):
+                kind = i % 3
+                if kind == 0:
+                    await c1.cmd("incr", f"k{i}")
+                elif kind == 1:
+                    await c1.cmd("set", f"k{i}", f"v{i}")
+                else:
+                    await c1.cmd("sadd", f"k{i}", "a", "b")
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await converge(apps, timeout=30.0)
+            assert apps[1].node.ks.n_keys() == n1.ks.n_keys()
+            await c1.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+def test_partial_resync_after_restart(tmp_path):
+    """A peer that goes away and returns within the repl_log window gets an
+    incremental stream, not a snapshot (reference push.rs:91-111)."""
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        try:
+            c1 = await Client().connect(apps[0].advertised_addr)
+            await c1.cmd("set", "a", "1")
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await converge(apps)
+
+            # take n2 offline
+            await apps[1].close()
+            for _ in range(20):
+                await c1.cmd("incr", "cnt")
+
+            # restart n2's server on the same port with the same state
+            from constdb_tpu.server.io import ServerApp
+            app2 = ServerApp(apps[1].node, host="127.0.0.1",
+                             port=apps[1].port, work_dir=str(tmp_path),
+                             heartbeat=0.15, reconnect_delay=0.25)
+            await app2.start()
+            apps[1] = app2
+            full_before = apps[0].node.stats.extra.get("full_syncs_sent", 0)
+            await converge(apps, timeout=20.0)
+            c2 = await Client().connect(app2.advertised_addr)
+            assert await c2.cmd("get", "cnt") == Int(20)
+            assert apps[0].node.stats.extra.get("full_syncs_sent", 0) == \
+                full_before, "partial resync must not dump a snapshot"
+            await c1.close()
+            await c2.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+def test_full_resync_after_log_eviction(tmp_path):
+    """A peer that falls off the repl_log ring gets a fresh snapshot
+    mid-stream (the reference leaves this TODO — pull.rs:167-172)."""
+    async def main():
+        apps = await make_cluster(2, str(tmp_path), repl_log_cap=2_000)
+        try:
+            c1 = await Client().connect(apps[0].advertised_addr)
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await converge(apps)
+            await apps[1].close()
+            # push far more bytes than the ring holds
+            for i in range(300):
+                await c1.cmd("set", f"k{i}", "x" * 32)
+
+            from constdb_tpu.server.io import ServerApp
+            app2 = ServerApp(apps[1].node, host="127.0.0.1",
+                             port=apps[1].port, work_dir=str(tmp_path),
+                             heartbeat=0.15, reconnect_delay=0.25)
+            await app2.start()
+            apps[1] = app2
+            await converge(apps, timeout=20.0)
+            assert apps[0].node.stats.extra.get("full_syncs_sent", 0) >= 1
+            await c1.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+def test_gc_after_acks(tmp_path):
+    """Tombstones are physically collected once every peer acked past them
+    (reference server.rs:257-263 → db.rs:82-119)."""
+    async def main():
+        apps, c = await _mesh3(tmp_path)
+        try:
+            await c[0].cmd("sadd", "s", "a", "b", "c")
+            await converge(apps)
+            await c[0].cmd("srem", "s", "b")
+            await converge(apps)
+            # all peers ack; gc cron should eventually drop the tombstone row
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while True:
+                n1 = apps[0].node
+                live = [m for m, *_ in n1.ks.elem_all(
+                    n1.ks.lookup(b"s"))]
+                if b"b" not in live:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("tombstone never collected")
+                await asyncio.sleep(0.1)
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
