@@ -29,7 +29,7 @@ class CpuMergeEngine:
         for i in range(n):
             key = batch.keys[i]
             enc = int(batch.key_enc[i])
-            kid = store.index.get(key, -1)
+            kid = store.key_index.lookup(key)
             if kid < 0:
                 kid = store.create_key(key, enc, int(batch.key_ct[i]), int(batch.key_dt[i]))
                 store.keys.mt[kid] = batch.key_mt[i]

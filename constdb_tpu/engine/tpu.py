@@ -78,7 +78,16 @@ class TpuMergeEngine:
         # merge if slots are unique within every batch
         self._unique_ok = all(b.rows_unique_per_slot for b in batches)
         self._n0_keys = store.keys.n
-        resolved = [(b, self._resolve_keys(store, b, st)) for b in batches]
+        # replica snapshots of one keyspace often share the key-list object;
+        # resolve each distinct list once (ids are stable within this merge)
+        memo: dict[int, np.ndarray] = {}
+        resolved = []
+        for b in batches:
+            kid_of = memo.get(id(b.keys))
+            if kid_of is None:
+                kid_of = self._resolve_keys(store, b, st)
+                memo[id(b.keys)] = kid_of
+            resolved.append((b, kid_of))
         self._merge_envelopes(store, resolved)
         self._merge_registers(store, resolved)
         self._merge_counter_rows(store, resolved, st)
@@ -102,28 +111,24 @@ class TpuMergeEngine:
         st.keys_seen += n
         if n == 0:
             return np.zeros(0, dtype=_I64)
-        index = store.index
-        kid_of = np.fromiter((index.get(k, -1) for k in batch.keys),
-                             dtype=_I64, count=n)
-        missing = np.nonzero(kid_of < 0)[0]
-        if len(missing):
-            # a raw op-stream batch may repeat a key: create each unique key
-            # once and point every occurrence at the same row
-            by_key: dict = {}
-            for i in missing.tolist():
-                by_key.setdefault(batch.keys[i], []).append(i)
-            first = np.fromiter((poss[0] for poss in by_key.values()),
-                                dtype=_I64, count=len(by_key))
+        n0 = store.keys.n
+        # one native batch call: intern every key; new ids ARE the new rows
+        kid_of, n_new = store.key_index.get_or_insert_batch(batch.keys)
+        if n_new:
+            # a raw op-stream batch may repeat a key: append one row per new
+            # id, values from its first occurrence (np.unique's sorted order
+            # IS insertion order — interner ids grow with first occurrence)
+            created = np.nonzero(kid_of >= n0)[0]
+            uniq_ids, first = np.unique(kid_of[created], return_index=True)
+            pos = created[first]
             rows = store.keys.append_block(
-                len(first),
-                enc=batch.key_enc[first], ct=batch.key_ct[first], mt=0,
-                dt=batch.key_dt[first], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
-            store.key_bytes.extend(by_key.keys())
-            store.reg_val.extend([None] * len(first))
-            index.update(zip(by_key.keys(), rows.tolist()))
-            for poss, row in zip(by_key.values(), rows.tolist()):
-                kid_of[poss] = row
-            st.keys_created += len(first)
+                n_new,
+                enc=batch.key_enc[pos], ct=batch.key_ct[pos], mt=0,
+                dt=batch.key_dt[pos], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
+            assert rows[0] == uniq_ids[0] and rows[-1] == uniq_ids[-1]
+            store.key_bytes.extend(batch.keys[i] for i in pos.tolist())
+            store.reg_val.extend([None] * n_new)
+            st.keys_created += n_new
 
         # conflict check over ALL positions: duplicate occurrences of a key
         # created above must also match the enc the first occurrence chose
@@ -368,23 +373,18 @@ class TpuMergeEngine:
     def _resolve_cnt_rows(self, store: KeySpace, combos: np.ndarray) -> np.ndarray:
         """(kid, node) combo keys -> store cnt rows, bulk-creating missing
         slots as neutral (val=0, t=NEUTRAL_T)."""
-        cnt_index = store.cnt_index
-        rows = np.fromiter((cnt_index.get(c, -1) for c in combos.tolist()),
-                           dtype=_I64, count=len(combos))
-        miss = np.nonzero(rows < 0)[0]
-        if len(miss):
-            miss_combos, minv = np.unique(combos[miss], return_inverse=True)
+        n0 = store.cnt.n
+        rows, n_new = store.cnt_index.get_or_assign_batch(combos, next_val=n0)
+        if n_new:
+            created = np.nonzero(rows >= n0)[0]
+            uniq_rows, first = np.unique(rows[created], return_index=True)
+            cc = combos[created[first]]
             nodes = np.asarray(store.node_ids, dtype=_I64)[
-                miss_combos & ((1 << _RANK_BITS) - 1)]
-            new_rows = store.cnt.append_block(
-                len(miss_combos), kid=miss_combos >> _RANK_BITS,
-                node=nodes, val=0, uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
-            cnt_index.update(zip(miss_combos.tolist(), new_rows.tolist()))
-            by_kid = store.cnt_rows_by_kid
-            for combo, row in zip((miss_combos >> _RANK_BITS).tolist(),
-                                  new_rows.tolist()):
-                by_kid.setdefault(combo, []).append(row)
-            rows[miss] = new_rows[minv]
+                cc & ((1 << _RANK_BITS) - 1)]
+            got = store.cnt.append_block(
+                n_new, kid=cc >> _RANK_BITS, node=nodes, val=0,
+                uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
+            assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
         return rows
 
     # ------------------------------------------------------------- elements
@@ -392,9 +392,7 @@ class TpuMergeEngine:
     def _merge_elem_rows(self, store: KeySpace, resolved,
                          st: MergeStats) -> None:
         n0 = store.el.n
-        free_before = len(store.el_free)
         staged = []  # (rows, at, an, dt, vals, has_vals)
-        elems = store.elems
         for b, kid_of in resolved:
             if not len(b.el_ki):
                 continue
@@ -403,18 +401,25 @@ class TpuMergeEngine:
             if not len(keep):
                 continue
             st.elem_rows += len(keep)
-            rows = np.empty(len(keep), dtype=_I64)
-            members = b.el_member
-            for j, r in enumerate(keep):
-                kid = int(kid_arr[r])
-                member = members[r]
-                ems = elems.setdefault(kid, {})
-                row = ems.get(member, -1)
-                if row < 0:
-                    row = store._el_new_row(kid, member, None, 0, 0)
-                    ems[member] = row
-                rows[j] = row
-            vals = [b.el_val[r] for r in keep]
+            all_kept = len(keep) == len(b.el_ki)
+            members = b.el_member if all_kept else [b.el_member[r] for r in keep]
+            # two native batch calls: intern members, then resolve/create
+            # (kid, member) combo slots — no per-row Python
+            mids, _ = store.member_index.get_or_insert_batch(members)
+            combos = (kid_arr[keep] << KeySpace.MEMBER_BITS) | mids
+            rn0 = store.el.n
+            rows, n_new = store.el_index.get_or_assign_batch(combos,
+                                                             next_val=rn0)
+            if n_new:
+                created = np.nonzero(rows >= rn0)[0]
+                uniq_rows, first = np.unique(rows[created], return_index=True)
+                pos = created[first]
+                got = store.el.append_block(n_new, kid=kid_arr[keep][pos],
+                                            add_t=0, add_node=0, del_t=0)
+                assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
+                store.el_member.extend(members[i] for i in pos.tolist())
+                store.el_val.extend([None] * n_new)
+            vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
             staged.append((rows, b.el_add_t[keep], b.el_add_node[keep],
                            b.el_del_t[keep], vals,
                            any(v is not None for v in vals)))
@@ -423,9 +428,6 @@ class TpuMergeEngine:
         n = store.el.n
         total = sum(len(r) for r, *_ in staged)
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
-        if all_new and len(store.el_free) != free_before:
-            # recycled free-list rows break the contiguous-new-block argument
-            base, size, all_new = 0, n, False
 
         if self._use_bulk(total, size):
             sp = K.next_pow2(size)

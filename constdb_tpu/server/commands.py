@@ -270,7 +270,7 @@ def del_command(node, ctx, args):
 def delbytes_command(node, ctx, args):
     key = args.next_bytes()
     ks = node.ks
-    kid = ks.index.get(key, -1)
+    kid = ks.lookup(key)
     if kid < 0:
         # unlike the reference (cmd.rs:298-317 creates a LIVE empty key),
         # an unknown key materializes already-tombstoned: ct=0 < dt=uuid
@@ -363,7 +363,7 @@ def delcnt_command(node, ctx, args):
     delete-observed base (visible value becomes total - base)."""
     key = args.next_bytes()
     ks = node.ks
-    kid = ks.index.get(key, -1)
+    kid = ks.lookup(key)
     if kid < 0:
         # materialize already-tombstoned (ct=0 < dt) so bases still register
         kid = ks.create_key(key, S.ENC_COUNTER, 0)
@@ -450,7 +450,7 @@ def spop_command(node, ctx, args):
 def _del_collection(node, ctx, args, enc: int) -> Msg:
     key = args.next_bytes()
     ks = node.ks
-    kid = ks.index.get(key, -1)
+    kid = ks.lookup(key)
     if kid < 0:
         kid = ks.create_key(key, enc, 0)
     elif ks.enc_of(kid) != enc:
@@ -563,7 +563,7 @@ def expireat_command(node, ctx, args):
     key = args.next_bytes()
     exp_uuid = args.next_uint()
     ks = node.ks
-    kid = ks.index.get(key, -1)
+    kid = ks.lookup(key)
     if kid < 0:
         return Int(0)
     ks.expire_at(key, exp_uuid)

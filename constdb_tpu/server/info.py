@@ -99,7 +99,7 @@ def _section_keyspace(node, out):
         out.append(("dicts", int(counts[S.ENC_DICT])))
         out.append(("sets", int(counts[S.ENC_SET])))
     out.append(("counter_slots", ks.cnt.n))
-    out.append(("element_rows", ks.el.n - len(ks.el_free)))
+    out.append(("element_rows", ks.el.n - ks.el_dead))
     out.append(("pending_tombstones", len(ks.garbage)))
 
 

@@ -5,15 +5,24 @@ Capability parity with the reference's `DB` + per-key `Object` heap
 src/crdt/lwwhash.rs), redesigned TPU-first: all numeric CRDT state
 (envelope times, counter slots, element add/del times) lives in contiguous
 numpy columns so bulk merges stage to the device without per-row Python
-work.  Python dicts exist only as indexes from key/member bytes to rows.
+work.  Indexes from key/member bytes to rows are native C++ hash tables
+(native/tables.cpp via utils/native_tables.py) with batch entry points —
+the merge engine resolves a million rows in a handful of FFI calls.
 
 Tables:
   keys  — one row per key: enc, ct/mt/dt envelope, expire, register value
           (bytes in a side list) with its (write-time, writer-node), counter
-          sum cache.
-  cnt   — one row per (key, node) counter slot: val, uuid.
+          sum cache.  `key_index` (StrTable) maps key bytes -> row, and row
+          ids ARE interner ids (both assign in insertion order).
+  cnt   — one row per (key, node) counter slot: val, uuid, base, base_t.
+          `cnt_index` (I64Dict) maps (kid << NODE_RANK_BITS | rank) -> row.
   el    — one row per set-member / dict-field: add_t, add_node, del_t;
-          member/value bytes in side lists.  Rows freed by GC are recycled.
+          member/value bytes in side lists.  `member_index` (StrTable)
+          interns member bytes; `el_index` (I64Dict) maps
+          (kid << MEMBER_BITS | member_id) -> row.  GC marks rows dead
+          (kid = -1); `_compact_elements` rebuilds the columns once dead
+          rows dominate (no free-list — row ids stay stable between
+          compactions, which the batched engine relies on).
 
 Single-op serving methods implement the op-level rules of
 crdt/semantics.py; bulk merge goes through engine/ (MergeEngine boundary).
@@ -28,6 +37,7 @@ import numpy as np
 
 from ..crdt import semantics as S
 from ..errors import InvalidType
+from ..utils.native_tables import I64Dict, StrTable
 from .columns import Columns
 
 _I64 = np.int64
@@ -57,26 +67,33 @@ class _ElCols(Columns):
 
 
 class KeySpace:
+    NODE_RANK_BITS = 20  # up to ~1M distinct node ids per cluster lifetime
+    MEMBER_BITS = 32     # up to ~4G distinct member byte-strings
+    NEUTRAL_T = S.NEUTRAL_T
+
     def __init__(self) -> None:
         self.keys = _KeyCols()
         self.key_bytes: list[bytes] = []
-        self.index: dict[bytes, int] = {}
+        self.key_index = StrTable(8096)
         self.reg_val: list[Optional[bytes]] = []
 
-        # counter slots are indexed by an integer combo key
-        # (kid << NODE_RANK_BITS | node_rank) — int dict probes vectorize as
-        # C-speed list comprehensions in the batched engine
         self.cnt = _CntCols()
-        self.cnt_index: dict[int, int] = {}
-        self.cnt_rows_by_kid: dict[int, list[int]] = {}  # O(slots) per-key reads
+        self.cnt_index = I64Dict(4096)
+        # per-kid row lists are derived lazily from the columns (bulk merges
+        # append millions of rows; only point reads need the lists)
+        self.cnt_rows_by_kid: dict[int, list[int]] = {}
+        self._cnt_synced = 0
         self.node_rank: dict[int, int] = {}
         self.node_ids: list[int] = []
 
         self.el = _ElCols()
         self.el_member: list[Optional[bytes]] = []
         self.el_val: list[Optional[bytes]] = []
-        self.elems: dict[int, dict[bytes, int]] = {}
-        self.el_free: list[int] = []
+        self.member_index = StrTable(8192)
+        self.el_index = I64Dict(8192)
+        self.el_rows_by_kid: dict[int, list[int]] = {}
+        self._el_synced = 0
+        self.el_dead = 0
 
         # key-level tombstone record for snapshot DELETES + GC
         # (parity: reference db.rs `deletes` map)
@@ -91,7 +108,7 @@ class KeySpace:
     # ------------------------------------------------------------------ keys
 
     def lookup(self, key: bytes) -> int:
-        return self.index.get(key, -1)
+        return self.key_index.lookup(key)
 
     def n_keys(self) -> int:
         return self.keys.n
@@ -101,12 +118,13 @@ class KeySpace:
                                rv_t=0, rv_node=0, cnt_sum=0)
         self.key_bytes.append(key)
         self.reg_val.append(None)
-        self.index[key] = kid
+        iid = self.key_index.get_or_insert(key)
+        assert iid == kid, f"key index desync: {iid} != {kid}"
         return kid
 
     def get_or_create(self, key: bytes, enc: int, uuid: int) -> tuple[int, bool]:
         """Existing row (type-checked) or a fresh one created at `uuid`."""
-        kid = self.index.get(key, -1)
+        kid = self.key_index.lookup(key)
         if kid < 0:
             return self.create_key(key, enc, uuid), True
         if int(self.keys.enc[kid]) != enc:
@@ -116,7 +134,7 @@ class KeySpace:
     def query(self, key: bytes, uuid: int) -> int:
         """kid or -1; lazily applies a due expiry as a key-level delete
         (parity: reference db.rs:53-66)."""
-        kid = self.index.get(key, -1)
+        kid = self.key_index.lookup(key)
         if kid < 0:
             return -1
         exp = int(self.keys.expire[kid])
@@ -155,7 +173,7 @@ class KeySpace:
 
     def expire_at(self, key: bytes, t: int) -> None:
         """Latest expiry wins (max-merge; see semantics.py header)."""
-        kid = self.index.get(key, -1)
+        kid = self.key_index.lookup(key)
         if kid >= 0 and t > int(self.keys.expire[kid]):
             self.keys.expire[kid] = t
 
@@ -170,8 +188,6 @@ class KeySpace:
 
     # -------------------------------------------------------------- counters
 
-    NODE_RANK_BITS = 20  # up to ~1M distinct node ids per cluster lifetime
-
     def rank_of(self, node: int) -> int:
         """Dense rank for a node id (monotone in registration order)."""
         r = self.node_rank.get(node)
@@ -183,8 +199,6 @@ class KeySpace:
             self.node_ids.append(node)
         return r
 
-    NEUTRAL_T = S.NEUTRAL_T  # "never written" timestamp for either LWW pair
-
     def _cnt_row(self, kid: int, node: int) -> int:
         """Existing or fresh (both pairs unwritten) slot row."""
         combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
@@ -192,9 +206,16 @@ class KeySpace:
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=0, uuid=self.NEUTRAL_T,
                                   base=0, base_t=self.NEUTRAL_T)
-            self.cnt_index[combo] = row
-            self.cnt_rows_by_kid.setdefault(kid, []).append(row)
+            self.cnt_index.put(combo, row)
         return row
+
+    def _sync_cnt_lists(self) -> None:
+        n = self.cnt.n
+        if self._cnt_synced < n:
+            by_kid = self.cnt_rows_by_kid
+            for off, kid in enumerate(self.cnt.kid[self._cnt_synced:n].tolist()):
+                by_kid.setdefault(kid, []).append(self._cnt_synced + off)
+            self._cnt_synced = n
 
     def counter_change(self, kid: int, node: int, delta: int, uuid: int) -> tuple[int, int]:
         """Local INCR/DECR on the caller's own slot: the cumulative lifetime
@@ -239,6 +260,7 @@ class KeySpace:
 
     def counter_slots(self, kid: int) -> list[tuple[int, int, int, int, int]]:
         """[(node, total, uuid, base, base_t)] for DESC / DEL / snapshot."""
+        self._sync_cnt_lists()
         out = []
         for row in self.cnt_rows_by_kid.get(kid, ()):
             out.append((int(self.cnt.node[row]), int(self.cnt.val[row]),
@@ -296,6 +318,17 @@ class KeySpace:
 
     # -------------------------------------------------------------- elements
 
+    def el_combo(self, kid: int, member: bytes) -> int:
+        """Stable combo id for an element slot; interns the member bytes."""
+        mid = self.member_index.get_or_insert(member)
+        return (kid << self.MEMBER_BITS) | mid
+
+    def el_row(self, kid: int, member: bytes) -> int:
+        mid = self.member_index.lookup(member)
+        if mid < 0:
+            return -1
+        return self.el_index.get((kid << self.MEMBER_BITS) | mid, -1)
+
     def elem_add(self, kid: int, member: bytes, val: Optional[bytes],
                  uuid: int, node: int) -> bool:
         """SADD member / HSET field: pure pointwise add-side LWW write, so
@@ -304,11 +337,10 @@ class KeySpace:
         or the stored add time — lwwhash.rs:87-107 — which leaves replicas
         that saw different op interleavings with different hidden state.)
         Returns True iff the member became visible by this op."""
-        ems = self.elems.setdefault(kid, {})
-        row = ems.get(member, -1)
+        combo = self.el_combo(kid, member)
+        row = self.el_index.get(combo, -1)
         if row < 0:
-            row = self._el_new_row(kid, member, val, uuid, node)
-            ems[member] = row
+            self._el_new_row(combo, kid, member, val, uuid, node)
             return True  # del_t == 0 → visible
         at, an = int(self.el.add_t[row]), int(self.el.add_node[row])
         dt = int(self.el.del_t[row])
@@ -323,13 +355,12 @@ class KeySpace:
         """SREM member / HDEL field: pure pointwise del-side max (see
         elem_add; reference lwwhash.rs:109-128 drops dels older than the
         stored add time).  Returns True iff the member became invisible."""
-        ems = self.elems.setdefault(kid, {})
-        row = ems.get(member, -1)
+        combo = self.el_combo(kid, member)
+        row = self.el_index.get(combo, -1)
         if row < 0:
             # record the tombstone, but an absent member was not "removed"
-            row = self._el_new_row(kid, member, None, 0, 0)
+            row = self._el_new_row(combo, kid, member, None, 0, 0)
             self.el.del_t[row] = uuid
-            ems[member] = row
             self._enqueue_garbage(uuid, self.key_bytes[kid], member)
             return False
         at, dt = int(self.el.add_t[row]), int(self.el.del_t[row])
@@ -342,34 +373,48 @@ class KeySpace:
 
     def elem_get(self, kid: int, member: bytes) -> Optional[bytes]:
         """Live dict-field value or None."""
-        row = self.elems.get(kid, {}).get(member, -1)
+        row = self.el_row(kid, member)
         if row < 0:
             return None
         if S.elem_alive(int(self.el.add_t[row]), int(self.el.del_t[row])):
             return self.el_val[row]
         return None
 
+    def _sync_el_lists(self) -> None:
+        n = self.el.n
+        if self._el_synced < n:
+            by_kid = self.el_rows_by_kid
+            for off, kid in enumerate(self.el.kid[self._el_synced:n].tolist()):
+                by_kid.setdefault(kid, []).append(self._el_synced + off)
+            self._el_synced = n
+
+    def _live_rows(self, kid: int) -> Iterator[int]:
+        self._sync_el_lists()
+        for row in self.el_rows_by_kid.get(kid, ()):
+            if int(self.el.kid[row]) == kid:
+                yield row
+
     def elem_live(self, kid: int) -> Iterator[tuple[bytes, Optional[bytes], int]]:
         """(member, value, add_t) for visible elements."""
-        for member, row in self.elems.get(kid, {}).items():
+        for row in self._live_rows(kid):
             if S.elem_alive(int(self.el.add_t[row]), int(self.el.del_t[row])):
-                yield member, self.el_val[row], int(self.el.add_t[row])
+                yield self.el_member[row], self.el_val[row], int(self.el.add_t[row])
 
     def elem_all(self, kid: int) -> Iterator[tuple[bytes, int, int, int, Optional[bytes]]]:
         """(member, add_t, add_node, del_t, value) incl. tombstones."""
-        for member, row in self.elems.get(kid, {}).items():
-            yield (member, int(self.el.add_t[row]), int(self.el.add_node[row]),
-                   int(self.el.del_t[row]), self.el_val[row])
+        for row in self._live_rows(kid):
+            yield (self.el_member[row], int(self.el.add_t[row]),
+                   int(self.el.add_node[row]), int(self.el.del_t[row]),
+                   self.el_val[row])
 
     def elem_merge(self, kid: int, member: bytes, add_t: int, add_node: int,
                    del_t: int, val: Optional[bytes]) -> None:
         """State-merge of one foreign element (CPU merge engine)."""
-        ems = self.elems.setdefault(kid, {})
-        row = ems.get(member, -1)
+        combo = self.el_combo(kid, member)
+        row = self.el_index.get(combo, -1)
         if row < 0:
-            row = self._el_new_row(kid, member, val, add_t, add_node)
+            row = self._el_new_row(combo, kid, member, val, add_t, add_node)
             self.el.del_t[row] = del_t
-            ems[member] = row
             if add_t < del_t:
                 self._enqueue_garbage(del_t, self.key_bytes[kid], member)
             return
@@ -384,20 +429,12 @@ class KeySpace:
         if at < dt and dt > d0:
             self._enqueue_garbage(dt, self.key_bytes[kid], member)
 
-    def _el_new_row(self, kid: int, member: bytes, val: Optional[bytes],
-                    add_t: int, add_node: int) -> int:
-        if self.el_free:
-            row = self.el_free.pop()
-            self.el.kid[row] = kid
-            self.el.add_t[row] = add_t
-            self.el.add_node[row] = add_node
-            self.el.del_t[row] = 0
-            self.el_member[row] = member
-            self.el_val[row] = val
-            return row
+    def _el_new_row(self, combo: int, kid: int, member: bytes,
+                    val: Optional[bytes], add_t: int, add_node: int) -> int:
         row = self.el.append(kid=kid, add_t=add_t, add_node=add_node, del_t=0)
         self.el_member.append(member)
         self.el_val.append(val)
+        self.el_index.put(combo, row)
         return row
 
     # ------------------------------------------------------------------- GC
@@ -417,28 +454,60 @@ class KeySpace:
                     del self.key_deletes[key]
                     freed += 1
                 continue
-            kid = self.index.get(key, -1)
+            kid = self.key_index.lookup(key)
             if kid < 0:
                 continue
-            row = self.elems.get(kid, {}).get(member, -1)
+            row = self.el_row(kid, member)
             if row < 0:
                 continue
             at, dt = int(self.el.add_t[row]), int(self.el.del_t[row])
             if at < dt and dt <= horizon:
-                del self.elems[kid][member]
+                mid = self.member_index.lookup(member)
+                self.el_index.delete((kid << self.MEMBER_BITS) | mid)
                 self.el.kid[row] = -1
                 self.el_member[row] = None
                 self.el_val[row] = None
-                self.el_free.append(row)
+                self.el_dead += 1
                 freed += 1
+        if self.el_dead > 10_000 and self.el_dead * 2 > self.el.n:
+            self._compact_elements()
         return freed
+
+    def _compact_elements(self) -> None:
+        """Rebuild element storage without dead rows (replaces free-list
+        reuse: row ids must stay stable BETWEEN compactions so the batched
+        engine's staged row indices never alias)."""
+        n = self.el.n
+        live = np.nonzero(self.el.kid[:n] >= 0)[0]
+        new_el = _ElCols()
+        new_el.append_block(len(live), kid=self.el.kid[live],
+                            add_t=self.el.add_t[live],
+                            add_node=self.el.add_node[live],
+                            del_t=self.el.del_t[live])
+        members = [self.el_member[r] for r in live.tolist()]
+        self.el_val = [self.el_val[r] for r in live.tolist()]
+        self.el_member = members
+        self.el = new_el
+        self.el_dead = 0
+        # rebuild combo index + per-kid lists with the new row ids
+        self.el_index = I64Dict(max(len(live), 16))
+        by_kid: dict[int, list[int]] = {}
+        kids = new_el.kid[: new_el.n].tolist()
+        if members:
+            mids, _ = self.member_index.get_or_insert_batch(members)
+            combos = (np.asarray(kids, dtype=_I64) << self.MEMBER_BITS) | mids
+            self.el_index.put_batch(combos, np.arange(len(live), dtype=_I64))
+        for row, kid in enumerate(kids):
+            by_kid.setdefault(kid, []).append(row)
+        self.el_rows_by_kid = by_kid
+        self._el_synced = new_el.n
 
     # ------------------------------------------------------------ inspection
 
     def canonical(self) -> dict:
         """Full logical state (incl. tombstones) for convergence checks."""
         out = {}
-        for key, kid in self.index.items():
+        for kid, key in enumerate(self.key_bytes):
             enc = int(self.keys.enc[kid])
             ct, mt, dt = self.envelope(kid)
             if enc == S.ENC_COUNTER:

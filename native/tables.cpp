@@ -1,0 +1,281 @@
+// Native staging tables for the merge hot path (C++17, no deps).
+//
+// The TPU merge engine's host-side cost is index resolution: key bytes ->
+// row, (key,node) combo -> counter slot, (key,member) combo -> element row.
+// In Python these are dict probes at ~100ns each over millions of rows; here
+// they are open-addressing tables with batch entry points called once per
+// column via ctypes (constdb_tpu/utils/native_tables.py).
+//
+//   StrTable — bytes -> dense id (insertion order).  Strings are copied into
+//              an arena; id -> (offset,len) lets callers recover bytes.
+//   I64Table — int64 -> int64 with tombstone deletion and batch
+//              lookup/assign; used for integer combo keys.
+//
+// Hashing: splitmix64 finalizer for ints, FNV-1a + splitmix for strings.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ULL;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+    return x ^ (x >> 31);
+}
+
+inline uint64_t hash_bytes(const uint8_t* p, int64_t len) {
+    uint64_t h = 0xCBF29CE484222325ULL;
+    for (int64_t i = 0; i < len; i++) {
+        h ^= p[i];
+        h *= 0x100000001B3ULL;
+    }
+    return splitmix64(h);
+}
+
+inline size_t next_pow2(size_t n) {
+    size_t p = 16;
+    while (p < n) p <<= 1;
+    return p;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------ StrTable
+
+struct StrTable {
+    // slot: id+1 (0 = empty); ids index into offs/lens
+    std::vector<int64_t> slots;
+    std::vector<uint64_t> hashes;   // per-slot cached hash
+    std::vector<uint8_t> arena;
+    std::vector<int64_t> offs;      // per-id arena offset
+    std::vector<int64_t> lens;      // per-id length
+    size_t mask = 0;
+    size_t count = 0;
+
+    explicit StrTable(size_t cap_hint) {
+        size_t cap = next_pow2(cap_hint * 2);
+        slots.assign(cap, 0);
+        hashes.assign(cap, 0);
+        mask = cap - 1;
+    }
+
+    void grow() {
+        size_t cap = slots.size() * 2;
+        std::vector<int64_t> ns(cap, 0);
+        std::vector<uint64_t> nh(cap, 0);
+        size_t nm = cap - 1;
+        for (size_t i = 0; i < slots.size(); i++) {
+            if (!slots[i]) continue;
+            size_t j = hashes[i] & nm;
+            while (ns[j]) j = (j + 1) & nm;
+            ns[j] = slots[i];
+            nh[j] = hashes[i];
+        }
+        slots.swap(ns);
+        hashes.swap(nh);
+        mask = nm;
+    }
+
+    inline bool eq(int64_t id, const uint8_t* p, int64_t len) const {
+        return lens[id] == len &&
+               std::memcmp(arena.data() + offs[id], p, (size_t)len) == 0;
+    }
+
+    int64_t lookup(const uint8_t* p, int64_t len) const {
+        uint64_t h = hash_bytes(p, len);
+        size_t j = h & mask;
+        while (slots[j]) {
+            if (hashes[j] == h && eq(slots[j] - 1, p, len)) return slots[j] - 1;
+            j = (j + 1) & mask;
+        }
+        return -1;
+    }
+
+    int64_t get_or_insert(const uint8_t* p, int64_t len) {
+        uint64_t h = hash_bytes(p, len);
+        size_t j = h & mask;
+        while (slots[j]) {
+            if (hashes[j] == h && eq(slots[j] - 1, p, len)) return slots[j] - 1;
+            j = (j + 1) & mask;
+        }
+        int64_t id = (int64_t)count;
+        offs.push_back((int64_t)arena.size());
+        lens.push_back(len);
+        arena.insert(arena.end(), p, p + len);
+        slots[j] = id + 1;
+        hashes[j] = h;
+        count++;
+        if (count * 10 >= slots.size() * 7) grow();
+        return id;
+    }
+};
+
+extern "C" {
+
+StrTable* cst_strtab_new(int64_t cap_hint) {
+    return new StrTable((size_t)(cap_hint > 0 ? cap_hint : 16));
+}
+void cst_strtab_free(StrTable* t) { delete t; }
+int64_t cst_strtab_len(StrTable* t) { return (int64_t)t->count; }
+
+int64_t cst_strtab_get_or_insert(StrTable* t, const uint8_t* p, int64_t len) {
+    return t->get_or_insert(p, len);
+}
+int64_t cst_strtab_lookup(StrTable* t, const uint8_t* p, int64_t len) {
+    return t->lookup(p, len);
+}
+
+// blob + offs[n+1] (offs[i]..offs[i+1] delimits item i) -> out_ids[n];
+// returns how many ids are new.
+int64_t cst_strtab_get_or_insert_batch(StrTable* t, const uint8_t* blob,
+                                       const int64_t* offs, int64_t n,
+                                       int64_t* out_ids) {
+    int64_t before = (int64_t)t->count;
+    for (int64_t i = 0; i < n; i++)
+        out_ids[i] = t->get_or_insert(blob + offs[i], offs[i + 1] - offs[i]);
+    return (int64_t)t->count - before;
+}
+
+void cst_strtab_lookup_batch(StrTable* t, const uint8_t* blob,
+                             const int64_t* offs, int64_t n, int64_t* out) {
+    for (int64_t i = 0; i < n; i++)
+        out[i] = t->lookup(blob + offs[i], offs[i + 1] - offs[i]);
+}
+
+int64_t cst_strtab_bytes_len(StrTable* t, int64_t id) {
+    return (id >= 0 && (size_t)id < t->count) ? t->lens[id] : -1;
+}
+void cst_strtab_bytes_get(StrTable* t, int64_t id, uint8_t* out) {
+    if (id >= 0 && (size_t)id < t->count)
+        std::memcpy(out, t->arena.data() + t->offs[id], (size_t)t->lens[id]);
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------------ I64Table
+
+struct I64Table {
+    static constexpr int64_t kEmpty = INT64_MIN;
+    static constexpr int64_t kTomb = INT64_MIN + 1;
+    std::vector<int64_t> keys;
+    std::vector<int64_t> vals;
+    size_t mask = 0;
+    size_t count = 0;   // live entries
+    size_t used = 0;    // live + tombstones
+
+    explicit I64Table(size_t cap_hint) {
+        size_t cap = next_pow2(cap_hint * 2);
+        keys.assign(cap, kEmpty);
+        vals.assign(cap, 0);
+        mask = cap - 1;
+    }
+
+    void rehash(size_t cap) {
+        std::vector<int64_t> nk(cap, kEmpty), nv(cap, 0);
+        size_t nm = cap - 1;
+        for (size_t i = 0; i < keys.size(); i++) {
+            if (keys[i] == kEmpty || keys[i] == kTomb) continue;
+            size_t j = splitmix64((uint64_t)keys[i]) & nm;
+            while (nk[j] != kEmpty) j = (j + 1) & nm;
+            nk[j] = keys[i];
+            nv[j] = vals[i];
+        }
+        keys.swap(nk);
+        vals.swap(nv);
+        mask = nm;
+        used = count;
+    }
+
+    inline void maybe_grow() {
+        if (used * 10 >= keys.size() * 7)
+            rehash(count * 10 >= keys.size() * 4 ? keys.size() * 2 : keys.size());
+    }
+
+    int64_t get(int64_t k, int64_t dflt) const {
+        size_t j = splitmix64((uint64_t)k) & mask;
+        while (keys[j] != kEmpty) {
+            if (keys[j] == k) return vals[j];
+            j = (j + 1) & mask;
+        }
+        return dflt;
+    }
+
+    void put(int64_t k, int64_t v) {
+        size_t j = splitmix64((uint64_t)k) & mask;
+        size_t tomb = SIZE_MAX;
+        while (keys[j] != kEmpty) {
+            if (keys[j] == k) { vals[j] = v; return; }
+            if (keys[j] == kTomb && tomb == SIZE_MAX) tomb = j;
+            j = (j + 1) & mask;
+        }
+        if (tomb != SIZE_MAX) {
+            keys[tomb] = k;
+            vals[tomb] = v;
+            count++;
+        } else {
+            keys[j] = k;
+            vals[j] = v;
+            count++;
+            used++;
+            maybe_grow();
+        }
+    }
+
+    int64_t del(int64_t k, int64_t dflt) {
+        size_t j = splitmix64((uint64_t)k) & mask;
+        while (keys[j] != kEmpty) {
+            if (keys[j] == k) {
+                int64_t v = vals[j];
+                keys[j] = kTomb;
+                count--;
+                return v;
+            }
+            j = (j + 1) & mask;
+        }
+        return dflt;
+    }
+};
+
+extern "C" {
+
+I64Table* cst_i64_new(int64_t cap_hint) {
+    return new I64Table((size_t)(cap_hint > 0 ? cap_hint : 16));
+}
+void cst_i64_free(I64Table* t) { delete t; }
+int64_t cst_i64_len(I64Table* t) { return (int64_t)t->count; }
+
+int64_t cst_i64_get(I64Table* t, int64_t k, int64_t dflt) { return t->get(k, dflt); }
+void cst_i64_put(I64Table* t, int64_t k, int64_t v) { t->put(k, v); }
+int64_t cst_i64_del(I64Table* t, int64_t k, int64_t dflt) { return t->del(k, dflt); }
+
+void cst_i64_lookup_batch(I64Table* t, const int64_t* ks, int64_t n,
+                          int64_t dflt, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) out[i] = t->get(ks[i], dflt);
+}
+
+void cst_i64_put_batch(I64Table* t, const int64_t* ks, const int64_t* vs,
+                       int64_t n) {
+    for (int64_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
+}
+
+// missing keys get sequential values starting at `next` (first-occurrence
+// order); returns the count of newly assigned keys.
+int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
+                                    int64_t next, int64_t* out) {
+    int64_t start = next;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t v = t->get(ks[i], INT64_MIN);
+        if (v == INT64_MIN) {
+            v = next++;
+            t->put(ks[i], v);
+        }
+        out[i] = v;
+    }
+    return next - start;
+}
+
+}  // extern "C"

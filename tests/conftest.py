@@ -1,20 +1,30 @@
 """Test harness configuration.
 
-Tests prefer the virtual 8-device CPU platform so multi-chip sharding
-(parallel/) is exercised without TPU hardware.  If the axon TPU plugin was
-already bound by sitecustomize (it loads before any conftest), these env
-vars cannot take effect in-process — tests then run on the TPU, and the
-sharded-mesh suite re-launches itself in a subprocess with a clean
-environment (see tests/test_sharded_merge.py).
+Tests run on the virtual 8-device CPU platform by default, so CRDT
+semantics, the merge engines, and the multi-chip sharding (parallel/) are
+exercised fast and without TPU hardware — and without depending on the
+health of a tunnel-attached device (a wedged device would hang the whole
+suite at backend init).  Set CONSTDB_TEST_TPU=1 to run against the real
+chip instead.
+
+Forcing CPU needs care here: the environment's sitecustomize registers the
+axon TPU plugin and sets `jax_platforms="axon,cpu"` through jax.config,
+which OVERRIDES the JAX_PLATFORMS env var — so this conftest overrides it
+back at the config level before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if not os.environ.get("CONSTDB_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -24,7 +34,7 @@ CPU_MESH_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
     "JAX_ENABLE_X64": "true",
-    "CONSTDB_MESH_RERUN": "1",  # recursion guard for the subprocess re-run
+    "CONSTDB_MESH_RERUN": "1",  # recursion guard for subprocess re-runs
 }
 
 

@@ -284,7 +284,7 @@ def test_expire_fires_via_hlc():
     n.clock = clk
     run(n, "set", "k", "v")
     # bypass wall clock: expire at an absolute uuid just past now
-    kid = n.ks.index[b"k"]
+    kid = n.ks.lookup(b"k")
     exp_uuid = (clk.ms + 5) << 22
     n.ks.expire_at(b"k", exp_uuid)
     assert run(n, "get", "k") == Bulk(b"v")
@@ -360,8 +360,8 @@ def test_gc_frees_acked_tombstones():
     n = mknode()
     run(n, "sadd", "s", "a", "b")
     run(n, "srem", "s", "a")
-    kid = n.ks.index[b"s"]
-    assert len(n.ks.elems[kid]) == 2
+    kid = n.ks.lookup(b"s")
+    assert len(list(n.ks.elem_all(kid))) == 2
     freed = n.gc()  # standalone: horizon = own clock
     assert freed >= 1
-    assert len(n.ks.elems[kid]) == 1
+    assert len(list(n.ks.elem_all(kid))) == 1

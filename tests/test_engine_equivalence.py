@@ -14,16 +14,17 @@ from constdb_tpu.store import KeySpace
 from test_merge_properties import gen_store
 
 
-@pytest.fixture(scope="module", params=["dense", "scatter"])
+@pytest.fixture(scope="module", params=["bulk", "scatter"])
 def engines(request):
     tpu = TpuMergeEngine()
     # force the chooser: both device strategies must match the CPU engine
-    tpu.DENSE_FRACTION = 10**18 if request.param == "dense" else 0
+    # (bulk needs rows-unique batches; those tests fall back to scatter)
+    tpu.BULK_FRACTION = 10**18 if request.param == "bulk" else 0
     return CpuMergeEngine(), tpu
 
 
 def both_sums(ks):
-    return {k: ks.counter_sum(kid) for k, kid in ks.index.items()
+    return {k: ks.counter_sum(kid) for kid, k in enumerate(ks.key_bytes)
             if ks.enc_of(kid) == ENC_COUNTER}
 
 
@@ -80,7 +81,7 @@ def test_gc_after_tpu_merge_matches_cpu(engines, seed):
     assert a.canonical() == b.canonical()
     # all dead elements must have been collected identically
     for ks in (a, b):
-        for key, kid in ks.index.items():
+        for kid, key in enumerate(ks.key_bytes):
             if ks.enc_of(kid) in (ENC_SET, ENC_DICT):
                 for m, at, an, dt, v in ks.elem_all(kid):
                     assert at >= dt, (key, m)

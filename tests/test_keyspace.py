@@ -139,10 +139,10 @@ class TestGC:
         ks.elem_rem(kid, b"a", t(3))
         ks.elem_rem(kid, b"b", t(8))
         assert ks.gc(t(5)) == 1  # only "a" is past the horizon
-        assert b"a" not in ks.elems[kid]
-        assert b"b" in ks.elems[kid]
+        assert ks.el_row(kid, b"a") < 0
+        assert ks.el_row(kid, b"b") >= 0
         assert ks.gc(t(9)) == 1
-        assert b"b" not in ks.elems[kid]
+        assert ks.el_row(kid, b"b") < 0
 
     def test_readded_member_not_collected(self):
         ks = KeySpace()
@@ -153,16 +153,25 @@ class TestGC:
         ks.gc(t(10))
         assert [m for m, _, _ in ks.elem_live(kid)] == [b"m"]
 
-    def test_row_reuse_after_gc(self):
+    def test_dead_rows_compact(self):
+        """GC marks rows dead; compaction rebuilds columns + indexes and
+        keeps surviving rows addressable."""
         ks = KeySpace()
         kid, _ = ks.get_or_create(b"s", ENC_SET, t(1))
-        ks.elem_add(kid, b"m", None, t(2), node=1)
-        ks.elem_rem(kid, b"m", t(3))
+        for i in range(50):
+            ks.elem_add(kid, b"m%d" % i, None, t(2), node=1)
+        for i in range(40):
+            ks.elem_rem(kid, b"m%d" % i, t(3))
         ks.gc(t(10))
-        assert ks.el_free
+        assert ks.el_dead == 40
+        ks._compact_elements()
+        assert ks.el_dead == 0 and ks.el.n == 10
+        live = sorted(m for m, _, _ in ks.elem_live(kid))
+        assert live == sorted(b"m%d" % i for i in range(40, 50))
+        # rows remain addressable through the rebuilt index
+        assert all(ks.el_row(kid, m) >= 0 for m in live)
         ks.elem_add(kid, b"x", None, t(11), node=1)
-        assert not ks.el_free  # freed row recycled
-        assert [m for m, _, _ in ks.elem_live(kid)] == [b"x"]
+        assert [m for m, _, _ in ks.elem_live(kid)].count(b"x") == 1
 
     def test_key_delete_record_gc(self):
         ks = KeySpace()

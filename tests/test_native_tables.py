@@ -1,0 +1,92 @@
+"""Native staging tables vs the pure-Python fallback: behavioral equality."""
+
+import numpy as np
+import pytest
+
+from constdb_tpu.utils import native_tables as nt
+
+
+def impls_str():
+    yield nt._PyStrTable
+    if nt.load_native():
+        yield nt._NativeStrTable
+
+
+def impls_i64():
+    yield nt._PyI64Dict
+    if nt.load_native():
+        yield nt._NativeI64Dict
+
+
+@pytest.mark.parametrize("cls", list(impls_str()))
+def test_strtab_basic(cls):
+    t = cls(4)
+    assert t.lookup(b"a") == -1
+    assert t.get_or_insert(b"a") == 0
+    assert t.get_or_insert(b"b") == 1
+    assert t.get_or_insert(b"a") == 0
+    assert t.lookup(b"b") == 1
+    assert len(t) == 2
+    assert t.bytes_of(0) == b"a"
+    assert t.bytes_of(1) == b"b"
+
+
+@pytest.mark.parametrize("cls", list(impls_str()))
+def test_strtab_batch_and_growth(cls):
+    rng = np.random.default_rng(0)
+    items = [b"key:%d" % i for i in rng.integers(0, 5000, 20000)]
+    t = cls(4)
+    ids, n_new = t.get_or_insert_batch(items)
+    assert n_new == len(set(items)) == len(t)
+    # same item -> same id; ids assigned in first-occurrence order
+    seen = {}
+    for b, i in zip(items, ids.tolist()):
+        assert seen.setdefault(b, i) == i
+    assert t.lookup_batch(items).tolist() == ids.tolist()
+    assert t.lookup_batch([b"nope"]).tolist() == [-1]
+    # empty string is a valid key
+    assert t.get_or_insert(b"") == len(seen)
+
+
+@pytest.mark.parametrize("cls", list(impls_i64()))
+def test_i64_basic(cls):
+    t = cls(4)
+    assert t.get(7) == -1
+    t.put(7, 70)
+    t.put(-3, 30)
+    assert t.get(7) == 70
+    assert t.get(-3) == 30
+    assert len(t) == 2
+    assert t.delete(7) == 70
+    assert t.get(7) == -1
+    assert len(t) == 1
+    t.put(7, 71)  # reinsert over tombstone
+    assert t.get(7) == 71
+
+
+@pytest.mark.parametrize("cls", list(impls_i64()))
+def test_i64_batch(cls):
+    rng = np.random.default_rng(1)
+    keys = rng.integers(-10**12, 10**12, 30000)
+    t = cls(4)
+    vals, n_new = t.get_or_assign_batch(keys, next_val=100)
+    uniq = len(np.unique(keys))
+    assert n_new == uniq == len(t)
+    # stable mapping
+    vals2, n_new2 = t.get_or_assign_batch(keys, next_val=100 + n_new)
+    assert n_new2 == 0
+    assert np.array_equal(vals, vals2)
+    assert np.array_equal(t.lookup_batch(keys), vals)
+    # deletes then reinserts keep other keys intact
+    for k in keys[:100].tolist():
+        t.delete(k)
+    got = t.lookup_batch(keys[:100])
+    uniq_first = set(keys[:100].tolist())
+    later = keys[100:]
+    still = np.isin(keys[:100], later)
+    assert all((g != -1) == bool(s) for g, s in zip(got.tolist(), still))
+
+
+def test_native_available():
+    """The built .so should be present in this repo (make -C native)."""
+    assert nt.load_native() is not None
