@@ -1,6 +1,6 @@
 """Batched JAX MergeEngine: the TPU path for bulk CRDT merges.
 
-Two device strategies, picked per CRDT family by batch density:
+Device strategies, picked per CRDT family:
 
   * bulk (the fast path, ops/bulk.py): each batch ships as COMPACT rows
     (int32 slot ids + value columns) and folds into full per-slot device
@@ -10,19 +10,25 @@ Two device strategies, picked per CRDT family by batch density:
     new — snapshot ingest into an empty region — the initial state is
     materialized ON device and only the merged block downloads.
   * scatter (ops/segment.py): touched-slot gather + scatter-max kernels.
-    Chosen for sparse merges (steady-state replication trickle) where
-    uploading the full state would dwarf the rows.
+    Chosen for sparse merges when state is host-resident.
+
+**Resident mode** (`TpuMergeEngine(resident=True)`): the per-family device
+state persists ACROSS merge calls, so streaming replica catch-up — the
+replica link applies a snapshot chunk-by-chunk, and each chunk is one
+`merge()` — pays row uploads only, never a state round-trip per chunk.
+Merged state flushes back to the host keyspace lazily (`flush()`), which
+the Node triggers before any command touches the numeric plane
+(`Node.ensure_flushed`); `KeySpace.version` bumps on op-path writes so the
+engine knows its mirror went stale.  Win-flags (which batch row's VALUE
+replaces a slot's bytes) still download per call — value bytes live only
+on the host.
 
 Batches whose rows are NOT unique per slot (raw op streams) always take the
 scatter path — its reductions tolerate intra-batch collisions; the bulk
 kernels require `rows_unique_per_slot` (one scatter per slot per call).
 
-Host staging is bulk/vectorized (list-comp index probes, block appends,
-`dict.update`); the only remaining per-row Python is new element-row index
-insertion (native staging library replaces it later).
-
 Must be semantically bit-identical to engine/cpu.py — differential-tested in
-tests/test_engine_equivalence.py.
+tests/test_engine_equivalence.py and tests/test_resident_engine.py.
 """
 
 from __future__ import annotations
@@ -53,16 +59,40 @@ def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
+# family -> [(column name in the family's host table, neutral fill)]
+_FAMILIES = {
+    "env": [("ct", 0), ("mt", 0), ("dt", 0), ("expire", 0)],
+    "reg": [("rv_t", 0), ("rv_node", 0)],
+    "cnt": [("val", 0), ("uuid", K.NEUTRAL_T), ("base", 0),
+            ("base_t", K.NEUTRAL_T)],
+    "el": [("add_t", 0), ("add_node", 0), ("del_t", 0)],
+}
+
+
+def _host_table(store: KeySpace, fam: str):
+    return store.el if fam == "el" else (store.cnt if fam == "cnt"
+                                         else store.keys)
+
+
+def _fam_rows(store: KeySpace, fam: str) -> int:
+    return _host_table(store, fam).n
+
+
 class TpuMergeEngine:
     name = "tpu"
     # bulk when staged rows cover >= 1/BULK_FRACTION of the slot region
+    # (resident mode always prefers bulk: there is no state upload to avoid)
     BULK_FRACTION = 8
 
-    def __init__(self) -> None:
+    def __init__(self, resident: bool = False) -> None:
         import jax  # ensure a backend exists before we advertise ourselves
 
         self._jax = jax
         self._devices = jax.devices()
+        self.resident = resident
+        self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
+        self._seen_version = -1
+        self.needs_flush = False
 
     # ------------------------------------------------------------------ API
 
@@ -77,6 +107,12 @@ class TpuMergeEngine:
         # the bulk path scatters each slot once per batch, which is only a
         # merge if slots are unique within every batch
         self._unique_ok = all(b.rows_unique_per_slot for b in batches)
+        if self.resident and store.version != self._seen_version:
+            # host moved underneath us; resident mirrors are stale.  The
+            # Node flushes before op writes, so nothing unflushed is lost.
+            assert not self.needs_flush, "op write before flush"
+            self._res.clear()
+            self._seen_version = store.version
         self._n0_keys = store.keys.n
         # replica snapshots of one keyspace often share the key-list object;
         # resolve each distinct list once (ids are stable within this merge)
@@ -96,10 +132,90 @@ class TpuMergeEngine:
             for i, key in enumerate(b.del_keys):
                 store.record_key_delete(key, int(b.del_t[i]))
         # slot merges bypass the incremental sum cache — re-derive it in one
-        # vectorized pass (envelope-only merges cannot change counter sums)
-        if any(len(b.cnt_ki) for b, _ in resolved):
+        # vectorized pass (envelope-only merges cannot change counter sums);
+        # resident mode re-derives at flush time instead
+        if not (self.resident and self.needs_flush) and \
+                any(len(b.cnt_ki) for b, _ in resolved):
             store.recompute_counter_sums()
         return st
+
+    # ---------------------------------------------------------------- flush
+
+    def flush(self, store: KeySpace) -> None:
+        """Write resident device state back into the host keyspace (resident
+        mode only; a no-op otherwise).  Also re-derives counter sums and
+        enqueues element tombstones whose del_t advanced on device."""
+        if not self.needs_flush:
+            return
+        get = self._jax.device_get
+        for fam, res in self._res.items():
+            n = res["n"]
+            if n == 0:
+                continue
+            table = _host_table(store, fam)
+            if fam == "el":
+                old_dt = table.del_t[:n].copy()
+            cols = res["cols"]
+            if fam == "env":
+                out = np.asarray(get(cols["stack"]))[:n]
+                for i, (name, _) in enumerate(_FAMILIES["env"]):
+                    table.col(name)[:n] = out[:, i]
+            else:
+                for name, _ in _FAMILIES[fam]:
+                    table.col(name)[:n] = np.asarray(get(cols[name]))[:n]
+            if fam == "el":
+                self._enqueue_elem_garbage(store, np.arange(n),
+                                           table.add_t[:n], table.del_t[:n],
+                                           old_dt)
+        if "cnt" in self._res and self._res["cnt"]["n"]:
+            store.recompute_counter_sums()
+        self.needs_flush = False
+        self._seen_version = store.version
+
+    # ------------------------------------------------------ resident state
+
+    def _resident_state(self, store: KeySpace, fam: str, n: int):
+        """Device state dict for family `fam` covering rows [0, n); grows
+        (neutral-filled) as the host table grows.  Returns (cols, cap)."""
+        res = self._res.get(fam)
+        cap = K.next_pow2(max(n, 1))
+        spec = _FAMILIES[fam]
+        if res is None:
+            table = _host_table(store, fam)
+            if fam == "env":
+                host = np.stack([table.col(c)[:n] for c, _ in spec], axis=-1)
+                cols = {"stack": self._jax.device_put(_pad(host, cap, 0))}
+            else:
+                cols = {c: self._jax.device_put(
+                    _pad(table.col(c)[:n], cap, fill)) for c, fill in spec}
+        elif n > res["cap"]:
+            old = res["cols"]
+            jnp = self._jax.numpy
+            if fam == "env":
+                grown = jnp.concatenate(
+                    [old["stack"], jnp.zeros((cap - res["cap"], len(spec)),
+                                             dtype=jnp.int64)])
+                cols = {"stack": grown}
+            else:
+                cols = {c: jnp.concatenate(
+                    [old[c], B.device_full(cap - res["cap"], fill)])
+                    for c, fill in spec}
+        else:
+            cols = res["cols"]
+            cap = res["cap"]
+        self._res[fam] = {"cols": cols, "n": n, "cap": cap}
+        return cols, cap
+
+    def _family_done(self, fam: str, cols: dict, n: int, cap: int) -> None:
+        self._res[fam] = {"cols": cols, "n": n, "cap": cap}
+        self.needs_flush = True
+
+    def _drop_family(self, store: KeySpace, fam: str) -> None:
+        """A host-side (scatter) update is about to touch this family: sync
+        device state down first, then forget the mirror."""
+        if fam in self._res:
+            self.flush(store)
+            del self._res[fam]
 
     # ------------------------------------------------------- key resolution
 
@@ -129,6 +245,13 @@ class TpuMergeEngine:
             store.key_bytes.extend(batch.keys[i] for i in pos.tolist())
             store.reg_val.extend([None] * n_new)
             st.keys_created += n_new
+            if self.resident:
+                # created rows carry batch first-occurrence values on the
+                # host but neutral zeros on the device mirror; the batch rows
+                # merging in reconstruct them, EXCEPT for conflict-skipped
+                # duplicates — clear host values so both sides start neutral
+                store.keys.ct[rows] = 0
+                store.keys.dt[rows] = 0
 
         # conflict check over ALL positions: duplicate occurrences of a key
         # created above must also match the enc the first occurrence chose
@@ -145,8 +268,11 @@ class TpuMergeEngine:
     # --------------------------------------------------- bulk-path plumbing
 
     def _use_bulk(self, total_rows: int, region: int) -> bool:
-        return (self._unique_ok and region > 0
-                and total_rows * self.BULK_FRACTION >= region)
+        if not self._unique_ok:
+            return False
+        if self.resident:
+            return True  # no state upload to amortize — bulk always wins
+        return region > 0 and total_rows * self.BULK_FRACTION >= region
 
     @staticmethod
     def _bulk_region(staged_rows: list[np.ndarray], n0: int, n: int
@@ -197,19 +323,29 @@ class TpuMergeEngine:
                                                 self._n0_keys, n)
 
         if self._use_bulk(total, size):
-            sp = K.next_pow2(size)
-            if all_new:
-                state = self._jax.numpy.zeros((sp, 4), dtype=self._jax.numpy.int64)
+            if self.resident:
+                cols, sp = self._resident_state(store, "env", n)
+                state = cols["stack"]
+                base = 0
             else:
-                cols = np.stack([store.keys.ct[base:n], store.keys.mt[base:n],
-                                 store.keys.dt[base:n],
-                                 store.keys.expire[base:n]], axis=-1)
-                state = self._jax.device_put(_pad(cols, sp, 0))
+                sp = K.next_pow2(size)
+                if all_new:
+                    state = self._jax.numpy.zeros((sp, 4),
+                                                  dtype=self._jax.numpy.int64)
+                else:
+                    host = np.stack([store.keys.ct[base:n],
+                                     store.keys.mt[base:n],
+                                     store.keys.dt[base:n],
+                                     store.keys.expire[base:n]], axis=-1)
+                    state = self._jax.device_put(_pad(host, sp, 0))
             dev = [self._upload_batch(
                 p, base, sp, [(np.stack(c, axis=-1), 0)])
                 for p, c in staged]
             for idx, c in dev:
                 state = B.bulk_max(state, idx, c)
+            if self.resident:
+                self._family_done("env", {"stack": state}, n, sp)
+                return
             out = np.asarray(self._jax.device_get(state))[:size]
             store.keys.ct[base:n] = out[:, 0]
             store.keys.mt[base:n] = out[:, 1]
@@ -217,6 +353,7 @@ class TpuMergeEngine:
             store.keys.expire[base:n] = out[:, 3]
             return
         # scatter path over touched slots
+        self._drop_family(store, "env")
         kv = np.concatenate([p for p, _ in staged])
         trows, slot_idx = np.unique(kv, return_inverse=True)
         n_slots = K.next_pow2(len(trows) + 1)
@@ -259,9 +396,15 @@ class TpuMergeEngine:
                                                 self._n0_keys, n)
 
         if self._use_bulk(total, size):
-            sp = K.next_pow2(size)
-            t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
-            nd = self._state_up(store.keys.rv_node, base, size, sp, 0, all_new)
+            if self.resident:
+                cols, sp = self._resident_state(store, "reg", n)
+                t, nd = cols["rv_t"], cols["rv_node"]
+                base = 0
+            else:
+                sp = K.next_pow2(size)
+                t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
+                nd = self._state_up(store.keys.rv_node, base, size, sp, 0,
+                                    all_new)
             dev = [self._upload_batch(p, base, sp,
                                       [(bt, K.NEUTRAL_T), (bn, K.NEUTRAL_T)])
                    for p, bt, bn, _ in staged]
@@ -269,8 +412,11 @@ class TpuMergeEngine:
             for idx, bt, bn in dev:
                 t, nd, win = B.bulk_lww(t, nd, idx, bt, bn)
                 wins.append(win)
-            store.keys.rv_t[base:n] = np.asarray(t)[:size]
-            store.keys.rv_node[base:n] = np.asarray(nd)[:size]
+            if self.resident:
+                self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp)
+            else:
+                store.keys.rv_t[base:n] = np.asarray(t)[:size]
+                store.keys.rv_node[base:n] = np.asarray(nd)[:size]
             reg_val = store.reg_val
             for (pos, _, _, vals), win in zip(staged, wins):
                 for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
@@ -278,6 +424,7 @@ class TpuMergeEngine:
             return
         # scatter path: registers are LWW slots — reuse the element add-side
         # kernel with a zero del side
+        self._drop_family(store, "reg")
         kids = np.concatenate([p for p, *_ in staged])
         vals: list = []
         for _, _, _, v in staged:
@@ -331,13 +478,19 @@ class TpuMergeEngine:
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
 
         if self._use_bulk(total, size):
-            sp = K.next_pow2(size)
-            val = self._state_up(store.cnt.val, base, size, sp, 0, all_new)
-            uuid = self._state_up(store.cnt.uuid, base, size, sp,
-                                  K.NEUTRAL_T, all_new)
-            cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
-            cbt = self._state_up(store.cnt.base_t, base, size, sp,
-                                 K.NEUTRAL_T, all_new)
+            if self.resident:
+                cols, sp = self._resident_state(store, "cnt", n)
+                val, uuid = cols["val"], cols["uuid"]
+                cb, cbt = cols["base"], cols["base_t"]
+                base = 0
+            else:
+                sp = K.next_pow2(size)
+                val = self._state_up(store.cnt.val, base, size, sp, 0, all_new)
+                uuid = self._state_up(store.cnt.uuid, base, size, sp,
+                                      K.NEUTRAL_T, all_new)
+                cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
+                cbt = self._state_up(store.cnt.base_t, base, size, sp,
+                                     K.NEUTRAL_T, all_new)
             dev = [self._upload_batch(
                 r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
                               (bt, K.NEUTRAL_T)])
@@ -345,12 +498,17 @@ class TpuMergeEngine:
             for idx, v, u, bb, bt in dev:
                 val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
                                                      idx, v, u, bb, bt)
+            if self.resident:
+                self._family_done("cnt", {"val": val, "uuid": uuid,
+                                          "base": cb, "base_t": cbt}, n, sp)
+                return
             store.cnt.val[base:n] = np.asarray(val)[:size]
             store.cnt.uuid[base:n] = np.asarray(uuid)[:size]
             store.cnt.base[base:n] = np.asarray(cb)[:size]
             store.cnt.base_t[base:n] = np.asarray(cbt)[:size]
             return  # sums re-derived in one pass by merge_many
 
+        self._drop_family(store, "cnt")
         all_rows = np.concatenate([s[0] for s in staged])
         trows, slot_idx = np.unique(all_rows, return_inverse=True)
         n_slots = K.next_pow2(len(trows) + 1)
@@ -368,7 +526,11 @@ class TpuMergeEngine:
             new_val, new_t = (a[: len(trows)] for a in self._jax.device_get(out))
             store.cnt.col(vcol)[trows] = new_val
             store.cnt.col(tcol)[trows] = new_t
-        # sums re-derived in one pass by merge_many
+        if self.resident:
+            # merge_many's sum pass is skipped while other families hold
+            # unflushed device state — this path already wrote the host
+            store.recompute_counter_sums()
+        # else: sums re-derived in one pass by merge_many
 
     def _resolve_cnt_rows(self, store: KeySpace, combos: np.ndarray) -> np.ndarray:
         """(kid, node) combo keys -> store cnt rows, bulk-creating missing
@@ -430,12 +592,19 @@ class TpuMergeEngine:
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
 
         if self._use_bulk(total, size):
-            sp = K.next_pow2(size)
-            old_dt = (np.zeros(size, dtype=_I64) if all_new
-                      else store.el.del_t[base:n].copy())
-            at = self._state_up(store.el.add_t, base, size, sp, 0, all_new)
-            an = self._state_up(store.el.add_node, base, size, sp, 0, all_new)
-            dt = self._state_up(store.el.del_t, base, size, sp, 0, all_new)
+            if self.resident:
+                cols, sp = self._resident_state(store, "el", n)
+                at, an, dt = cols["add_t"], cols["add_node"], cols["del_t"]
+                base, size = 0, n
+                old_dt = None  # garbage enqueue deferred to flush
+            else:
+                sp = K.next_pow2(size)
+                old_dt = (np.zeros(size, dtype=_I64) if all_new
+                          else store.el.del_t[base:n].copy())
+                at = self._state_up(store.el.add_t, base, size, sp, 0, all_new)
+                an = self._state_up(store.el.add_node, base, size, sp, 0,
+                                    all_new)
+                dt = self._state_up(store.el.del_t, base, size, sp, 0, all_new)
             dev = [self._upload_batch(
                 r, base, sp, [(a, K.NEUTRAL_T), (x, K.NEUTRAL_T), (d, 0)])
                 for r, a, x, d, _, _ in staged]
@@ -443,22 +612,26 @@ class TpuMergeEngine:
             for idx, a, x, d in dev:
                 at, an, dt, win = B.bulk_elems(at, an, dt, idx, a, x, d)
                 wins.append(win)
-            m_at = np.asarray(at)[:size]
-            m_an = np.asarray(an)[:size]
-            m_dt = np.asarray(dt)[:size]
-            store.el.add_t[base:n] = m_at
-            store.el.add_node[base:n] = m_an
-            store.el.del_t[base:n] = m_dt
+            if self.resident:
+                self._family_done("el", {"add_t": at, "add_node": an,
+                                         "del_t": dt}, n, sp)
+            else:
+                m_at = np.asarray(at)[:size]
+                m_dt = np.asarray(dt)[:size]
+                store.el.add_t[base:n] = m_at
+                store.el.add_node[base:n] = np.asarray(an)[:size]
+                store.el.del_t[base:n] = m_dt
+                self._enqueue_elem_garbage(store, np.arange(base, n), m_at,
+                                           m_dt, old_dt)
             el_val = store.el_val
             for (pos, _, _, _, vals, has_vals), win in zip(staged, wins):
                 if not has_vals:
                     continue
                 for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
                     el_val[int(pos[j])] = vals[int(j)]
-            self._enqueue_elem_garbage(store, np.arange(base, n), m_at, m_dt,
-                                       old_dt)
             return
 
+        self._drop_family(store, "el")
         all_rows = np.concatenate([r for r, *_ in staged])
         vals_flat: list = []
         for _, _, _, _, v, _ in staged:

@@ -241,6 +241,7 @@ class ReplicaLink:
         stream length-prefixed bytes (reference push.rs:34-71 +
         server.rs:221-250, minus the fork)."""
         node = self.node
+        node.ensure_flushed()  # device-resident merge state → host first
         capture = batch_from_keyspace(node.ks)
         repl_last = node.repl_log.last_uuid
         meta_hdr = NodeMeta(node_id=node.node_id, alias=node.alias,

@@ -147,6 +147,7 @@ def execute(node: "Node", req, client=None) -> Msg:
     if cmd.flags & CMD_REPL_ONLY:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
+    node.ensure_flushed()  # device-resident merge results become readable
     uuid = node.hlc.tick(cmd.is_write)
     ctx = ExecCtx(uuid, node.node_id, False, client)
     args = ArgIter(items[1:], name.decode())
@@ -154,8 +155,10 @@ def execute(node: "Node", req, client=None) -> Msg:
         reply = cmd.handler(node, ctx, args)
     except CstError as e:
         return Err(e.resp_error())
-    if cmd.is_write and not (cmd.flags & CMD_NO_REPLICATE):
-        node.replicate_cmd(uuid, name, items[1:])
+    if cmd.is_write:
+        node.ks.version += 1
+        if not (cmd.flags & CMD_NO_REPLICATE):
+            node.replicate_cmd(uuid, name, items[1:])
     return reply
 
 
@@ -169,9 +172,13 @@ def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
     if cmd.flags & CMD_CLIENT_ONLY:
         raise InvalidRequestMsg(f"'{name.decode()}' cannot come from a replica")
     node.stats.cmds_replicated += 1
+    node.ensure_flushed()
     node.hlc.observe(uuid)
     ctx = ExecCtx(uuid, origin_nodeid, True, None)
-    return cmd.handler(node, ctx, ArgIter(args, name.decode()))
+    reply = cmd.handler(node, ctx, ArgIter(args, name.decode()))
+    if cmd.is_write:
+        node.ks.version += 1
+    return reply
 
 
 # ====================================================================

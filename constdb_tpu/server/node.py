@@ -86,6 +86,7 @@ class Node:
         return self.hlc.current
 
     def gc(self) -> int:
+        self.ensure_flushed()
         freed = self.ks.gc(self.gc_horizon())
         self.stats.gc_freed += freed
         return freed
@@ -94,8 +95,22 @@ class Node:
 
     def merge_batch(self, batch) -> None:
         """Bulk CRDT merge via the configured MergeEngine (snapshot ingest /
-        replica catch-up — the reference's per-key db.merge_entry loop)."""
+        replica catch-up — the reference's per-key db.merge_entry loop).
+        With a device-resident engine, merged state stays on the device
+        between calls; it flushes to the host lazily before the next read
+        (`ensure_flushed`)."""
         st = self.engine.merge(self.ks, batch)
         self.stats.merges += 1
         self.stats.merge_rows += batch.n_rows
         return st
+
+    def ensure_flushed(self) -> None:
+        """Sync device-resident merge state back to the host keyspace
+        before any read/write of the numeric plane."""
+        flush = getattr(self.engine, "flush", None)
+        if flush is not None and getattr(self.engine, "needs_flush", False):
+            flush(self.ks)
+
+    def canonical(self) -> dict:
+        self.ensure_flushed()
+        return self.ks.canonical()

@@ -76,6 +76,9 @@ class KeySpace:
         self.key_bytes: list[bytes] = []
         self.key_index = StrTable(8096)
         self.reg_val: list[Optional[bytes]] = []
+        # bumped by op-path writes; lets a device-resident merge engine know
+        # its mirror of the numeric plane has gone stale (engine/tpu.py)
+        self.version = 0
 
         self.cnt = _CntCols()
         self.cnt_index = I64Dict(4096)

@@ -78,7 +78,7 @@ async def converge(apps, timeout: float = 15.0, poll: float = 0.05) -> None:
     loop = asyncio.get_running_loop()
     deadline = loop.time() + timeout
     while True:
-        canons = [app.node.ks.canonical() for app in apps]
+        canons = [app.node.canonical() for app in apps]
         if all(c == canons[0] for c in canons[1:]):
             return
         if loop.time() > deadline:
