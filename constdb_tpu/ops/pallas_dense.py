@@ -1,0 +1,163 @@
+"""Fused dense CRDT merge kernels in Pallas (TPU).
+
+One VMEM pass computes what the XLA path (ops/dense.py) expresses as
+several reductions + an argmax: the lexicographic (add_t, add_node) winner,
+the merged del side, and the winning replica row, over [R, S] dense merge
+tensors blocked along S.
+
+TPU VMEM lanes are 32-bit, so int64 columns travel as two int32/uint32
+planes; a signed 64-bit comparison is exactly the lexicographic
+(hi signed, lo unsigned) comparison.  All merge values here (uuids,
+NEUTRAL_T, node ids) are ordinary int64s, so the split/join is lossless.
+
+`merge_elems(..., interpret=True)` runs the same kernel through the Pallas
+interpreter on CPU — that is how tests/test_pallas_dense.py differential-
+tests it against ops/dense.py without TPU hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+try:  # TPU backends
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    _VMEM = None
+
+BLOCK_S = 512
+_I32_MIN = jnp.iinfo(jnp.int32).min
+
+
+def _split64(x):
+    """int64 -> (hi int32, lo uint32); (hi, lo) lex order == int64 order."""
+    return ((x >> 32).astype(jnp.int32),
+            (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32))
+
+
+def _join64(hi, lo):
+    return (hi.astype(jnp.int64) << 32) | lo.astype(jnp.int64)
+
+
+def _lex_mask(hi, lo, mask, lo_zero):
+    """Among rows where `mask`, the rows achieving the (hi, lo) lex max.
+    -> (new_mask, m_hi [S], m_lo [S])."""
+    hi_c = jnp.where(mask, hi, _I32_MIN)
+    m_hi = jnp.max(hi_c, axis=0)
+    mask = mask & (hi == m_hi[None, :])
+    lo_c = jnp.where(mask, lo, lo_zero)
+    m_lo = jnp.max(lo_c, axis=0)
+    mask = mask & (lo == m_lo[None, :])
+    return mask, m_hi, m_lo
+
+
+def _elems_kernel(at_hi, at_lo, an_hi, an_lo, dt_hi, dt_lo,
+                  o_at_hi, o_at_lo, o_an_hi, o_an_lo, o_dt_hi, o_dt_lo,
+                  o_win):
+    R = at_hi.shape[0]
+    full = jnp.ones(at_hi.shape, dtype=jnp.bool_)
+    zero_u = jnp.uint32(0)
+
+    # 4-level lexicographic winner: (at_hi, at_lo, an_hi, an_lo)
+    m, ah, al = _lex_mask(at_hi[:], at_lo[:], full, zero_u)
+    m, nh, nl = _lex_mask(an_hi[:], an_lo[:], m, zero_u)
+
+    # first winning row (ties share identical (t, node) == the same write)
+    rows = jax.lax.broadcasted_iota(jnp.int32, at_hi.shape, 0)
+    win = jnp.min(jnp.where(m, rows, R), axis=0)
+
+    # del side: independent 2-level max
+    _, dh, dl = _lex_mask(dt_hi[:], dt_lo[:], full, zero_u)
+
+    o_at_hi[:] = ah[None, :]
+    o_at_lo[:] = al[None, :]
+    o_an_hi[:] = nh[None, :]
+    o_an_lo[:] = nl[None, :]
+    o_dt_hi[:] = dh[None, :]
+    o_dt_lo[:] = dl[None, :]
+    o_win[:] = win[None, :]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def merge_elems(at, an, dt, interpret: bool = False):
+    """Fused [R, S] element merge: lexicographic (add_t, add_node) winner +
+    max del_t.  -> (at[S], an[S], dt[S], win_batch[S]) — bit-identical to
+    ops/dense.py dense_merge_elems."""
+    R, S = at.shape
+    sp = -(-S // BLOCK_S) * BLOCK_S
+    neutral = jnp.int64(-(1 << 62))
+
+    def prep(x, fill):
+        if sp != S:
+            x = jnp.concatenate(
+                [x, jnp.full((R, sp - S), fill, dtype=jnp.int64)], axis=1)
+        return _split64(x)
+
+    planes = [*prep(at, neutral), *prep(an, neutral), *prep(dt, 0)]
+    grid = (sp // BLOCK_S,)
+    in_spec = pl.BlockSpec((R, BLOCK_S), lambda i: (0, i))
+    out_spec = pl.BlockSpec((1, BLOCK_S), lambda i: (0, i))
+    shapes = ([jax.ShapeDtypeStruct((1, sp), jnp.int32),
+               jax.ShapeDtypeStruct((1, sp), jnp.uint32)] * 3
+              + [jax.ShapeDtypeStruct((1, sp), jnp.int32)])
+    out = pl.pallas_call(
+        _elems_kernel,
+        grid=grid,
+        in_specs=[in_spec] * 6,
+        out_specs=[out_spec] * 7,
+        out_shape=shapes,
+        interpret=interpret,
+    )(*planes)
+    ah, al, nh, nl, dh, dl, win = (o[0] for o in out)
+    return (_join64(ah, al)[:S], _join64(nh, nl)[:S],
+            _join64(dh, dl)[:S], win.astype(jnp.int64)[:S])
+
+
+def _counters_kernel(v_hi, v_lo, t_hi, t_lo, o_v_hi, o_v_lo, o_t_hi, o_t_lo):
+    full = jnp.ones(v_hi.shape, dtype=jnp.bool_)
+    zero_u = jnp.uint32(0)
+    # (uuid, value) lexicographic max == LWW with max-value tie-break
+    m, th, tl = _lex_mask(t_hi[:], t_lo[:], full, zero_u)
+    _, vh, vl = _lex_mask(v_hi[:], v_lo[:], m, zero_u)
+    o_v_hi[:] = vh[None, :]
+    o_v_lo[:] = vl[None, :]
+    o_t_hi[:] = th[None, :]
+    o_t_lo[:] = tl[None, :]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def merge_counters(vals, ts, interpret: bool = False):
+    """Fused [R, S] counter-slot merge: per-slot (value @ uuid) LWW with
+    max-value tie — bit-identical to ops/dense.py dense_merge_counters."""
+    R, S = vals.shape
+    sp = -(-S // BLOCK_S) * BLOCK_S
+    neutral = jnp.int64(-(1 << 62))
+
+    def prep(x, fill):
+        if sp != S:
+            x = jnp.concatenate(
+                [x, jnp.full((R, sp - S), fill, dtype=jnp.int64)], axis=1)
+        return _split64(x)
+
+    planes = [*prep(vals, neutral), *prep(ts, neutral)]
+    in_spec = pl.BlockSpec((R, BLOCK_S), lambda i: (0, i))
+    out_spec = pl.BlockSpec((1, BLOCK_S), lambda i: (0, i))
+    shapes = [jax.ShapeDtypeStruct((1, sp), jnp.int32),
+              jax.ShapeDtypeStruct((1, sp), jnp.uint32)] * 2
+    out = pl.pallas_call(
+        _counters_kernel,
+        grid=(sp // BLOCK_S,),
+        in_specs=[in_spec] * 4,
+        out_specs=[out_spec] * 4,
+        out_shape=shapes,
+        interpret=interpret,
+    )(*planes)
+    vh, vl, th, tl = (o[0] for o in out)
+    return _join64(vh, vl)[:S], _join64(th, tl)[:S]
