@@ -1,0 +1,135 @@
+"""Multi-value register + sequence CRDTs: convergence property tests.
+
+These complete the reference's vestigial scaffolds (src/crdt/vclock.rs,
+src/crdt/list.rs) — merge must be commutative, associative, idempotent.
+"""
+
+import random
+
+import pytest
+
+from constdb_tpu.crdt.multivalue import MultiValue, VClock
+from constdb_tpu.crdt.sequence import Sequence
+
+
+# ---------------------------------------------------------------- multivalue
+
+def test_vclock_partial_order():
+    a = VClock({1: 2, 2: 1})
+    b = VClock({1: 1, 2: 1})
+    c = VClock({1: 1, 2: 2})
+    assert a.dominates(b) and not b.dominates(a)
+    assert a.concurrent(c)
+    assert a.merge(c).c == {1: 2, 2: 2}
+
+
+def test_concurrent_writes_become_siblings():
+    r1, r2 = MultiValue(), MultiValue()
+    r1.write(b"x", node=1)
+    r2.write(b"y", node=2)  # concurrent: neither saw the other
+    r1.merge(r2)
+    assert sorted(r1.read()) == [b"x", b"y"]
+    # a reader resolves by writing with the read context
+    r1.write(b"z", node=1, context=r1.context())
+    assert r1.read() == [b"z"]
+
+
+def test_causal_write_supersedes():
+    r1, r2 = MultiValue(), MultiValue()
+    r1.write(b"x", node=1)
+    r2.merge(r1)
+    r2.write(b"y", node=2)  # saw x
+    r1.merge(r2)
+    assert r1.read() == [b"y"]
+
+
+def _random_mv_ops(seed: int, n_nodes: int = 3, n_ops: int = 40):
+    rng = random.Random(seed)
+    regs = [MultiValue() for _ in range(n_nodes)]
+    for i in range(n_ops):
+        n = rng.randrange(n_nodes)
+        if rng.random() < 0.6:
+            regs[n].write(b"v%d" % i, node=n + 1)
+        else:
+            regs[n].merge(regs[rng.randrange(n_nodes)])
+    return regs
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_mv_merge_properties(seed):
+    regs = _random_mv_ops(seed)
+
+    # commutative + convergent: full pairwise mixing in any order agrees
+    import copy
+    order1 = copy.deepcopy(regs)
+    order2 = copy.deepcopy(regs)
+    for i in range(len(order1)):
+        for j in range(len(order1)):
+            order1[i].merge(order1[j])
+    for i in reversed(range(len(order2))):
+        for j in reversed(range(len(order2))):
+            order2[i].merge(order2[j])
+    states1 = {r.state() for r in order1}
+    states2 = {r.state() for r in order2}
+    assert len(states1) == 1 and states1 == states2
+
+    # idempotent
+    before = order1[0].state()
+    order1[0].merge(order1[0])
+    assert order1[0].state() == before
+
+
+# ------------------------------------------------------------------ sequence
+
+def test_sequence_basic_order():
+    s = Sequence()
+    s.insert(0, b"b", node=1, uuid=2)
+    s.insert(0, b"a", node=1, uuid=3)
+    s.insert(2, b"c", node=1, uuid=4)
+    assert s.read() == [b"a", b"b", b"c"]
+    s.delete(1, uuid=5)
+    assert s.read() == [b"a", b"c"]
+
+
+def test_sequence_concurrent_inserts_converge():
+    base = Sequence()
+    base.insert(0, b"x", node=1, uuid=1)
+    import copy
+    s1, s2 = copy.deepcopy(base), copy.deepcopy(base)
+    s1.insert(1, b"from1", node=1, uuid=10)
+    s2.insert(1, b"from2", node=2, uuid=11)
+    s1.merge(s2)
+    s2.merge(s1)
+    assert s1.read() == s2.read()
+    assert set(s1.read()) == {b"x", b"from1", b"from2"}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_sequence_merge_properties(seed):
+    rng = random.Random(seed)
+    import copy
+    nodes = [Sequence() for _ in range(3)]
+    uuid = 1
+    for _ in range(50):
+        n = rng.randrange(3)
+        s = nodes[n]
+        uuid += 1
+        live = len(s.read())
+        if rng.random() < 0.6 or live == 0:
+            s.insert(rng.randrange(live + 1), b"v%d" % uuid, node=n + 1,
+                     uuid=uuid)
+        elif rng.random() < 0.5:
+            s.delete(rng.randrange(live), uuid=uuid)
+        else:
+            s.merge(nodes[rng.randrange(3)])
+    merged = copy.deepcopy(nodes)
+    for i in range(3):
+        for j in range(3):
+            merged[i].merge(merged[j])
+    reads = {tuple(m.read()) for m in merged}
+    states = {m.state() for m in merged}
+    assert len(reads) == 1 and len(states) == 1
+    # idempotent
+    before = merged[0].state()
+    merged[0].merge(merged[0])
+    assert merged[0].state() == before

@@ -1,0 +1,171 @@
+"""Server-level black-box tests over real TCP: command surface, INFO,
+expiry, boot-time snapshot restore, and fault injection on the sync path."""
+
+import asyncio
+import os
+
+import pytest
+
+from constdb_tpu.errors import ConnBroken
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, Nil, Simple
+
+from cluster_util import Client, close_cluster, converge, full_mesh, make_cluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_command_surface(tmp_path):
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        try:
+            # errors
+            bad = await c.cmd("nope")
+            assert isinstance(bad, Err) and b"unknown command" in bad.val
+            bad = await c.cmd("get")
+            assert isinstance(bad, Err)
+            await c.cmd("set", "s", "v")
+            bad = await c.cmd("sadd", "s", "m")
+            assert isinstance(bad, Err) and b"WRONGTYPE" in bad.val
+            # node / client / desc / repllog
+            assert await c.cmd("node", "id") == Int(apps[0].node.node_id)
+            await c.cmd("node", "alias", "prima")
+            assert await c.cmd("node", "alias") == Bulk(b"prima")
+            tid = await c.cmd("client", "threadid")
+            assert isinstance(tid, Bulk)
+            d = await c.cmd("desc", "s")
+            assert isinstance(d, Arr) and any(b"Bytes" in i.val for i in d.items)
+            uuids = await c.cmd("repllog", "uuids")
+            assert isinstance(uuids, Arr) and len(uuids.items) >= 1
+            entry = await c.cmd("repllog", "at", uuids.items[0].val)
+            assert isinstance(entry, Arr)
+            # spop
+            await c.cmd("sadd", "pop", "only")
+            assert await c.cmd("spop", "pop") == Bulk(b"only")
+            assert await c.cmd("spop", "pop") == Nil()
+        finally:
+            await c.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_info_sections(tmp_path):
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        try:
+            await c.cmd("meet", apps[1].advertised_addr)
+            await full_mesh(apps)
+            await c.cmd("incr", "k")
+            info = (await c.cmd("info")).val.decode()
+            for section in ("# Server", "# Clients", "# Memory", "# Stats",
+                            "# Replication", "# Keyspace"):
+                assert section in info, info
+            assert "connected_replicas:1" in info
+            assert "counters:1" in info
+            only = (await c.cmd("info", "keyspace")).val.decode()
+            assert "# Keyspace" in only and "# Server" not in only
+        finally:
+            await c.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_expire_replicates(tmp_path):
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        c1 = await Client().connect(apps[0].advertised_addr)
+        c2 = await Client().connect(apps[1].advertised_addr)
+        try:
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await full_mesh(apps)
+            await c1.cmd("set", "tmp", "v")
+            assert await c1.cmd("expire", "tmp", "1") == Int(1)
+            await converge(apps)
+            ttl = await c2.cmd("ttl", "tmp")
+            assert isinstance(ttl, Int) and 0 <= ttl.val <= 1
+            assert await c2.cmd("get", "tmp") == Bulk(b"v")
+            await asyncio.sleep(1.2)
+            assert await c1.cmd("get", "tmp") == Nil()
+            assert await c2.cmd("get", "tmp") == Nil()
+            assert await c2.cmd("ttl", "tmp") == Int(-2)
+        finally:
+            await c1.close()
+            await c2.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_snapshot_boot_restore(tmp_path):
+    async def main():
+        from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
+        from constdb_tpu.server.io import start_node
+        from constdb_tpu.server.node import Node
+
+        snap = str(tmp_path / "boot.snapshot")
+        apps = await make_cluster(1, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        node_id = apps[0].node.node_id
+        await c.cmd("incr", "persisted")
+        await c.cmd("sadd", "tags", "a", "b")
+        dump_keyspace(snap, apps[0].node.ks,
+                      NodeMeta(node_id=node_id,
+                               repl_last_uuid=apps[0].node.repl_log.last_uuid))
+        await c.close()
+        await close_cluster(apps)
+
+        # a fresh process restores from the snapshot (the reference restarts
+        # empty — SURVEY.md §5.4)
+        node2 = Node()
+        app2 = await start_node(node2, host="127.0.0.1", port=0,
+                                work_dir=str(tmp_path), snapshot_path=snap)
+        try:
+            c2 = await Client().connect(app2.advertised_addr)
+            assert node2.node_id == node_id
+            assert await c2.cmd("get", "persisted") == Int(1)
+            got = await c2.cmd("smembers", "tags")
+            assert {i.val for i in got.items} == {b"a", b"b"}
+            await c2.close()
+        finally:
+            await app2.close()
+    run(main())
+
+
+def test_sync_survives_injected_snapshot_failure(tmp_path):
+    """Fault injection at the sync seam: the first snapshot download dies
+    mid-transfer; the link must reconnect and fully converge (reference
+    behavior: reconnect-forever, replica/replica.rs:254-271)."""
+    async def main():
+        from constdb_tpu.replica.link import ReplicaLink
+
+        # tiny repl_log: catch-up MUST go through a full snapshot
+        apps = await make_cluster(2, str(tmp_path), repl_log_cap=2_000)
+        c1 = await Client().connect(apps[0].advertised_addr)
+        try:
+            for i in range(300):
+                await c1.cmd("set", f"k{i}", f"v{i}")
+
+            original = ReplicaLink._receive_snapshot
+            failures = {"n": 0}
+
+            async def flaky(self, reader, parser, size, repl_last):
+                if failures["n"] == 0:
+                    failures["n"] += 1
+                    # consume nothing: simulate the peer dying mid-transfer
+                    raise ConnectionError("injected snapshot failure")
+                return await original(self, reader, parser, size, repl_last)
+
+            ReplicaLink._receive_snapshot = flaky
+            try:
+                await c1.cmd("meet", apps[1].advertised_addr)
+                await converge(apps, timeout=20.0)
+            finally:
+                ReplicaLink._receive_snapshot = original
+            assert failures["n"] == 1
+            assert apps[1].node.ks.n_keys() == apps[0].node.ks.n_keys()
+        finally:
+            await c1.close()
+            await close_cluster(apps)
+    run(main())
