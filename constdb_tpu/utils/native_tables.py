@@ -1,10 +1,15 @@
-"""Staging index tables: native C++ via ctypes, pure-Python fallback.
+"""Staging index tables: native C++, three binding tiers.
 
 The merge hot path resolves millions of (bytes -> id) and (int64 -> int64)
 probes per batch; native/tables.cpp does them in C with BATCH entry points
-so Python crosses the FFI boundary once per column, not once per row.  The
-fallback classes keep every caller working when the .so is absent (fresh
-checkout before `make -C native`), at dict speed.
+so Python crosses the FFI boundary once per column, not once per row.
+
+Binding tiers, best available wins:
+  1. CPython extension (native/pyext.cpp, `cst_ext`) — walks bytes lists
+     directly in C, no Python-side blob packing at all;
+  2. ctypes over libconstdb_native.so — caller packs a blob + offsets;
+  3. pure Python dicts — keeps everything working on a fresh checkout
+     before `make -C native`, at dict speed.
 
 API shape is numpy-first: batch methods take/return int64 arrays.
 """
@@ -12,6 +17,7 @@ API shape is numpy-first: batch methods take/return int64 arrays.
 from __future__ import annotations
 
 import ctypes
+import importlib.util
 import os
 from typing import Optional
 
@@ -20,6 +26,30 @@ import numpy as np
 _I64 = np.int64
 
 _lib = None
+_ext = None
+
+
+def load_ext():
+    """The CPython extension module, or None."""
+    global _ext
+    if _ext is not None:
+        return _ext or None
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for cand in (
+        os.path.join(here, "_native", "cst_ext.so"),
+        os.path.join(os.path.dirname(here), "native", "build", "cst_ext.so"),
+    ):
+        if os.path.exists(cand):
+            try:
+                spec = importlib.util.spec_from_file_location("cst_ext", cand)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                _ext = mod
+                return mod
+            except (ImportError, OSError):
+                continue
+    _ext = False
+    return None
 
 
 def load_native() -> Optional[ctypes.CDLL]:
@@ -278,9 +308,85 @@ class _PyI64Dict:
         return out, next_val - start
 
 
+# ------------------------------------------------- CPython-extension tier
+
+class _ExtStrTable:
+    """bytes -> dense id via the C extension (no blob packing)."""
+
+    __slots__ = ("_h", "_m")
+
+    def __init__(self, cap_hint: int = 16):
+        self._m = load_ext()
+        self._h = self._m.strtab_new(cap_hint)
+
+    def __len__(self) -> int:
+        return self._m.strtab_len(self._h)
+
+    def get_or_insert(self, b: bytes) -> int:
+        return self._m.strtab_get_or_insert(self._h, b)
+
+    def lookup(self, b: bytes) -> int:
+        return self._m.strtab_lookup(self._h, b)
+
+    def get_or_insert_batch(self, items: list) -> tuple[np.ndarray, int]:
+        out = np.empty(len(items), dtype=_I64)
+        n_new = self._m.strtab_get_or_insert_batch(self._h, items, out)
+        return out, n_new
+
+    def lookup_batch(self, items: list) -> np.ndarray:
+        out = np.empty(len(items), dtype=_I64)
+        self._m.strtab_lookup_batch(self._h, items, out)
+        return out
+
+    def bytes_of(self, idx: int) -> bytes:
+        return self._m.strtab_bytes_of(self._h, idx)
+
+
+class _ExtI64Dict:
+    __slots__ = ("_h", "_m")
+
+    def __init__(self, cap_hint: int = 16):
+        self._m = load_ext()
+        self._h = self._m.i64_new(cap_hint)
+
+    def __len__(self) -> int:
+        return self._m.i64_len(self._h)
+
+    def get(self, k: int, dflt: int = -1) -> int:
+        return self._m.i64_get(self._h, k, dflt)
+
+    def put(self, k: int, v: int) -> None:
+        self._m.i64_put(self._h, k, v)
+
+    def delete(self, k: int, dflt: int = -1) -> int:
+        return self._m.i64_del(self._h, k, dflt)
+
+    def lookup_batch(self, keys: np.ndarray, dflt: int = -1) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=_I64)
+        out = np.empty(len(keys), dtype=_I64)
+        self._m.i64_lookup_batch(self._h, keys, dflt, out)
+        return out
+
+    def put_batch(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=_I64)
+        vals = np.ascontiguousarray(vals, dtype=_I64)
+        self._m.i64_put_batch(self._h, keys, vals)
+
+    def get_or_assign_batch(self, keys: np.ndarray, next_val: int
+                            ) -> tuple[np.ndarray, int]:
+        keys = np.ascontiguousarray(keys, dtype=_I64)
+        out = np.empty(len(keys), dtype=_I64)
+        n_new = self._m.i64_get_or_assign_batch(self._h, keys, next_val, out)
+        return out, n_new
+
+
 def StrTable(cap_hint: int = 16):
+    if load_ext():
+        return _ExtStrTable(cap_hint)
     return (_NativeStrTable if load_native() else _PyStrTable)(cap_hint)
 
 
 def I64Dict(cap_hint: int = 16):
+    if load_ext():
+        return _ExtI64Dict(cap_hint)
     return (_NativeI64Dict if load_native() else _PyI64Dict)(cap_hint)

@@ -1,0 +1,297 @@
+// CPython extension binding for the staging tables (native/tables.cpp).
+//
+// The ctypes path needs the caller to pack a list of bytes into one blob +
+// offsets (a Python-side O(n) pass that shows up in merge profiles); here
+// the extension walks the PyBytes list directly in C.  Output arrays are
+// caller-allocated numpy buffers passed via the buffer protocol, so no
+// numpy C-API dependency.
+//
+// Built by native/Makefile into constdb_tpu/_native/cst_ext*.so;
+// utils/native_tables.py prefers it and falls back to ctypes, then to pure
+// Python.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include "tables.cpp"  // self-contained: StrTable / I64Table definitions
+
+namespace {
+
+const char* kStrCapsule = "constdb.StrTable";
+const char* kI64Capsule = "constdb.I64Table";
+
+void str_destructor(PyObject* cap) {
+    delete static_cast<StrTable*>(PyCapsule_GetPointer(cap, kStrCapsule));
+}
+void i64_destructor(PyObject* cap) {
+    delete static_cast<I64Table*>(PyCapsule_GetPointer(cap, kI64Capsule));
+}
+
+StrTable* get_str(PyObject* cap) {
+    return static_cast<StrTable*>(PyCapsule_GetPointer(cap, kStrCapsule));
+}
+I64Table* get_i64(PyObject* cap) {
+    return static_cast<I64Table*>(PyCapsule_GetPointer(cap, kI64Capsule));
+}
+
+bool out_buffer(PyObject* obj, Py_buffer* view, Py_ssize_t need_items) {
+    if (PyObject_GetBuffer(obj, view, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) != 0)
+        return false;
+    if (view->len < (Py_ssize_t)(need_items * sizeof(int64_t))) {
+        PyBuffer_Release(view);
+        PyErr_SetString(PyExc_ValueError, "output buffer too small");
+        return false;
+    }
+    return true;
+}
+
+// ------------------------------------------------------------------ StrTable
+
+PyObject* py_strtab_new(PyObject*, PyObject* args) {
+    Py_ssize_t cap_hint = 16;
+    if (!PyArg_ParseTuple(args, "|n", &cap_hint)) return nullptr;
+    return PyCapsule_New(new StrTable((size_t)cap_hint), kStrCapsule,
+                         str_destructor);
+}
+
+PyObject* py_strtab_len(PyObject*, PyObject* args) {
+    PyObject* cap;
+    if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) return nullptr;
+    return PyLong_FromSsize_t((Py_ssize_t)t->count);
+}
+
+PyObject* py_strtab_get_or_insert(PyObject*, PyObject* args) {
+    PyObject* cap;
+    Py_buffer b;
+    if (!PyArg_ParseTuple(args, "Oy*", &cap, &b)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) { PyBuffer_Release(&b); return nullptr; }
+    int64_t id = t->get_or_insert((const uint8_t*)b.buf, (int64_t)b.len);
+    PyBuffer_Release(&b);
+    return PyLong_FromLongLong(id);
+}
+
+PyObject* py_strtab_lookup(PyObject*, PyObject* args) {
+    PyObject* cap;
+    Py_buffer b;
+    if (!PyArg_ParseTuple(args, "Oy*", &cap, &b)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) { PyBuffer_Release(&b); return nullptr; }
+    int64_t id = t->lookup((const uint8_t*)b.buf, (int64_t)b.len);
+    PyBuffer_Release(&b);
+    return PyLong_FromLongLong(id);
+}
+
+// (table, list[bytes], out int64[n]) -> n_new
+PyObject* py_strtab_get_or_insert_batch(PyObject*, PyObject* args) {
+    PyObject *cap, *list, *out;
+    if (!PyArg_ParseTuple(args, "OOO", &cap, &list, &out)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) return nullptr;
+    PyObject* seq = PySequence_Fast(list, "expected a sequence of bytes");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_buffer ob;
+    if (!out_buffer(out, &ob, n)) { Py_DECREF(seq); return nullptr; }
+    int64_t* dst = (int64_t*)ob.buf;
+    int64_t before = (int64_t)t->count;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        char* p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) != 0) {
+            PyBuffer_Release(&ob);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        dst[i] = t->get_or_insert((const uint8_t*)p, (int64_t)len);
+    }
+    PyBuffer_Release(&ob);
+    Py_DECREF(seq);
+    return PyLong_FromLongLong((int64_t)t->count - before);
+}
+
+PyObject* py_strtab_lookup_batch(PyObject*, PyObject* args) {
+    PyObject *cap, *list, *out;
+    if (!PyArg_ParseTuple(args, "OOO", &cap, &list, &out)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) return nullptr;
+    PyObject* seq = PySequence_Fast(list, "expected a sequence of bytes");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_buffer ob;
+    if (!out_buffer(out, &ob, n)) { Py_DECREF(seq); return nullptr; }
+    int64_t* dst = (int64_t*)ob.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
+        char* p;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(item, &p, &len) != 0) {
+            PyBuffer_Release(&ob);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        dst[i] = t->lookup((const uint8_t*)p, (int64_t)len);
+    }
+    PyBuffer_Release(&ob);
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
+PyObject* py_strtab_bytes_of(PyObject*, PyObject* args) {
+    PyObject* cap;
+    Py_ssize_t id;
+    if (!PyArg_ParseTuple(args, "On", &cap, &id)) return nullptr;
+    StrTable* t = get_str(cap);
+    if (!t) return nullptr;
+    if (id < 0 || (size_t)id >= t->count) {
+        PyErr_SetString(PyExc_IndexError, "string id out of range");
+        return nullptr;
+    }
+    return PyBytes_FromStringAndSize(
+        (const char*)t->arena.data() + t->offs[id], (Py_ssize_t)t->lens[id]);
+}
+
+// ------------------------------------------------------------------ I64Table
+
+PyObject* py_i64_new(PyObject*, PyObject* args) {
+    Py_ssize_t cap_hint = 16;
+    if (!PyArg_ParseTuple(args, "|n", &cap_hint)) return nullptr;
+    return PyCapsule_New(new I64Table((size_t)cap_hint), kI64Capsule,
+                         i64_destructor);
+}
+
+PyObject* py_i64_len(PyObject*, PyObject* args) {
+    PyObject* cap;
+    if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    return PyLong_FromSsize_t((Py_ssize_t)t->count);
+}
+
+PyObject* py_i64_get(PyObject*, PyObject* args) {
+    PyObject* cap;
+    long long k, dflt;
+    if (!PyArg_ParseTuple(args, "OLL", &cap, &k, &dflt)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    return PyLong_FromLongLong(t->get(k, dflt));
+}
+
+PyObject* py_i64_put(PyObject*, PyObject* args) {
+    PyObject* cap;
+    long long k, v;
+    if (!PyArg_ParseTuple(args, "OLL", &cap, &k, &v)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    t->put(k, v);
+    Py_RETURN_NONE;
+}
+
+PyObject* py_i64_del(PyObject*, PyObject* args) {
+    PyObject* cap;
+    long long k, dflt;
+    if (!PyArg_ParseTuple(args, "OLL", &cap, &k, &dflt)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    return PyLong_FromLongLong(t->del(k, dflt));
+}
+
+bool in_buffer(PyObject* obj, Py_buffer* view) {
+    return PyObject_GetBuffer(obj, view, PyBUF_C_CONTIGUOUS) == 0;
+}
+
+// (table, keys int64[n], dflt, out int64[n])
+PyObject* py_i64_lookup_batch(PyObject*, PyObject* args) {
+    PyObject *cap, *keys, *out;
+    long long dflt;
+    if (!PyArg_ParseTuple(args, "OOLO", &cap, &keys, &dflt, &out)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    Py_buffer kb, ob;
+    if (!in_buffer(keys, &kb)) return nullptr;
+    Py_ssize_t n = kb.len / (Py_ssize_t)sizeof(int64_t);
+    if (!out_buffer(out, &ob, n)) { PyBuffer_Release(&kb); return nullptr; }
+    const int64_t* ks = (const int64_t*)kb.buf;
+    int64_t* dst = (int64_t*)ob.buf;
+    for (Py_ssize_t i = 0; i < n; i++) dst[i] = t->get(ks[i], dflt);
+    PyBuffer_Release(&ob);
+    PyBuffer_Release(&kb);
+    Py_RETURN_NONE;
+}
+
+// (table, keys int64[n], vals int64[n])
+PyObject* py_i64_put_batch(PyObject*, PyObject* args) {
+    PyObject *cap, *keys, *vals;
+    if (!PyArg_ParseTuple(args, "OOO", &cap, &keys, &vals)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    Py_buffer kb, vb;
+    if (!in_buffer(keys, &kb)) return nullptr;
+    if (!in_buffer(vals, &vb)) { PyBuffer_Release(&kb); return nullptr; }
+    Py_ssize_t n = kb.len / (Py_ssize_t)sizeof(int64_t);
+    const int64_t* ks = (const int64_t*)kb.buf;
+    const int64_t* vs = (const int64_t*)vb.buf;
+    for (Py_ssize_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
+    PyBuffer_Release(&vb);
+    PyBuffer_Release(&kb);
+    Py_RETURN_NONE;
+}
+
+// (table, keys int64[n], next, out int64[n]) -> n_new
+PyObject* py_i64_get_or_assign_batch(PyObject*, PyObject* args) {
+    PyObject *cap, *keys, *out;
+    long long next;
+    if (!PyArg_ParseTuple(args, "OOLO", &cap, &keys, &next, &out)) return nullptr;
+    I64Table* t = get_i64(cap);
+    if (!t) return nullptr;
+    Py_buffer kb, ob;
+    if (!in_buffer(keys, &kb)) return nullptr;
+    Py_ssize_t n = kb.len / (Py_ssize_t)sizeof(int64_t);
+    if (!out_buffer(out, &ob, n)) { PyBuffer_Release(&kb); return nullptr; }
+    const int64_t* ks = (const int64_t*)kb.buf;
+    int64_t* dst = (int64_t*)ob.buf;
+    int64_t start = next;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int64_t v = t->get(ks[i], INT64_MIN);
+        if (v == INT64_MIN) {
+            v = next++;
+            t->put(ks[i], v);
+        }
+        dst[i] = v;
+    }
+    PyBuffer_Release(&ob);
+    PyBuffer_Release(&kb);
+    return PyLong_FromLongLong(next - start);
+}
+
+PyMethodDef methods[] = {
+    {"strtab_new", py_strtab_new, METH_VARARGS, ""},
+    {"strtab_len", py_strtab_len, METH_VARARGS, ""},
+    {"strtab_get_or_insert", py_strtab_get_or_insert, METH_VARARGS, ""},
+    {"strtab_lookup", py_strtab_lookup, METH_VARARGS, ""},
+    {"strtab_get_or_insert_batch", py_strtab_get_or_insert_batch, METH_VARARGS, ""},
+    {"strtab_lookup_batch", py_strtab_lookup_batch, METH_VARARGS, ""},
+    {"strtab_bytes_of", py_strtab_bytes_of, METH_VARARGS, ""},
+    {"i64_new", py_i64_new, METH_VARARGS, ""},
+    {"i64_len", py_i64_len, METH_VARARGS, ""},
+    {"i64_get", py_i64_get, METH_VARARGS, ""},
+    {"i64_put", py_i64_put, METH_VARARGS, ""},
+    {"i64_del", py_i64_del, METH_VARARGS, ""},
+    {"i64_lookup_batch", py_i64_lookup_batch, METH_VARARGS, ""},
+    {"i64_put_batch", py_i64_put_batch, METH_VARARGS, ""},
+    {"i64_get_or_assign_batch", py_i64_get_or_assign_batch, METH_VARARGS, ""},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "cst_ext",
+    "Native staging tables (CPython binding)", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_cst_ext(void) { return PyModule_Create(&moduledef); }

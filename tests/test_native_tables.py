@@ -10,12 +10,16 @@ def impls_str():
     yield nt._PyStrTable
     if nt.load_native():
         yield nt._NativeStrTable
+    if nt.load_ext():
+        yield nt._ExtStrTable
 
 
 def impls_i64():
     yield nt._PyI64Dict
     if nt.load_native():
         yield nt._NativeI64Dict
+    if nt.load_ext():
+        yield nt._ExtI64Dict
 
 
 @pytest.mark.parametrize("cls", list(impls_str()))
@@ -88,5 +92,6 @@ def test_i64_batch(cls):
 
 
 def test_native_available():
-    """The built .so should be present in this repo (make -C native)."""
+    """The built .so files should be present in this repo (make -C native)."""
     assert nt.load_native() is not None
+    assert nt.load_ext() is not None
