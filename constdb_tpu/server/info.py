@@ -61,6 +61,12 @@ def _section_stats(node, out):
     out.append(("total_net_output_bytes", st.net_out_bytes))
     out.append(("merge_batches", st.merges))
     out.append(("merge_rows", st.merge_rows))
+    out.append(("merge_seconds_total", round(st.merge_secs, 6)))
+    if st.merges and st.merge_secs:
+        out.append(("merge_rows_per_sec",
+                    int(st.merge_rows / st.merge_secs)))
+    out.append(("flush_seconds_total", round(st.flush_secs, 6)))
+    out.append(("engine", node.engine.name))
     out.append(("gc_freed", st.gc_freed))
     for k, v in sorted(st.extra.items()):
         out.append((k, v))

@@ -31,6 +31,8 @@ class NodeStats:
     current_clients: int = 0
     merges: int = 0
     merge_rows: int = 0
+    merge_secs: float = 0.0
+    flush_secs: float = 0.0
     gc_freed: int = 0
     start_time: float = 0.0
     extra: dict = field(default_factory=dict)
@@ -99,7 +101,10 @@ class Node:
         With a device-resident engine, merged state stays on the device
         between calls; it flushes to the host lazily before the next read
         (`ensure_flushed`)."""
+        import time
+        t0 = time.perf_counter()
         st = self.engine.merge(self.ks, batch)
+        self.stats.merge_secs += time.perf_counter() - t0
         self.stats.merges += 1
         self.stats.merge_rows += batch.n_rows
         return st
@@ -109,7 +114,10 @@ class Node:
         before any read/write of the numeric plane."""
         flush = getattr(self.engine, "flush", None)
         if flush is not None and getattr(self.engine, "needs_flush", False):
+            import time
+            t0 = time.perf_counter()
             flush(self.ks)
+            self.stats.flush_secs += time.perf_counter() - t0
 
     def canonical(self) -> dict:
         self.ensure_flushed()

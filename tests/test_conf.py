@@ -1,0 +1,28 @@
+"""Config loading: TOML file + CLI overrides (reference src/conf.rs)."""
+
+from constdb_tpu.conf import Config, load_config
+
+
+def test_defaults():
+    cfg = load_config([])
+    assert cfg.port == 9001 and cfg.ip == "127.0.0.1"
+    assert cfg.repl_log_cap == 1_024_000  # reference src/server.rs:81
+    assert cfg.replica_heartbeat_frequency == 4
+
+
+def test_toml_and_flag_priority(tmp_path):
+    toml = tmp_path / "node.toml"
+    toml.write_text(
+        'node_id = 7\nport = 7100\nnode_alias = "alpha"\n'
+        'work_dir = "/tmp/wd"\nreplica_heartbeat_frequency = 2\n'
+        'snapshot_path = "/tmp/db.snapshot"\n')
+    cfg = load_config([str(toml)])
+    assert cfg.node_id == 7 and cfg.port == 7100 and cfg.node_alias == "alpha"
+    assert cfg.replica_heartbeat_frequency == 2
+    assert cfg.snapshot_path == "/tmp/db.snapshot"
+    # CLI flags override the file
+    cfg = load_config([str(toml), "--port", "7200", "--alias", "beta",
+                       "--engine", "cpu"])
+    assert cfg.port == 7200 and cfg.node_alias == "beta"
+    assert cfg.engine == "cpu"
+    assert cfg.node_id == 7  # untouched by flags
