@@ -16,8 +16,9 @@ Prints ONE JSON line:
    "unit": "keys/sec", "vs_baseline": <speedup over the CPU MergeEngine>}
 
 Sizing knobs (env): CONSTDB_BENCH_KEYS (default 1_000_000),
-CONSTDB_BENCH_REPLICAS (default 8), CONSTDB_BENCH_CPU_KEYS (default
-100_000), CONSTDB_BENCH_CHUNK (keys per chunk, default 131072).
+CONSTDB_BENCH_REPLICAS (default 8), CONSTDB_BENCH_CPU_KEYS (defaults to
+CONSTDB_BENCH_KEYS so the baseline rate is same-scale; set lower to cap the
+pure-Python run), CONSTDB_BENCH_CHUNK (keys per chunk, default 131072).
 """
 
 from __future__ import annotations
@@ -147,7 +148,10 @@ def time_engine(make_engine, chunks, repeats: int = 2) -> float:
 def main() -> None:
     n_keys = int(os.environ.get("CONSTDB_BENCH_KEYS", 1_000_000))
     n_rep = int(os.environ.get("CONSTDB_BENCH_REPLICAS", 8))
-    n_cpu = min(n_keys, int(os.environ.get("CONSTDB_BENCH_CPU_KEYS", 100_000)))
+    # CPU baseline defaults to the SAME key count (apples-to-apples rate);
+    # cap it with CONSTDB_BENCH_CPU_KEYS when the pure-Python loop would
+    # take too long at the full scale.
+    n_cpu = min(n_keys, int(os.environ.get("CONSTDB_BENCH_CPU_KEYS", n_keys)))
     chunk = int(os.environ.get("CONSTDB_BENCH_CHUNK", 1 << 17))
 
     print(f"[bench] workload: {n_keys} keys x {n_rep} replicas, "
@@ -162,6 +166,20 @@ def main() -> None:
           f"= {cpu_rate:,.0f} keys/s (workload gen+run "
           f"{time.perf_counter() - t0:.1f}s)", file=sys.stderr)
 
+    # Probe the device backend OUT-OF-PROCESS before touching jax here: a
+    # wedged tunnel-attached device hangs in-process init forever (round-1
+    # BENCH_r01.json died on exactly this).  On a bad probe we still print
+    # a valid JSON line from the XLA-on-CPU device path so the driver
+    # always records a number.
+    from constdb_tpu.utils.backend import force_cpu_platform, probe_backend
+
+    probe = probe_backend()
+    note = ""
+    if not probe.ok:
+        note = f"device backend unavailable ({probe.error}); XLA-on-CPU fallback"
+        print(f"[bench] WARNING: {note}", file=sys.stderr)
+        force_cpu_platform()
+
     from constdb_tpu.engine.tpu import TpuMergeEngine
     import jax
     print(f"[bench] jax backend: {jax.default_backend()} "
@@ -174,15 +192,19 @@ def main() -> None:
     tpu_t = time_engine(lambda: TpuMergeEngine(resident=True), chunks,
                         repeats=2)
     rate = n_keys / tpu_t
-    print(f"[bench] tpu engine (resident): {tpu_t:.3f}s on {n_keys} keys "
+    print(f"[bench] device engine (resident, "
+          f"{jax.default_backend()}): {tpu_t:.3f}s on {n_keys} keys "
           f"= {rate:,.0f} keys/s", file=sys.stderr)
 
-    print(json.dumps({
+    out = {
         "metric": "snapshot_merge_keys_per_sec",
         "value": round(rate, 1),
         "unit": "keys/sec",
         "vs_baseline": round(rate / cpu_rate, 2),
-    }))
+    }
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

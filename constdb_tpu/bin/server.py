@@ -43,6 +43,7 @@ async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
     while True:
         await asyncio.sleep(cfg.snapshot_interval)
         node = app.node
+        node.ensure_flushed()  # device-resident merge state → host first
         capture = batch_from_keyspace(node.ks)  # consistent: on the loop
         meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                         addr=app.advertised_addr,
@@ -96,6 +97,7 @@ async def amain(cfg: Config) -> None:
         t.cancel()
     if cfg.snapshot_path:
         # final synchronous dump so a clean restart resumes warm
+        node.ensure_flushed()  # device-resident merge state → host first
         dump_keyspace(cfg.snapshot_path, node.ks,
                       NodeMeta(node_id=node.node_id, alias=node.alias,
                                addr=app.advertised_addr,

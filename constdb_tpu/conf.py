@@ -76,13 +76,32 @@ def load_config(argv: list[str] | None = None) -> Config:
 
 
 def build_engine(kind: str):
-    """'auto' prefers the TPU engine when a device backend initializes."""
+    """'auto' prefers the TPU engine when a device backend initializes.
+
+    Backend health is checked OUT-OF-PROCESS first (utils/backend.py):
+    a wedged tunnel-attached device hangs in-process init forever, which
+    would wedge node boot under engine="auto".  Probe says healthy →
+    init for real; probe fails → pin this process to the CPU platform
+    (so nothing later in the server accidentally hangs) and fall back.
+    """
     if kind in ("auto", "tpu"):
-        try:
-            from .engine.tpu import TpuMergeEngine
-            return TpuMergeEngine()
-        except Exception:
-            if kind == "tpu":
-                raise
+        from .utils.backend import force_cpu_platform, probe_backend
+
+        probe = probe_backend()
+        if probe.ok and probe.platform != "cpu":
+            try:
+                from .engine.tpu import TpuMergeEngine
+                return TpuMergeEngine()
+            except Exception:
+                # device vanished between probe and real init
+                if kind == "tpu":
+                    raise
+                force_cpu_platform()
+        elif kind == "tpu":
+            raise RuntimeError(
+                f"engine='tpu' requested but no healthy device backend: "
+                f"{probe.error or f'default backend is {probe.platform}'}")
+        if not probe.ok:
+            force_cpu_platform()
     from .engine.cpu import CpuMergeEngine
     return CpuMergeEngine()

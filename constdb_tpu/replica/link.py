@@ -106,7 +106,8 @@ class ReplicaLink:
     async def _dial_loop(self) -> None:
         """Reconnect-forever with backoff (reference
         replica/replica.rs:254-271, 5s retry)."""
-        while not self.closing and self.meta.alive:
+        while not self.closing and self.meta.alive and \
+                not self.meta.dial_suspended:
             if not self.connected:
                 try:
                     await self._dial_once()
@@ -136,6 +137,14 @@ class ReplicaLink:
         self._install(reader, writer, parser, peer_resume)
 
     def _check_sync_reply(self, msg) -> int:
+        from ..resp.message import Err
+        if isinstance(msg, Err) and b"forgotten" in msg.val:
+            # the peer expelled us (FORGET): stop dialing it.  The flag is
+            # cleared when someone re-MEETs us and dials in (adopt()).
+            self.meta.dial_suspended = True
+            log.info("peer %s rejected sync: forgotten; suspending dial",
+                     self.meta.addr)
+            raise CstError(f"forgotten by {self.meta.addr}")
         items = msg.items if isinstance(msg, Arr) else None
         if not items or as_bytes(items[0]).lower() != SYNC or \
                 as_int(items[1]) != 1:
@@ -150,6 +159,7 @@ class ReplicaLink:
               parser: RespParser, peer_resume: int) -> None:
         """Install an inbound connection (the passive side of SYNC —
         reference replica.rs:16-40 steals the client's Conn)."""
+        self.meta.dial_suspended = False  # the mesh re-admitted us
         self._install(reader, writer, parser, peer_resume)
 
     def _install(self, reader, writer, parser, peer_resume: int) -> None:

@@ -43,6 +43,11 @@ class ReplicaMeta:
     uuid_he_acked: int = 0
     # runtime attachment (not replicated): the live link driving this peer
     link: object = field(default=None, repr=False, compare=False)
+    # runtime flag (not replicated): set when this peer rejected our SYNC
+    # as "forgotten" — we are the expelled node; stop dialing until an
+    # inbound connection (someone re-MET us) clears it.  Kept out of the
+    # add_t/del_t LWW so it never corrupts replicated membership.
+    dial_suspended: bool = field(default=False, compare=False)
 
     @property
     def alive(self) -> bool:
@@ -79,6 +84,8 @@ class ReplicaManager:
                 m.node_id = node_id
             if alias:
                 m.alias = alias
+        if m.alive:
+            m.dial_suspended = False  # explicit (re-)MEET re-admits
         return m
 
     def forget(self, addr: str, uuid: int) -> bool:

@@ -203,7 +203,16 @@ class ServerApp:
             return
         node = self.node
         prev = node.replicas.get(peer_addr)
-        newly_met = prev is None or not prev.alive
+        if prev is not None and not prev.alive:
+            # FORGET must stick: a tombstoned peer's SYNC is rejected until
+            # an explicit MEET re-admits the address (Redis CLUSTER
+            # FORGET-style ban).  Auto-re-adding here resurrected forgotten
+            # peers across the whole mesh within one reconnect_delay.
+            writer.write(b"-forgotten: removed from this mesh; "
+                         b"an explicit MEET is required to rejoin\r\n")
+            writer.close()
+            return
+        newly_met = prev is None
         meta = node.replicas.add(peer_addr, node.hlc.tick(True),
                                  node_id=peer_id, alias=peer_alias)
         if newly_met:
@@ -245,6 +254,15 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
         if meta.node_id and not node.node_id:
             node.node_id = meta.node_id
         node.hlc.observe(meta.repl_last_uuid)
+        # The fresh repl_log does not cover any of the restored history, so
+        # a peer resuming below the restored watermark MUST get a full
+        # snapshot — with last_uuid/evicted_up_to left at 0,
+        # can_resume_from(0) would be true and the push loop would serve
+        # PARTSYNC that silently omits every restored key (permanent
+        # divergence).  Same rule the reference applies when the resume
+        # point falls outside the ring (push.rs:95-110).
+        node.repl_log.last_uuid = meta.repl_last_uuid
+        node.repl_log.evicted_up_to = meta.repl_last_uuid
         node.replicas.merge_records(records, my_addr=app.advertised_addr)
         log.info("restored snapshot %s (%d keys)", app.snapshot_path,
                  node.ks.n_keys())

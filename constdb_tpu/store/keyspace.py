@@ -447,6 +447,7 @@ class KeySpace:
         (parity: reference db.rs:82-119, fixed to pop oldest-first and to
         actually collect equal-time entries)."""
         freed = 0
+        el_freed = 0
         while self.garbage:
             t, _seq, key, member = self.garbage[0]
             if t > horizon:
@@ -472,6 +473,14 @@ class KeySpace:
                 self.el_val[row] = None
                 self.el_dead += 1
                 freed += 1
+                el_freed += 1
+        if el_freed:
+            # a resident engine's device mirrors gather/scatter by row id;
+            # any element-row removal (and especially the compaction below,
+            # which REORDERS rows) must invalidate them or later flushes
+            # write stale columns over the collected table.  key_deletes-only
+            # rounds touch no mirrored column and skip the bump.
+            self.version += 1
         if self.el_dead > 10_000 and self.el_dead * 2 > self.el.n:
             self._compact_elements()
         return freed
@@ -480,6 +489,7 @@ class KeySpace:
         """Rebuild element storage without dead rows (replaces free-list
         reuse: row ids must stay stable BETWEEN compactions so the batched
         engine's staged row indices never alias)."""
+        self.version += 1  # row ids change: resident device mirrors are stale
         n = self.el.n
         live = np.nonzero(self.el.kid[:n] >= 0)[0]
         new_el = _ElCols()

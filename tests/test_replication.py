@@ -69,6 +69,54 @@ def test_replicas_and_forget(tmp_path):
     run(main())
 
 
+def test_forget_sticks_and_remeet_readmits(tmp_path):
+    """A forgotten live peer must STAY forgotten: its SYNC attempts are
+    rejected (no auto-meet resurrection through the handshake) and it stops
+    dialing; an explicit MEET re-admits it and the mesh reconverges."""
+    async def main():
+        apps = await make_cluster(3, str(tmp_path))
+        c = [await Client().connect(a.advertised_addr) for a in apps]
+        try:
+            await c[0].cmd("meet", apps[1].advertised_addr)
+            await c[2].cmd("meet", apps[1].advertised_addr)
+            await full_mesh(apps)
+            await c[0].cmd("set", "pre", "1")
+            await converge(apps)
+
+            victim = apps[2].advertised_addr
+            await c[0].cmd("forget", victim)
+            await converge([apps[0], apps[1]])
+
+            # give the victim several reconnect rounds to try to come back
+            await asyncio.sleep(apps[2].reconnect_delay * 4)
+            for app in apps[:2]:
+                m = app.node.replicas.get(victim)
+                assert m is not None and not m.alive, \
+                    f"{app.advertised_addr} resurrected the forgotten peer"
+            # the victim learned it was expelled and stopped dialing
+            assert all(m.dial_suspended or not m.alive
+                       for m in apps[2].node.replicas.peers.values()
+                       if m.addr != victim)
+
+            # writes on the surviving mesh do not reach the victim
+            await c[0].cmd("set", "while-out", "x")
+            await converge([apps[0], apps[1]])
+            await asyncio.sleep(apps[2].reconnect_delay)
+            got = await c[2].cmd("get", "while-out")
+            assert got == Nil()
+
+            # explicit MEET re-admits: full mesh + convergence again
+            await c[0].cmd("meet", victim)
+            await full_mesh(apps)
+            await converge(apps)
+            assert await c[2].cmd("get", "while-out") == Bulk(b"x")
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
 # -------------------------------------------------------------- convergence
 
 async def _mesh3(tmp_path, **kw):

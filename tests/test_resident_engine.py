@@ -125,6 +125,48 @@ def test_scatter_fallback_drops_mirror():
     assert store.register_get(kid) == b"b"
 
 
+def test_gc_compaction_invalidates_resident_mirror():
+    """gc() and element compaction reorder/shrink the element table; a
+    resident engine that kept its device mirror would flush stale
+    add_t/add_node/del_t over the compacted rows.  KeySpace.version must
+    bump so the next merge re-uploads from the host."""
+    src = Node(node_id=2)
+    for i in range(40):
+        _cmd(src, b"sadd", b"s%d" % (i % 4), b"m%d" % i)
+
+    node = Node(node_id=1, engine=TpuMergeEngine(resident=True))
+    ref = Node(node_id=1)  # oracle: CPU engine, same op sequence
+    for c in chunked(src.ks, chunk_keys=11):
+        node.merge_batch(c)
+        ref.merge_batch(c)
+    node.ensure_flushed()
+
+    # tombstone half the members, collect them, and force the compaction
+    # path (row REORDER) regardless of the production thresholds
+    for i in range(0, 40, 2):
+        _cmd(node, b"srem", b"s%d" % (i % 4), b"m%d" % i)
+        _cmd(ref, b"srem", b"s%d" % (i % 4), b"m%d" % i)
+    v0 = node.ks.version
+    assert node.gc() > 0
+    assert node.ks.version > v0
+    node.ks._compact_elements()
+    ref.gc()
+    ref.ks._compact_elements()
+
+    src2 = Node(node_id=3)
+    for i in range(40):
+        _cmd(src2, b"sadd", b"s%d" % (i % 4), b"n%d" % i)
+    for c in chunked(src2.ks, chunk_keys=11):
+        node.merge_batch(c)
+        ref.merge_batch(c)
+    node.ensure_flushed()
+
+    for s in range(4):
+        got = _cmd(node, b"smembers", b"s%d" % s)
+        want = _cmd(ref, b"smembers", b"s%d" % s)
+        assert {m.val for m in got.items} == {m.val for m in want.items}
+
+
 def test_resident_grows_across_merges():
     """State arrays grow (neutral-filled) as later chunks add new slots."""
     eng = TpuMergeEngine(resident=True)

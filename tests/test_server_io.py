@@ -9,7 +9,8 @@ import pytest
 from constdb_tpu.errors import ConnBroken
 from constdb_tpu.resp.message import Arr, Bulk, Err, Int, Nil, Simple
 
-from cluster_util import Client, close_cluster, converge, full_mesh, make_cluster
+from cluster_util import (FAST, Client, close_cluster, converge, full_mesh,
+                          make_cluster)
 
 
 def run(coro):
@@ -130,6 +131,47 @@ def test_snapshot_boot_restore(tmp_path):
             await c2.close()
         finally:
             await app2.close()
+    run(main())
+
+
+def test_restored_node_full_syncs_fresh_peer(tmp_path):
+    """A node restored from a boot snapshot must serve a FULL sync to any
+    peer resuming below the restored watermark: its fresh repl_log holds
+    none of the restored history, so a partial stream would silently omit
+    every restored key (permanent divergence).  Same rule as the reference
+    when the resume point falls outside the ring (push.rs:95-110)."""
+    async def main():
+        from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
+        from constdb_tpu.server.io import start_node
+        from constdb_tpu.server.node import Node
+
+        snap = str(tmp_path / "boot.snapshot")
+        apps = await make_cluster(1, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        for i in range(50):
+            await c.cmd("set", f"old{i}", f"v{i}")
+        dump_keyspace(snap, apps[0].node.ks,
+                      NodeMeta(node_id=apps[0].node.node_id,
+                               repl_last_uuid=apps[0].node.repl_log.last_uuid))
+        await c.close()
+        await close_cluster(apps)
+
+        node2 = Node()
+        app2 = await start_node(node2, host="127.0.0.1", port=0,
+                                work_dir=str(tmp_path), snapshot_path=snap,
+                                **FAST)
+        # the restored log must not claim to cover pre-restore history
+        assert not node2.repl_log.can_resume_from(0)
+        fresh = (await make_cluster(1, str(tmp_path)))[0]
+        try:
+            c2 = await Client().connect(app2.advertised_addr)
+            await c2.cmd("meet", fresh.advertised_addr)
+            await converge([app2, fresh], timeout=15.0)
+            await c2.close()
+            assert fresh.node.ks.n_keys() == node2.ks.n_keys()
+        finally:
+            await app2.close()
+            await fresh.close()
     run(main())
 
 
