@@ -30,16 +30,13 @@ harmless.
 from __future__ import annotations
 
 import asyncio
-import io
 import logging
 import os
 import random
 from typing import Optional, TYPE_CHECKING
 
 from ..errors import CstError, ReplicateCommandsLost
-from ..persist.snapshot import (NodeMeta, SnapshotLoader, SnapshotWriter,
-                                batch_chunks)
-from ..engine.base import batch_from_keyspace
+from ..persist.snapshot import SnapshotLoader
 from ..resp.codec import RespParser, encode_msg
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
 from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
@@ -246,40 +243,24 @@ class ReplicaLink:
             consumer.close()
 
     async def _send_snapshot(self, writer) -> None:
-        """Fork-free full sync: capture the columnar state on the loop
-        (consistent — single-writer), encode+compress on a worker thread,
-        stream length-prefixed bytes (reference push.rs:34-71 +
-        server.rs:221-250, minus the fork)."""
-        node = self.node
-        node.ensure_flushed()  # device-resident merge state → host first
-        capture = batch_from_keyspace(node.ks)
-        repl_last = node.repl_log.last_uuid
-        meta_hdr = NodeMeta(node_id=node.node_id, alias=node.alias,
-                            addr=self.app.advertised_addr,
-                            repl_last_uuid=repl_last)
-        records = node.replicas.records()
-        chunk_keys = self.app.snapshot_chunk_keys
-
-        def encode() -> bytes:
-            buf = io.BytesIO()
-            w = SnapshotWriter(buf)
-            w.write_node(meta_hdr)
-            w.write_replicas(records)
-            for chunk in batch_chunks(capture, chunk_keys):
-                w.write_chunk(chunk)
-            w.finish()
-            return buf.getvalue()
-
-        blob = await asyncio.to_thread(encode)
-        self.node.stats.extra["last_snapshot_bytes"] = len(blob)
+        """Fork-free full sync with bounded memory: acquire the node's
+        SHARED on-disk dump (produced once, reused by every concurrently
+        or subsequently syncing peer while the repl_log still covers its
+        watermark — reference server.rs:221-250 reuse + push.rs:34-71
+        send_file, minus the fork) and stream the file to the socket in
+        fixed-size pieces.  After the snapshot, the push loop streams the
+        repl_log gap from the dump's watermark — which `can_resume_from`
+        guarantees is still present."""
+        dump = await self.app.shared_dump.acquire()
         self.node.stats.extra["full_syncs_sent"] = \
             self.node.stats.extra.get("full_syncs_sent", 0) + 1
-        writer.write(encode_msg(Arr([Bulk(FULLSYNC), Int(len(blob)),
-                                     Int(repl_last)])))
-        for off in range(0, len(blob), _READ_CHUNK):
-            writer.write(blob[off:off + _READ_CHUNK])
-            await writer.drain()
-        self.meta.uuid_i_sent = repl_last
+        writer.write(encode_msg(Arr([Bulk(FULLSYNC), Int(dump.size),
+                                     Int(dump.repl_last)])))
+        with open(dump.path, "rb") as f:
+            while piece := f.read(_READ_CHUNK):
+                writer.write(piece)
+                await writer.drain()
+        self.meta.uuid_i_sent = dump.repl_last
 
     # ----------------------------------------------------------------- pull
 

@@ -58,6 +58,8 @@ class ServerApp:
         self._server: Optional[asyncio.base_events.Server] = None
         self._cron_task: Optional[asyncio.Task] = None
         self._conn_tasks: set[asyncio.Task] = set()
+        from ..persist.share import SharedDump
+        self.shared_dump = SharedDump(self)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -117,6 +117,52 @@ def test_forget_sticks_and_remeet_readmits(tmp_path):
     run(main())
 
 
+def test_full_sync_dump_shared_and_reused(tmp_path):
+    """Full syncs stream one shared on-disk dump: two peers syncing at
+    once produce ONE dump; a later peer reuses it while the repl_log still
+    covers its watermark; a peer arriving after eviction forces a fresh
+    dump (reference server.rs:221-250 reuse rule, minus the fork)."""
+    async def main():
+        apps = await make_cluster(4, str(tmp_path), repl_log_cap=2_000)
+        c = [await Client().connect(a.advertised_addr) for a in apps]
+        try:
+            # enough data that catch-up must go through a full snapshot
+            for i in range(300):
+                await c[0].cmd("set", f"k{i}", f"v{i}")
+            # two peers join concurrently → one dump serves both
+            await asyncio.gather(c[1].cmd("meet", apps[0].advertised_addr),
+                                 c[2].cmd("meet", apps[0].advertised_addr))
+            await converge(apps[:3], timeout=20.0)
+            assert apps[0].shared_dump.dumps_taken == 1
+
+            # a later joiner reuses the same dump: no writes happened, the
+            # log still covers the dump watermark
+            await c[3].cmd("meet", apps[0].advertised_addr)
+            await converge(apps, timeout=20.0)
+            assert apps[0].shared_dump.dumps_taken == 1
+
+            # evict the log past the dump watermark → next full sync must
+            # re-dump (the cached file can no longer be topped up)
+            for i in range(300):
+                await c[0].cmd("set", f"m{i}", f"w{i}")
+            assert not apps[0].node.repl_log.can_resume_from(
+                apps[0].shared_dump._current.repl_last)
+            fresh = (await make_cluster(1, str(tmp_path)))[0]
+            try:
+                cf = await Client().connect(fresh.advertised_addr)
+                await cf.cmd("meet", apps[0].advertised_addr)
+                await converge([apps[0], fresh], timeout=20.0)
+                await cf.close()
+                assert apps[0].shared_dump.dumps_taken == 2
+            finally:
+                await fresh.close()
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
 # -------------------------------------------------------------- convergence
 
 async def _mesh3(tmp_path, **kw):
