@@ -84,7 +84,14 @@ class TpuMergeEngine:
     # (resident mode always prefers bulk: there is no state upload to avoid)
     BULK_FRACTION = 8
 
-    def __init__(self, resident: bool = False) -> None:
+    def __init__(self, resident: bool = False, mesh=None) -> None:
+        """`mesh`: an optional jax.sharding.Mesh with a "kv" axis.  When
+        given, per-slot device state range-partitions over that axis
+        (NamedSharding P("kv")) while batch rows replicate — GSPMD then
+        partitions the very same bulk kernels across the slice, with each
+        device scattering the rows that land in its slot range.  Sharding
+        is placement policy only: kernels, semantics, and host plumbing
+        are identical to the single-chip path (SURVEY.md §7 item 6)."""
         import jax  # ensure a backend exists before we advertise ourselves
 
         self._jax = jax
@@ -93,6 +100,71 @@ class TpuMergeEngine:
         self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
         self._seen_version = -1
         self.needs_flush = False
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._kv_n = int(mesh.shape["kv"])
+            self._sh_state = (None, NamedSharding(mesh, PartitionSpec("kv")),
+                              NamedSharding(mesh, PartitionSpec("kv", None)))
+            self._sh_rep = NamedSharding(mesh, PartitionSpec())
+            self._jit_cache: dict = {}
+        else:
+            self._kv_n = 1
+
+    # ----------------------------------------------------- device placement
+
+    def _sp_size(self, size: int) -> int:
+        """Padded state size: pow2, and divisible by the kv axis."""
+        return max(K.next_pow2(max(size, 1)), self._kv_n)
+
+    def _put_state(self, host: np.ndarray):
+        if self._mesh is None:
+            return self._jax.device_put(host)
+        return self._jax.device_put(host, self._sh_state[host.ndim])
+
+    def _put_batch(self, arr: np.ndarray):
+        if self._mesh is None:
+            return self._jax.device_put(arr)
+        return self._jax.device_put(arr, self._sh_rep)
+
+    def _full(self, n: int, fill: int, cols: int = 0):
+        """Neutral state materialized on device with the state sharding
+        (cols=0 → [n]; cols=C → [n, C])."""
+        if self._mesh is None:
+            if cols:
+                return self._jax.numpy.zeros((n, cols),
+                                             dtype=self._jax.numpy.int64)
+            return B.device_full(n, fill)
+        key = ("full", n, fill, cols)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            jnp = self._jax.numpy
+            shape = (n, cols) if cols else (n,)
+            fn = self._jax.jit(
+                lambda: jnp.full(shape, fill, dtype=jnp.int64),
+                out_shardings=self._sh_state[2 if cols else 1])
+            self._jit_cache[key] = fn
+        return fn()
+
+    def _grow(self, old, delta: int, fill: int, cols: int = 0):
+        """Extend resident state by `delta` neutral rows, preserving the
+        state sharding."""
+        jnp = self._jax.numpy
+        if self._mesh is None:
+            if cols:
+                return jnp.concatenate(
+                    [old, jnp.zeros((delta, cols), dtype=jnp.int64)])
+            return jnp.concatenate([old, B.device_full(delta, fill)])
+        key = ("grow", delta, fill, cols)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            shape = (delta, cols) if cols else (delta,)
+            fn = self._jax.jit(
+                lambda o: jnp.concatenate(
+                    [o, jnp.full(shape, fill, dtype=jnp.int64)]),
+                out_shardings=self._sh_state[2 if cols else 1])
+            self._jit_cache[key] = fn
+        return fn(old)
 
     # ------------------------------------------------------------------ API
 
@@ -178,28 +250,25 @@ class TpuMergeEngine:
         """Device state dict for family `fam` covering rows [0, n); grows
         (neutral-filled) as the host table grows.  Returns (cols, cap)."""
         res = self._res.get(fam)
-        cap = K.next_pow2(max(n, 1))
+        cap = self._sp_size(n)
         spec = _FAMILIES[fam]
         if res is None:
             table = _host_table(store, fam)
             if fam == "env":
                 host = np.stack([table.col(c)[:n] for c, _ in spec], axis=-1)
-                cols = {"stack": self._jax.device_put(_pad(host, cap, 0))}
+                cols = {"stack": self._put_state(_pad(host, cap, 0))}
             else:
-                cols = {c: self._jax.device_put(
+                cols = {c: self._put_state(
                     _pad(table.col(c)[:n], cap, fill)) for c, fill in spec}
         elif n > res["cap"]:
             old = res["cols"]
-            jnp = self._jax.numpy
+            delta = cap - res["cap"]
             if fam == "env":
-                grown = jnp.concatenate(
-                    [old["stack"], jnp.zeros((cap - res["cap"], len(spec)),
-                                             dtype=jnp.int64)])
-                cols = {"stack": grown}
+                cols = {"stack": self._grow(old["stack"], delta, 0,
+                                            cols=len(spec))}
             else:
-                cols = {c: jnp.concatenate(
-                    [old[c], B.device_full(cap - res["cap"], fill)])
-                    for c, fill in spec}
+                cols = {c: self._grow(old[c], delta, fill)
+                        for c, fill in spec}
         else:
             cols = res["cols"]
             cap = res["cap"]
@@ -270,8 +339,11 @@ class TpuMergeEngine:
     def _use_bulk(self, total_rows: int, region: int) -> bool:
         if not self._unique_ok:
             return False
-        if self.resident:
-            return True  # no state upload to amortize — bulk always wins
+        if self.resident or self._mesh is not None:
+            # resident: no state upload to amortize — bulk always wins.
+            # mesh: bulk is the sharded path; the scatter fallback would
+            # run single-device.
+            return True
         return region > 0 and total_rows * self.BULK_FRACTION >= region
 
     @staticmethod
@@ -289,8 +361,9 @@ class TpuMergeEngine:
     def _upload_batch(self, rows: np.ndarray, base: int, sp: int,
                       cols: list[tuple[np.ndarray, int]]):
         """Async-upload one batch: int32 ids (padded with distinct
-        out-of-range slots) + padded value columns."""
-        put = self._jax.device_put
+        out-of-range slots) + padded value columns.  On a mesh, batch rows
+        replicate to every device (each scatters its slot range)."""
+        put = self._put_batch
         n = len(rows)
         np_ = K.next_pow2(max(n, 1))
         idx = np.empty(np_, dtype=_I32)
@@ -302,8 +375,8 @@ class TpuMergeEngine:
     def _state_up(self, col: np.ndarray, base: int, size: int, sp: int,
                   fill: int, all_new: bool):
         if all_new:
-            return B.device_full(sp, fill)
-        return self._jax.device_put(_pad(col[base:base + size], sp, fill))
+            return self._full(sp, fill)
+        return self._put_state(_pad(col[base:base + size], sp, fill))
 
     # ------------------------------------------------------------ envelopes
 
@@ -328,16 +401,15 @@ class TpuMergeEngine:
                 state = cols["stack"]
                 base = 0
             else:
-                sp = K.next_pow2(size)
+                sp = self._sp_size(size)
                 if all_new:
-                    state = self._jax.numpy.zeros((sp, 4),
-                                                  dtype=self._jax.numpy.int64)
+                    state = self._full(sp, 0, cols=4)
                 else:
                     host = np.stack([store.keys.ct[base:n],
                                      store.keys.mt[base:n],
                                      store.keys.dt[base:n],
                                      store.keys.expire[base:n]], axis=-1)
-                    state = self._jax.device_put(_pad(host, sp, 0))
+                    state = self._put_state(_pad(host, sp, 0))
             dev = [self._upload_batch(
                 p, base, sp, [(np.stack(c, axis=-1), 0)])
                 for p, c in staged]
@@ -401,7 +473,7 @@ class TpuMergeEngine:
                 t, nd = cols["rv_t"], cols["rv_node"]
                 base = 0
             else:
-                sp = K.next_pow2(size)
+                sp = self._sp_size(size)
                 t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
                 nd = self._state_up(store.keys.rv_node, base, size, sp, 0,
                                     all_new)
@@ -484,7 +556,7 @@ class TpuMergeEngine:
                 cb, cbt = cols["base"], cols["base_t"]
                 base = 0
             else:
-                sp = K.next_pow2(size)
+                sp = self._sp_size(size)
                 val = self._state_up(store.cnt.val, base, size, sp, 0, all_new)
                 uuid = self._state_up(store.cnt.uuid, base, size, sp,
                                       K.NEUTRAL_T, all_new)
@@ -598,7 +670,7 @@ class TpuMergeEngine:
                 base, size = 0, n
                 old_dt = None  # garbage enqueue deferred to flush
             else:
-                sp = K.next_pow2(size)
+                sp = self._sp_size(size)
                 old_dt = (np.zeros(size, dtype=_I64) if all_new
                           else store.el.del_t[base:n].copy())
                 at = self._state_up(store.el.add_t, base, size, sp, 0, all_new)

@@ -1,3 +1,5 @@
-from .sharded import make_mesh, sharded_merge_step, shard_batch_arrays
+from .sharded import (engine_mesh, make_mesh, shard_batch_arrays,
+                      sharded_merge_step)
 
-__all__ = ["make_mesh", "sharded_merge_step", "shard_batch_arrays"]
+__all__ = ["engine_mesh", "make_mesh", "sharded_merge_step",
+           "shard_batch_arrays"]

@@ -47,6 +47,16 @@ def make_mesh(n_devices: Optional[int] = None, rep: int = 1) -> Mesh:
     return Mesh(grid, ("rep", "kv"))
 
 
+def engine_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1D ("kv",) mesh for `TpuMergeEngine(mesh=...)`: the production
+    merge path range-partitions per-slot state over this axis (batches
+    arrive sequentially from the replica links, so the engine's only
+    intra-node parallel axis is the keyspace)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), ("kv",))
+
+
 def _local_merge(vals, ts, at, an, dt, env):
     """Per-device partial reduction over the local R-shard, then global
     combination over the "rep" mesh axis."""
