@@ -129,16 +129,24 @@ def chunk_batches(batches, chunk_keys: int):
     return out
 
 
-def time_engine(make_engine, chunks, repeats: int = 2) -> float:
+def time_engine(make_engine, chunks, repeats: int = 2,
+                group: int = 1) -> float:
     """Best wall-time over `repeats` streamed catch-ups into a fresh store
-    (includes the final flush for resident engines)."""
+    (includes the final flush for resident engines).  `group` > 1 feeds
+    that many consecutive chunks per engine call (merge_many) — with the
+    interleaved arrival order, groups of n_replicas are slot-ALIGNED and
+    take the engine's fused dense-fold path (one scatter per group)."""
     best = float("inf")
     for _ in range(repeats):
         engine = make_engine()
         store = KeySpace()
         t0 = time.perf_counter()
-        for c in chunks:
-            engine.merge(store, c)
+        if group > 1 and hasattr(engine, "merge_many"):
+            for i in range(0, len(chunks), group):
+                engine.merge_many(store, chunks[i:i + group])
+        else:
+            for c in chunks:
+                engine.merge(store, c)
         if getattr(engine, "needs_flush", False):
             engine.flush(store)
         best = min(best, time.perf_counter() - t0)
@@ -189,12 +197,20 @@ def main() -> None:
     chunks = chunk_batches(make_workload(n_keys, n_rep, seed=7), chunk)
     print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s "
           f"({len(chunks)} chunks)", file=sys.stderr)
-    tpu_t = time_engine(lambda: TpuMergeEngine(resident=True), chunks,
-                        repeats=2)
+    group = int(os.environ.get("CONSTDB_BENCH_GROUP", "1"))
+    fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
+    eng_holder = {}
+
+    def make_eng():
+        eng_holder["e"] = TpuMergeEngine(resident=True, dense_fold=fold)
+        return eng_holder["e"]
+
+    tpu_t = time_engine(make_eng, chunks, repeats=2, group=group)
     rate = n_keys / tpu_t
-    print(f"[bench] device engine (resident, "
-          f"{jax.default_backend()}): {tpu_t:.3f}s on {n_keys} keys "
-          f"= {rate:,.0f} keys/s", file=sys.stderr)
+    print(f"[bench] device engine (resident, {jax.default_backend()}, "
+          f"group={group}, folds={eng_holder['e'].folds}): "
+          f"{tpu_t:.3f}s on {n_keys} keys = {rate:,.0f} keys/s",
+          file=sys.stderr)
 
     out = {
         "metric": "snapshot_merge_keys_per_sec",

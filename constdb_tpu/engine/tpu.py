@@ -84,18 +84,31 @@ class TpuMergeEngine:
     # (resident mode always prefers bulk: there is no state upload to avoid)
     BULK_FRACTION = 8
 
-    def __init__(self, resident: bool = False, mesh=None) -> None:
+    def __init__(self, resident: bool = False, mesh=None,
+                 dense_fold: str = "auto") -> None:
         """`mesh`: an optional jax.sharding.Mesh with a "kv" axis.  When
         given, per-slot device state range-partitions over that axis
         (NamedSharding P("kv")) while batch rows replicate — GSPMD then
         partitions the very same bulk kernels across the slice, with each
         device scattering the rows that land in its slot range.  Sharding
         is placement policy only: kernels, semantics, and host plumbing
-        are identical to the single-chip path (SURVEY.md §7 item 6)."""
+        are identical to the single-chip path (SURVEY.md §7 item 6).
+
+        `dense_fold`: strategy for ALIGNED multi-batch merges (several
+        batches staging the exact same slot rows — R replica snapshots of
+        one keyspace, the bulk catch-up shape).  Aligned batches reduce
+        on-device in one fused [R, N] pass, then scatter ONCE instead of
+        R times.  "auto" = fused Pallas kernels (ops/pallas_dense.py) on
+        TPU backends, XLA dense kernels (ops/dense.py) elsewhere; "pallas"
+        / "pallas-interpret" / "xla" force a backend; "off" disables
+        folding.  Both backends are differential-tested bit-identical."""
         import jax  # ensure a backend exists before we advertise ourselves
 
         self._jax = jax
         self._devices = jax.devices()
+        self.dense_fold = dense_fold
+        self.folds = 0          # aligned folds performed (observability)
+        self._pallas_broken = False
         self.resident = resident
         self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
         self._seen_version = -1
@@ -114,8 +127,12 @@ class TpuMergeEngine:
     # ----------------------------------------------------- device placement
 
     def _sp_size(self, size: int) -> int:
-        """Padded state size: pow2, and divisible by the kv axis."""
-        return max(K.next_pow2(max(size, 1)), self._kv_n)
+        """Padded state size: pow2, rounded up to a multiple of the kv
+        axis (a non-pow2 device count otherwise fails sharding)."""
+        sp = K.next_pow2(max(size, 1))
+        if self._kv_n > 1 and sp % self._kv_n:
+            sp = -(-sp // self._kv_n) * self._kv_n
+        return sp
 
     def _put_state(self, host: np.ndarray):
         if self._mesh is None:
@@ -363,20 +380,127 @@ class TpuMergeEngine:
         """Async-upload one batch: int32 ids (padded with distinct
         out-of-range slots) + padded value columns.  On a mesh, batch rows
         replicate to every device (each scatters its slot range)."""
-        put = self._put_batch
         n = len(rows)
         np_ = K.next_pow2(max(n, 1))
+        return [self._batch_idx(rows, base, sp, np_)] + \
+            [self._put_batch(_pad(c, np_, fill)) for c, fill in cols]
+
+    def _batch_idx(self, rows: np.ndarray, base: int, sp: int, np_: int):
+        n = len(rows)
         idx = np.empty(np_, dtype=_I32)
         idx[:n] = rows - base
         if np_ > n:
             idx[n:] = sp + np.arange(np_ - n, dtype=_I32)
-        return [put(idx)] + [put(_pad(c, np_, fill)) for c, fill in cols]
+        return self._put_batch(idx)
 
     def _state_up(self, col: np.ndarray, base: int, size: int, sp: int,
                   fill: int, all_new: bool):
         if all_new:
             return self._full(sp, fill)
         return self._put_state(_pad(col[base:base + size], sp, fill))
+
+    # ---------------------------------------------------- aligned-batch fold
+    # R batches staging the exact same slot rows (R replica snapshots of one
+    # keyspace — the bulk catch-up shape) reduce on-device in one fused
+    # [R, N] pass, then scatter ONCE.  Counter rows fold too, but only
+    # align for repeated syncs from the SAME origin (replica snapshots
+    # carry per-(key, node) slots, which differ per replica).
+
+    @staticmethod
+    def _aligned(staged) -> bool:
+        if len(staged) < 2:
+            return False
+        r0 = staged[0][0]
+        return all(len(s[0]) == len(r0) and np.array_equal(s[0], r0)
+                   for s in staged[1:])
+
+    def _fold_prep(self, staged, base: int, sp: int):
+        """Common fold staging: (rows0, nA, np_, device idx)."""
+        rows0 = staged[0][0]
+        nA = len(rows0)
+        np_ = K.next_pow2(max(nA, 1))
+        self.folds += 1
+        return rows0, nA, np_, self._batch_idx(rows0, base, sp, np_)
+
+    @staticmethod
+    def _stacked(staged, i: int, fill, np_: int) -> np.ndarray:
+        return np.stack([_pad(s[i], np_, fill) for s in staged])
+
+    def _fold_backend(self) -> str:
+        mode = self.dense_fold
+        if mode in ("off", "pallas", "pallas-interpret", "xla"):
+            return mode
+        if self._pallas_broken:
+            return "xla"
+        # Pallas lowers through Mosaic on TPU backends only; the mesh path
+        # keeps XLA (pallas_call inside GSPMD needs per-shard shapes)
+        if self._mesh is not None:
+            return "xla"
+        return "pallas" if self._jax.default_backend() != "cpu" else "xla"
+
+    def _fold_lex(self, t_s, n_s, d_s):
+        """[R, N] stacks -> per-slot lexicographic (t, n) winner, max d,
+        winning batch row: (t[N], n[N], d[N], win_batch[N]) on device."""
+        be = self._fold_backend()
+        if be.startswith("pallas"):
+            from ..ops import pallas_dense as PD
+            try:
+                return PD.merge_elems(
+                    self._put_batch(t_s), self._put_batch(n_s),
+                    self._put_batch(d_s),
+                    interpret=(be == "pallas-interpret"))
+            except Exception:
+                if self.dense_fold != "auto":
+                    raise
+                log.warning("pallas fold unavailable; falling back to XLA",
+                            exc_info=True)
+                self._pallas_broken = True
+        from ..ops import dense as D
+        return D.dense_merge_elems(self._put_batch(t_s), self._put_batch(n_s),
+                                   self._put_batch(d_s))
+
+    def _fold_lww(self, t_s, n_s):
+        """[R, N] stacks -> plain (t, node) LWW winner: (t[N], n[N],
+        win_batch[N]) on device.  The del side the element kernel wants is
+        fabricated ON DEVICE (zeros never cross the host link)."""
+        be = self._fold_backend()
+        if be.startswith("pallas"):
+            from ..ops import pallas_dense as PD
+            try:
+                t_d = self._put_batch(t_s)
+                at, an, _dt, win = PD.merge_elems(
+                    t_d, self._put_batch(n_s),
+                    self._jax.numpy.zeros_like(t_d),
+                    interpret=(be == "pallas-interpret"))
+                return at, an, win
+            except Exception:
+                if self.dense_fold != "auto":
+                    raise
+                log.warning("pallas fold unavailable; falling back to XLA",
+                            exc_info=True)
+                self._pallas_broken = True
+        from ..ops import dense as D
+        return D.dense_merge_lww(self._put_batch(t_s), self._put_batch(n_s))
+
+    def _fold_pair(self, v_s, t_s):
+        """[R, N] stacks -> per-slot (value @ time) LWW with max-value tie:
+        (val[N], t[N]) on device (counter slots — no win flags needed)."""
+        be = self._fold_backend()
+        if be.startswith("pallas"):
+            from ..ops import pallas_dense as PD
+            try:
+                return PD.merge_counters(
+                    self._put_batch(v_s), self._put_batch(t_s),
+                    interpret=(be == "pallas-interpret"))
+            except Exception:
+                if self.dense_fold != "auto":
+                    raise
+                log.warning("pallas fold unavailable; falling back to XLA",
+                            exc_info=True)
+                self._pallas_broken = True
+        from ..ops import dense as D
+        return D.dense_merge_counters(self._put_batch(v_s),
+                                      self._put_batch(t_s))
 
     # ------------------------------------------------------------ envelopes
 
@@ -410,11 +534,21 @@ class TpuMergeEngine:
                                      store.keys.dt[base:n],
                                      store.keys.expire[base:n]], axis=-1)
                     state = self._put_state(_pad(host, sp, 0))
-            dev = [self._upload_batch(
-                p, base, sp, [(np.stack(c, axis=-1), 0)])
-                for p, c in staged]
-            for idx, c in dev:
-                state = B.bulk_max(state, idx, c)
+            if self._fold_backend() != "off" and self._aligned(staged):
+                # envelopes are plain max — one stacked XLA reduction, one
+                # scatter (no win flags to track)
+                from ..ops import dense as D
+                rows0, _nA, np_, idx = self._fold_prep(staged, base, sp)
+                stack = np.stack([_pad(np.stack(c, axis=-1), np_, 0)
+                                  for _, c in staged])
+                state = B.bulk_max(state, idx,
+                                   D.dense_max(self._put_batch(stack)))
+            else:
+                dev = [self._upload_batch(
+                    p, base, sp, [(np.stack(c, axis=-1), 0)])
+                    for p, c in staged]
+                for idx, c in dev:
+                    state = B.bulk_max(state, idx, c)
             if self.resident:
                 self._family_done("env", {"stack": state}, n, sp)
                 return
@@ -477,19 +611,34 @@ class TpuMergeEngine:
                 t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
                 nd = self._state_up(store.keys.rv_node, base, size, sp, 0,
                                     all_new)
-            dev = [self._upload_batch(p, base, sp,
-                                      [(bt, K.NEUTRAL_T), (bn, K.NEUTRAL_T)])
-                   for p, bt, bn, _ in staged]
-            wins = []
-            for idx, bt, bn in dev:
-                t, nd, win = B.bulk_lww(t, nd, idx, bt, bn)
-                wins.append(win)
+            fold = self._fold_backend() != "off" and self._aligned(staged)
+            if fold:
+                rows0, nA, np_, idx = self._fold_prep(staged, base, sp)
+                ft, fn, winb = self._fold_lww(
+                    self._stacked(staged, 1, K.NEUTRAL_T, np_),
+                    self._stacked(staged, 2, K.NEUTRAL_T, np_))
+                t, nd, win = B.bulk_lww(t, nd, idx, ft, fn)
+                wins = [win]
+            else:
+                dev = [self._upload_batch(p, base, sp,
+                                          [(bt, K.NEUTRAL_T),
+                                           (bn, K.NEUTRAL_T)])
+                       for p, bt, bn, _ in staged]
+                wins = []
+                for idx, bt, bn in dev:
+                    t, nd, win = B.bulk_lww(t, nd, idx, bt, bn)
+                    wins.append(win)
             if self.resident:
                 self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp)
             else:
                 store.keys.rv_t[base:n] = np.asarray(t)[:size]
                 store.keys.rv_node[base:n] = np.asarray(nd)[:size]
             reg_val = store.reg_val
+            if fold:
+                winb_h = np.asarray(winb)
+                for j in np.nonzero(np.asarray(wins[0])[:nA])[0]:
+                    reg_val[int(rows0[j])] = staged[int(winb_h[j])][3][int(j)]
+                return
             for (pos, _, _, vals), win in zip(staged, wins):
                 for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
                     reg_val[int(pos[j])] = vals[int(j)]
@@ -563,13 +712,27 @@ class TpuMergeEngine:
                 cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
                 cbt = self._state_up(store.cnt.base_t, base, size, sp,
                                      K.NEUTRAL_T, all_new)
-            dev = [self._upload_batch(
-                r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
-                              (bt, K.NEUTRAL_T)])
-                for r, v, u, bb, bt in staged]
-            for idx, v, u, bb, bt in dev:
+            if self._fold_backend() != "off" and self._aligned(staged):
+                # aligned counter rows (same (key, node) slots per batch —
+                # repeated syncs from one origin): fold both (value @ time)
+                # pairs on-device, scatter once
+                rows0, _nA, np_, idx = self._fold_prep(staged, base, sp)
+                fv, fu = self._fold_pair(
+                    self._stacked(staged, 1, 0, np_),
+                    self._stacked(staged, 2, K.NEUTRAL_T, np_))
+                fb, fbt = self._fold_pair(
+                    self._stacked(staged, 3, 0, np_),
+                    self._stacked(staged, 4, K.NEUTRAL_T, np_))
                 val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
-                                                     idx, v, u, bb, bt)
+                                                     idx, fv, fu, fb, fbt)
+            else:
+                dev = [self._upload_batch(
+                    r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
+                                  (bt, K.NEUTRAL_T)])
+                    for r, v, u, bb, bt in staged]
+                for idx, v, u, bb, bt in dev:
+                    val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
+                                                         idx, v, u, bb, bt)
             if self.resident:
                 self._family_done("cnt", {"val": val, "uuid": uuid,
                                           "base": cb, "base_t": cbt}, n, sp)
@@ -677,13 +840,23 @@ class TpuMergeEngine:
                 an = self._state_up(store.el.add_node, base, size, sp, 0,
                                     all_new)
                 dt = self._state_up(store.el.del_t, base, size, sp, 0, all_new)
-            dev = [self._upload_batch(
-                r, base, sp, [(a, K.NEUTRAL_T), (x, K.NEUTRAL_T), (d, 0)])
-                for r, a, x, d, _, _ in staged]
-            wins = []
-            for idx, a, x, d in dev:
-                at, an, dt, win = B.bulk_elems(at, an, dt, idx, a, x, d)
-                wins.append(win)
+            fold = self._fold_backend() != "off" and self._aligned(staged)
+            if fold:
+                rows0, nA, np_, idx = self._fold_prep(staged, base, sp)
+                fa, fx, fd, winb = self._fold_lex(
+                    self._stacked(staged, 1, K.NEUTRAL_T, np_),
+                    self._stacked(staged, 2, K.NEUTRAL_T, np_),
+                    self._stacked(staged, 3, 0, np_))
+                at, an, dt, win = B.bulk_elems(at, an, dt, idx, fa, fx, fd)
+                wins = [win]
+            else:
+                dev = [self._upload_batch(
+                    r, base, sp, [(a, K.NEUTRAL_T), (x, K.NEUTRAL_T), (d, 0)])
+                    for r, a, x, d, _, _ in staged]
+                wins = []
+                for idx, a, x, d in dev:
+                    at, an, dt, win = B.bulk_elems(at, an, dt, idx, a, x, d)
+                    wins.append(win)
             if self.resident:
                 self._family_done("el", {"add_t": at, "add_node": an,
                                          "del_t": dt}, n, sp)
@@ -696,11 +869,30 @@ class TpuMergeEngine:
                 self._enqueue_elem_garbage(store, np.arange(base, n), m_at,
                                            m_dt, old_dt)
             el_val = store.el_val
+            el_kid = store.el.kid
+            enc = store.keys.enc
+            if fold:
+                # CPU parity: the winning row's value — None included —
+                # replaces the slot's.  Values live only on dict kids, so
+                # the Python loop is vectorized down to dict rows; set rows
+                # are None-over-None no-ops.
+                winb_h = np.asarray(winb)
+                cand = np.asarray(wins[0])[:nA] & \
+                    (enc[el_kid[rows0]] == S.ENC_DICT)
+                for j in np.nonzero(cand)[0]:
+                    el_val[int(rows0[j])] = staged[int(winb_h[j])][4][int(j)]
+                return
             for (pos, _, _, _, vals, has_vals), win in zip(staged, wins):
-                if not has_vals:
-                    continue
-                for j in np.nonzero(np.asarray(win)[: len(pos)])[0]:
-                    el_val[int(pos[j])] = vals[int(j)]
+                win_arr = np.asarray(win)[: len(pos)]
+                if has_vals:
+                    for j in np.nonzero(win_arr)[0]:
+                        el_val[int(pos[j])] = vals[int(j)]
+                else:
+                    # valueless batch: winning None adds must still CLEAR
+                    # dict values (CPU parity); set rows need no touch
+                    cand = win_arr & (enc[el_kid[pos]] == S.ENC_DICT)
+                    for j in np.nonzero(cand)[0]:
+                        el_val[int(pos[j])] = None
             return
 
         self._drop_family(store, "el")

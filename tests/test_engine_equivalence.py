@@ -226,3 +226,153 @@ def test_mesh_engine_state_is_sharded(kv_mesh):
             assert spec and spec[0] == "kv", \
                 f"{fam}.{name} not kv-sharded: {arr.sharding}"
     eng.flush(b)
+
+
+# ---------------------------------------------- aligned multi-batch fold
+
+@pytest.fixture(scope="module")
+def aligned_batches():
+    import bench
+
+    return bench.make_workload(600, 4, seed=11)
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas-interpret"])
+def test_aligned_fold_matches_cpu(aligned_batches, mode):
+    """R aligned replica snapshots reduce on-device in one fused pass
+    (Pallas on TPU / XLA dense elsewhere) then scatter once; the result
+    must stay bit-identical to the CPU engine folding them one by one."""
+    cpu_store = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in aligned_batches:
+        cpu.merge(cpu_store, b)
+
+    eng = TpuMergeEngine(dense_fold=mode)
+    st = KeySpace()
+    eng.merge_many(st, aligned_batches)
+    assert eng.folds > 0, "aligned fold did not trigger"
+    assert st.canonical() == cpu_store.canonical()
+    assert both_sums(st) == both_sums(cpu_store)
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas-interpret"])
+def test_aligned_fold_onto_existing_state(aligned_batches, mode):
+    """Folding onto a non-empty store: the single scatter must still merge
+    correctly against resident prior state."""
+    first, rest = aligned_batches[0], aligned_batches[1:]
+
+    cpu_store = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in aligned_batches:
+        cpu.merge(cpu_store, b)
+
+    eng = TpuMergeEngine(resident=True, dense_fold=mode)
+    st = KeySpace()
+    eng.merge(st, first)
+    eng.merge_many(st, rest)
+    assert eng.folds > 0
+    eng.flush(st)
+    assert st.canonical() == cpu_store.canonical()
+
+
+def test_fold_off_still_matches(aligned_batches):
+    eng = TpuMergeEngine(dense_fold="off")
+    st = KeySpace()
+    eng.merge_many(st, aligned_batches)
+    assert eng.folds == 0
+    cpu_store = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in aligned_batches:
+        cpu.merge(cpu_store, b)
+    assert st.canonical() == cpu_store.canonical()
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas-interpret"])
+def test_aligned_counter_fold_matches_cpu(mode):
+    """Aligned counter rows (same (key, node) slots in every batch —
+    repeated syncs from one origin) fold via the fused pair kernel."""
+    import bench
+
+    batches = bench.make_workload(400, 1, seed=3)
+    # same origin twice, second sync with advanced uuids/values
+    b2 = bench.make_workload(400, 1, seed=4)[0]
+    b2.cnt_node = batches[0].cnt_node
+    many = [batches[0], b2]
+
+    cpu_store = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in many:
+        cpu.merge(cpu_store, b)
+
+    eng = TpuMergeEngine(dense_fold=mode)
+    st = KeySpace()
+    eng.merge_many(st, many)
+    assert eng.folds > 0
+    assert st.canonical() == cpu_store.canonical()
+    assert both_sums(st) == both_sums(cpu_store)
+
+
+def _dict_none_batches():
+    """Two aligned batches over one dict key: the lexicographic winner for
+    member m carries value None (review regression: the winning None must
+    CLEAR the stored value, exactly as the CPU engine does)."""
+    import numpy as np
+
+    def mk(add_t, val):
+        b = batch_from_keyspace(KeySpace())  # empty scaffold
+        b.rows_unique_per_slot = True
+        b.keys = [b"d1"]
+        b.key_enc = np.array([ENC_DICT], dtype=np.int8)
+        b.key_ct = np.array([1 << 22], dtype=np.int64)
+        b.key_mt = np.array([add_t], dtype=np.int64)
+        b.key_dt = np.zeros(1, dtype=np.int64)
+        b.key_expire = np.zeros(1, dtype=np.int64)
+        b.reg_val = [None]
+        b.reg_t = np.zeros(1, dtype=np.int64)
+        b.reg_node = np.zeros(1, dtype=np.int64)
+        b.el_ki = np.zeros(1, dtype=np.int64)
+        b.el_member = [b"m"]
+        b.el_val = [val]
+        b.el_add_t = np.array([add_t], dtype=np.int64)
+        b.el_add_node = np.array([1], dtype=np.int64)
+        b.el_del_t = np.zeros(1, dtype=np.int64)
+        return b
+
+    lo = mk(100 << 22, b"y")
+    hi = mk(200 << 22, None)   # the winner — and it carries None
+    return lo, hi
+
+
+@pytest.mark.parametrize("mode", ["off", "xla", "pallas-interpret"])
+def test_winning_none_value_clears_dict_field(mode):
+    lo, hi = _dict_none_batches()
+    cpu_store = KeySpace()
+    cpu = CpuMergeEngine()
+    cpu.merge(cpu_store, lo)
+    cpu.merge(cpu_store, hi)
+
+    st = KeySpace()
+    TpuMergeEngine(dense_fold=mode).merge_many(st, [lo, hi])
+    assert st.canonical() == cpu_store.canonical()
+    kid = st.lookup(b"d1")
+    row = st.el_row(kid, b"m")
+    assert st.el_val[row] is None
+
+
+def test_non_pow2_mesh_engine():
+    """State padding must round up to the kv axis size, not just pow2
+    (review regression: a 6-device mesh crashed on the first merge)."""
+    import jax
+
+    if len(jax.devices()) < 6:
+        pytest.skip("needs >= 6 virtual devices")
+    from constdb_tpu.parallel import engine_mesh
+
+    src = gen_store(5, node=1)
+    st = KeySpace()
+    eng = TpuMergeEngine(resident=True, mesh=engine_mesh(6))
+    eng.merge(st, batch_from_keyspace(src))
+    eng.flush(st)
+    cpu_store = KeySpace()
+    CpuMergeEngine().merge(cpu_store, batch_from_keyspace(src))
+    assert st.canonical() == cpu_store.canonical()
