@@ -120,3 +120,40 @@ class MultiValue:
 
     def state(self) -> frozenset:
         return frozenset((v, frozenset(vc.c.items())) for v, vc in self.siblings)
+
+
+# ------------------------------------------------- wire/member serialization
+# A sibling is stored as an ELEMENT ROW whose member bytes are the write's
+# canonical clock serialization: deterministic, so the same write interns to
+# the same member on every replica and element-plane merges (both engines,
+# snapshots, GC) apply unchanged.
+
+def clock_to_bytes(vc: VClock) -> bytes:
+    """Canonical ascii form `node:count,node:count` sorted by node."""
+    return b",".join(b"%d:%d" % (n, c) for n, c in sorted(vc.c.items()))
+
+
+def clock_from_bytes(b: bytes) -> VClock:
+    out = VClock()
+    if b:
+        for part in b.split(b","):
+            n, _, c = part.partition(b":")
+            out.c[int(n)] = int(c)
+    return out
+
+
+def frontier_of(pairs: list) -> list:
+    """Prune causally-dominated entries from [(member, value, clock), ...]
+    (read-time view; dominated rows may linger until a later write
+    tombstones them)."""
+    out = []
+    for i, (m, v, vc) in enumerate(pairs):
+        dominated = False
+        for j, (m2, _v2, vc2) in enumerate(pairs):
+            if i != j and vc2.dominates(vc) and not (vc.dominates(vc2)
+                                                     and i < j):
+                dominated = True
+                break
+        if not dominated:
+            out.append((m, v, vc))
+    return out

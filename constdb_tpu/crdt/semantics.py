@@ -53,14 +53,24 @@ broken — SURVEY.md §"Known reference defects"):
 from __future__ import annotations
 
 # Encoding tags — wire-compatible with the reference's snapshot enc byte
-# (reference src/object.rs:19-22).
+# (reference src/object.rs:19-22).  6/7 are new: the reference advertises a
+# MultiValueRegister and scaffolds a List (README.md:10, vclock.rs, list.rs)
+# but never assigns them encodings — this build completes them on the
+# element plane (crdt/multivalue.py, crdt/sequence.py docstrings).
 ENC_NONE = -1
 ENC_COUNTER = 0
 ENC_BYTES = 3
 ENC_DICT = 4
 ENC_SET = 5
+ENC_MV = 6
+ENC_LIST = 7
 
-ENC_NAMES = {ENC_COUNTER: "Counter", ENC_BYTES: "Bytes", ENC_DICT: "LWWDict", ENC_SET: "LWWSet"}
+ENC_NAMES = {ENC_COUNTER: "Counter", ENC_BYTES: "Bytes", ENC_DICT: "LWWDict",
+             ENC_SET: "LWWSet", ENC_MV: "MultiValue", ENC_LIST: "List"}
+
+# encodings whose element rows carry value bytes (dict fields, multi-value
+# siblings, list entries); set members are valueless
+VALUE_ENCS = (ENC_DICT, ENC_MV, ENC_LIST)
 
 # "never written" timestamp sentinel: loses to every real timestamp (real
 # uuids are >= 0).  Single definition shared by the store layer and the

@@ -99,3 +99,33 @@ class Sequence:
 
     def state(self) -> frozenset:
         return frozenset((it[0], it[1], it[2], it[3]) for it in self.items)
+
+
+# ------------------------------------------------- wire/member serialization
+# A list entry is stored as an ELEMENT ROW whose member bytes are its
+# position id serialized as fixed-width big-endian digits — byte-lex order
+# of members IS position order, so sorting live members reads the list and
+# element-plane merges (both engines, snapshots, GC) apply unchanged.
+
+_DIGIT_BYTES = 2 + 8  # slot (16-bit) + writer node (64-bit)
+
+
+def pos_to_bytes(pos: tuple) -> bytes:
+    out = bytearray()
+    for slot, node in pos:
+        out += slot.to_bytes(2, "big") + node.to_bytes(8, "big")
+    return bytes(out)
+
+
+def pos_from_bytes(b: bytes) -> tuple:
+    return tuple((int.from_bytes(b[i:i + 2], "big"),
+                  int.from_bytes(b[i + 2:i + _DIGIT_BYTES], "big"))
+                 for i in range(0, len(b), _DIGIT_BYTES))
+
+
+def pos_between_bytes(lo: Optional[bytes], hi: Optional[bytes],
+                      node: int) -> bytes:
+    """A fresh serialized position strictly between two serialized ones."""
+    return pos_to_bytes(Sequence._between(
+        pos_from_bytes(lo) if lo else None,
+        pos_from_bytes(hi) if hi else None, node))

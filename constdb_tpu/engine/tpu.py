@@ -878,7 +878,7 @@ class TpuMergeEngine:
                 # are None-over-None no-ops.
                 winb_h = np.asarray(winb)
                 cand = np.asarray(wins[0])[:nA] & \
-                    (enc[el_kid[rows0]] == S.ENC_DICT)
+                    np.isin(enc[el_kid[rows0]], S.VALUE_ENCS)
                 for j in np.nonzero(cand)[0]:
                     el_val[int(rows0[j])] = staged[int(winb_h[j])][4][int(j)]
                 return
@@ -889,8 +889,8 @@ class TpuMergeEngine:
                         el_val[int(pos[j])] = vals[int(j)]
                 else:
                     # valueless batch: winning None adds must still CLEAR
-                    # dict values (CPU parity); set rows need no touch
-                    cand = win_arr & (enc[el_kid[pos]] == S.ENC_DICT)
+                    # stored values (CPU parity); set rows need no touch
+                    cand = win_arr & np.isin(enc[el_kid[pos]], S.VALUE_ENCS)
                     for j in np.nonzero(cand)[0]:
                         el_val[int(pos[j])] = None
             return

@@ -260,7 +260,7 @@ def del_command(node, ctx, args):
                 node.replicate_cmd(uuid, b"delcnt", rep)
             else:
                 node.replicate_cmd(uuid, b"delbytes", [Bulk(key)])
-    elif enc in (S.ENC_SET, S.ENC_DICT):
+    elif enc in _DEL_COLLECTION_CMD:
         members = [m for m, *_ in ks.elem_all(kid)]
         for m in members:
             ks.elem_rem(kid, m, uuid)
@@ -268,9 +268,13 @@ def del_command(node, ctx, args):
             deleted = 1
         ks.set_delete_time(kid, uuid)
         ks.record_key_delete(key, uuid)
-        node.replicate_cmd(uuid, b"delset" if enc == S.ENC_SET else b"deldict",
-                           [Bulk(key)])
+        node.replicate_cmd(uuid, _DEL_COLLECTION_CMD[enc], [Bulk(key)])
     return Int(deleted)
+
+
+# element-plane encodings delete alike: tombstone every member + the key
+_DEL_COLLECTION_CMD = {S.ENC_SET: b"delset", S.ENC_DICT: b"deldict",
+                       S.ENC_MV: b"delmv", S.ENC_LIST: b"dellist"}
 
 
 @register("delbytes", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
@@ -543,6 +547,264 @@ def hdel_command(node, ctx, args):
 @register("deldict", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
 def deldict_command(node, ctx, args):
     return _del_collection(node, ctx, args, S.ENC_DICT)
+
+
+# ====================================================================
+# multi-value register commands (capability completion: the reference
+# advertises a MultiValueRegister — README.md:10 — but its VClock scaffold
+# is wired to nothing, src/crdt/vclock.rs.  Siblings live as element rows
+# whose member bytes are the write's canonical clock; dominated siblings
+# are tombstoned by later writes and pruned at read time.)
+# ====================================================================
+
+def _mv_live(ks, kid):
+    from ..crdt.multivalue import clock_from_bytes
+    return [(m, v, clock_from_bytes(m)) for m, v, _t in ks.elem_live(kid)]
+
+
+def _mv_apply(ks, kid, clock_bytes, wc, val, uuid, nodeid) -> None:
+    """Insert the sibling and tombstone every live sibling the write's
+    clock dominates — deterministic from the clocks alone, so replicas
+    applying this replicated write converge."""
+    live = _mv_live(ks, kid)
+    ks.elem_add(kid, clock_bytes, val, uuid, nodeid)
+    for m, _v, vc in live:
+        if m != clock_bytes and wc.dominates(vc):
+            ks.elem_rem(kid, m, uuid)
+    dt = int(ks.keys.dt[kid])
+    if uuid < dt:
+        # concurrent key-level delete from another replica wins
+        ks.elem_rem(kid, clock_bytes, dt)
+    ks.updated_at(kid, uuid)
+
+
+@register("mvset", CMD_WRITE | CMD_NO_REPLICATE)
+def mvset_command(node, ctx, args):
+    """MVSET key value [context-token].  The token (from MVGET) is the
+    causal context the writer observed; writing with it supersedes exactly
+    what was read.  Replicates as the positional `mvwrite`."""
+    from ..crdt.multivalue import VClock, clock_from_bytes, clock_to_bytes
+
+    key = args.next_bytes()
+    val = args.next_bytes()
+    token = args.next_bytes() if args.has_more else None
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_MV, ctx.uuid)
+    if token is not None:
+        ctx_vc = clock_from_bytes(token)
+    else:
+        ctx_vc = VClock()
+        for _m, _v, vc in _mv_live(ks, kid):
+            ctx_vc = ctx_vc.merge(vc)
+    wc = ctx_vc.bump(ctx.nodeid)
+    wb = clock_to_bytes(wc)
+    _mv_apply(ks, kid, wb, wc, val, ctx.uuid, ctx.nodeid)
+    node.replicate_cmd(ctx.uuid, b"mvwrite", [Bulk(key), Bulk(wb), Bulk(val)])
+    return Bulk(wb)
+
+
+@register("mvwrite", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def mvwrite_command(node, ctx, args):
+    from ..crdt.multivalue import clock_from_bytes
+
+    key = args.next_bytes()
+    wb = args.next_bytes()
+    val = args.next_bytes()
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_MV, ctx.uuid)
+    _mv_apply(ks, kid, wb, clock_from_bytes(wb), val, ctx.uuid, ctx.nodeid)
+    return NO_REPLY
+
+
+@register("mvget", CMD_READONLY)
+def mvget_command(node, ctx, args):
+    """-> [[sibling values...], context-token].  Concurrent writes all
+    surface (Dynamo-style); pass the token to MVSET to supersede them."""
+    from ..crdt.multivalue import VClock, clock_to_bytes, frontier_of
+
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return NIL
+    if ks.enc_of(kid) != S.ENC_MV:
+        raise _invalid_type()
+    live = frontier_of(_mv_live(ks, kid))
+    token = VClock()
+    for _m, _v, vc in live:
+        token = token.merge(vc)
+    return Arr([Arr([Bulk(v if v is not None else b"") for _m, v, _vc in live]),
+                Bulk(clock_to_bytes(token))])
+
+
+@register("delmv", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def delmv_command(node, ctx, args):
+    return _del_collection(node, ctx, args, S.ENC_MV)
+
+
+# ====================================================================
+# list commands (capability completion: the reference scaffolds an ordered
+# list — src/crdt/list.rs — wired to nothing.  Entries live as element rows
+# whose member bytes are LSEQ position ids; byte-lex member order IS list
+# order, so reads sort live members and merges are the element merge.)
+# ====================================================================
+
+def _list_live(ks, kid) -> list:
+    """[(pos_bytes, value)] in list order."""
+    return sorted((m, v) for m, v, _t in ks.elem_live(kid))
+
+
+def _list_kid(node, ctx, key, for_write: bool):
+    ks = node.ks
+    if for_write:
+        kid, _ = ks.get_or_create(key, S.ENC_LIST, ctx.uuid)
+        return kid
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return -1
+    if ks.enc_of(kid) != S.ENC_LIST:
+        raise _invalid_type()
+    return kid
+
+
+def _list_insert(node, ctx, key, index: int, values: list) -> int:
+    """Insert `values` before live index `index` (clamped); returns the new
+    live length.  Each insert replicates as the positional `lins`."""
+    from ..crdt.sequence import pos_between_bytes
+
+    ks = node.ks
+    kid = _list_kid(node, ctx, key, for_write=True)
+    live = _list_live(ks, kid)
+    index = max(0, min(index, len(live)))
+    lo = live[index - 1][0] if index > 0 else None
+    hi = live[index][0] if index < len(live) else None
+    rep = [Bulk(key)]
+    dt = int(ks.keys.dt[kid])
+    for v in values:
+        pos = pos_between_bytes(lo, hi, ctx.nodeid)
+        ks.elem_add(kid, pos, v, ctx.uuid, ctx.nodeid)
+        if ctx.uuid < dt:
+            ks.elem_rem(kid, pos, dt)
+        rep.append(Bulk(pos))
+        rep.append(Bulk(v))
+        lo = pos  # subsequent values land after the one just placed
+    ks.updated_at(kid, ctx.uuid)
+    # ONE replicated frame for the whole insert (repl_log uuids are unique)
+    node.replicate_cmd(ctx.uuid, b"lins", rep)
+    return len(_list_live(ks, kid))
+
+
+@register("linsert", CMD_WRITE | CMD_NO_REPLICATE)
+def linsert_command(node, ctx, args):
+    key = args.next_bytes()
+    index = args.next_int()
+    values = args.rest_bytes()
+    if not values:
+        raise WrongArity("linsert")
+    return Int(_list_insert(node, ctx, key, index, values))
+
+
+@register("lpush", CMD_WRITE | CMD_NO_REPLICATE)
+def lpush_command(node, ctx, args):
+    key = args.next_bytes()
+    values = args.rest_bytes()
+    if not values:
+        raise WrongArity("lpush")
+    return Int(_list_insert(node, ctx, key, 0, values))
+
+
+@register("rpush", CMD_WRITE | CMD_NO_REPLICATE)
+def rpush_command(node, ctx, args):
+    key = args.next_bytes()
+    values = args.rest_bytes()
+    if not values:
+        raise WrongArity("rpush")
+    return Int(_list_insert(node, ctx, key, 1 << 40, values))
+
+
+@register("lins", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def lins_command(node, ctx, args):
+    """Positional replicated insert: `lins key pos1 val1 [pos2 val2 ...]`."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_LIST, ctx.uuid)
+    dt = int(ks.keys.dt[kid])
+    while args.has_more:
+        pos = args.next_bytes()
+        val = args.next_bytes()
+        ks.elem_add(kid, pos, val, ctx.uuid, ctx.nodeid)
+        if ctx.uuid < dt:
+            ks.elem_rem(kid, pos, dt)
+    ks.updated_at(kid, ctx.uuid)
+    return NO_REPLY
+
+
+@register("lrem", CMD_WRITE | CMD_NO_REPLICATE)
+def lrem_command(node, ctx, args):
+    """LREM key index — delete the element at live index; replicates as the
+    positional `lremat` so every replica removes the SAME element."""
+    key = args.next_bytes()
+    index = args.next_int()
+    ks = node.ks
+    kid = _list_kid(node, ctx, key, for_write=False)
+    if kid < 0:
+        return Int(0)
+    live = _list_live(ks, kid)
+    if not 0 <= index < len(live):
+        return Int(0)
+    pos = live[index][0]
+    ks.elem_rem(kid, pos, ctx.uuid)
+    ks.updated_at(kid, ctx.uuid)
+    node.replicate_cmd(ctx.uuid, b"lremat", [Bulk(key), Bulk(pos)])
+    return Int(1)
+
+
+@register("lremat", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def lremat_command(node, ctx, args):
+    key = args.next_bytes()
+    pos = args.next_bytes()
+    ks = node.ks
+    kid, _ = ks.get_or_create(key, S.ENC_LIST, ctx.uuid)
+    ks.elem_rem(kid, pos, ctx.uuid)
+    ks.updated_at(kid, ctx.uuid)
+    return NO_REPLY
+
+
+@register("lrange", CMD_READONLY)
+def lrange_command(node, ctx, args):
+    """LRANGE key start stop — redis-style inclusive range with negative
+    indices."""
+    key = args.next_bytes()
+    start = args.next_int()
+    stop = args.next_int()
+    kid = _list_kid(node, ctx, key, for_write=False)
+    if kid < 0:
+        return Arr([])
+    vals = [v for _m, v in _list_live(node.ks, kid)]
+    n = len(vals)
+    if start < 0:
+        start += n
+    if stop < 0:
+        stop += n
+    start = max(0, start)
+    if stop < start:
+        return Arr([])
+    return Arr([Bulk(v if v is not None else b"")
+                for v in vals[start:stop + 1]])
+
+
+@register("llen", CMD_READONLY)
+def llen_command(node, ctx, args):
+    key = args.next_bytes()
+    kid = _list_kid(node, ctx, key, for_write=False)
+    if kid < 0:
+        return Int(0)
+    return Int(len(_list_live(node.ks, kid)))
+
+
+@register("dellist", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+def dellist_command(node, ctx, args):
+    return _del_collection(node, ctx, args, S.ENC_LIST)
 
 
 # ====================================================================

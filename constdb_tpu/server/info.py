@@ -104,6 +104,8 @@ def _section_keyspace(node, out):
         out.append(("registers", int(counts[S.ENC_BYTES])))
         out.append(("dicts", int(counts[S.ENC_DICT])))
         out.append(("sets", int(counts[S.ENC_SET])))
+        out.append(("multivalues", int(counts[S.ENC_MV])))
+        out.append(("lists", int(counts[S.ENC_LIST])))
     out.append(("counter_slots", ks.cnt.n))
     out.append(("element_rows", ks.el.n - ks.el_dead))
     out.append(("pending_tombstones", len(ks.garbage)))

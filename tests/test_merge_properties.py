@@ -10,17 +10,25 @@ import random
 
 import pytest
 
-from constdb_tpu.crdt import ENC_BYTES, ENC_COUNTER, ENC_DICT, ENC_SET
+from constdb_tpu.crdt import (ENC_BYTES, ENC_COUNTER, ENC_DICT, ENC_LIST,
+                              ENC_MV, ENC_SET)
 from constdb_tpu.engine import CpuMergeEngine, batch_from_keyspace
 from constdb_tpu.store import KeySpace
 
 KEYS = [b"cnt:%d" % i for i in range(4)] + [b"reg:%d" % i for i in range(4)] + \
-       [b"set:%d" % i for i in range(3)] + [b"dic:%d" % i for i in range(3)]
+       [b"set:%d" % i for i in range(3)] + [b"dic:%d" % i for i in range(3)] + \
+       [b"mvr:%d" % i for i in range(2)] + [b"lst:%d" % i for i in range(2)]
 MEMBERS = [b"m%d" % i for i in range(6)]
+# MV siblings / list entries are element rows keyed by opaque bytes (clock
+# serializations / LSEQ positions); merge-wise any byte-string member works
+MV_CLOCKS = [b"1:%d" % i for i in range(1, 4)] + [b"2:%d" % i for i in range(1, 4)]
+LIST_POS = [bytes([0, s, 0, 0, 0, 0, 0, 0, 0, n]) for s in (10, 20, 30)
+            for n in (1, 2)]
 
 
 def enc_for(key: bytes) -> int:
-    return {b"c": ENC_COUNTER, b"r": ENC_BYTES, b"s": ENC_SET, b"d": ENC_DICT}[key[:1]]
+    return {b"c": ENC_COUNTER, b"r": ENC_BYTES, b"s": ENC_SET, b"d": ENC_DICT,
+            b"m": ENC_MV, b"l": ENC_LIST}[key[:1]]
 
 
 def gen_store(seed: int, node: int, n_ops: int = 120) -> KeySpace:
@@ -41,12 +49,19 @@ def gen_store(seed: int, node: int, n_ops: int = 120) -> KeySpace:
             if ks.register_set(kid, b"v%d:%d" % (node, rng.randrange(100)), uuid, node):
                 pass
         elif op < 0.55:
-            member = rng.choice(MEMBERS)
-            val = b"x%d" % rng.randrange(50) if enc == ENC_DICT else None
+            if enc == ENC_MV:
+                member = rng.choice(MV_CLOCKS)
+            elif enc == ENC_LIST:
+                member = rng.choice(LIST_POS)
+            else:
+                member = rng.choice(MEMBERS)
+            val = None if enc == ENC_SET else b"x%d" % rng.randrange(50)
             ks.elem_add(kid, member, val, uuid, node)
             ks.updated_at(kid, uuid)
         elif op < 0.85:
-            ks.elem_rem(kid, rng.choice(MEMBERS), uuid)
+            pool = (MV_CLOCKS if enc == ENC_MV
+                    else LIST_POS if enc == ENC_LIST else MEMBERS)
+            ks.elem_rem(kid, rng.choice(pool), uuid)
             ks.updated_at(kid, uuid)
         else:  # key-level delete: tombstone all members + envelope
             for m, *_ in list(ks.elem_all(kid)):

@@ -1,0 +1,188 @@
+"""MultiValue register + List: the two CRDTs the reference advertises but
+never wires (reference README.md:10, src/crdt/vclock.rs, src/crdt/list.rs).
+Full-surface tests: commands over TCP, concurrent-sibling semantics,
+replication convergence, snapshot round-trip, and DEL."""
+
+import asyncio
+
+import pytest
+
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, Nil
+from constdb_tpu.server.node import Node
+
+from cluster_util import Client, close_cluster, converge, full_mesh, make_cluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _cmd(node, *parts):
+    return node.execute([Bulk(p if isinstance(p, bytes) else str(p).encode())
+                         for p in parts])
+
+
+# ------------------------------------------------------------- multi-value
+
+def test_mv_single_node_roundtrip():
+    n = Node(node_id=1)
+    tok = _cmd(n, b"mvset", b"k", b"v1")
+    assert isinstance(tok, Bulk)
+    got = _cmd(n, b"mvget", b"k")
+    vals, token = got.items
+    assert [b.val for b in vals.items] == [b"v1"]
+    # a write WITH the read context supersedes (one sibling remains)
+    _cmd(n, b"mvset", b"k", b"v2", token.val)
+    got = _cmd(n, b"mvget", b"k")
+    assert [b.val for b in got.items[0].items] == [b"v2"]
+
+
+def test_mv_concurrent_writes_surface_as_siblings():
+    """Writes that did not see each other (stale/absent context) both
+    survive; a context-carrying write supersedes exactly what was read."""
+    n = Node(node_id=1)
+    _cmd(n, b"mvset", b"k", b"a")
+    got = _cmd(n, b"mvget", b"k")
+    stale_token = got.items[1].val
+    _cmd(n, b"mvset", b"k", b"b", stale_token)  # supersedes a
+    # node 2's concurrent write (empty context — it saw nothing)
+    n2 = Node(node_id=2)
+    _cmd(n2, b"mvset", b"x", b"ignore")  # advance clock a bit
+    # simulate n2's concurrent write arriving by replicated mvwrite
+    from constdb_tpu.crdt.multivalue import VClock, clock_to_bytes
+    wc = VClock().bump(2)
+    n.apply_replicated(b"mvwrite",
+                       [Bulk(b"k"), Bulk(clock_to_bytes(wc)), Bulk(b"c")],
+                       2, 1000 << 22)
+    got = _cmd(n, b"mvget", b"k")
+    assert sorted(b.val for b in got.items[0].items) == [b"b", b"c"]
+    # resolving write with the merged context collapses both
+    _cmd(n, b"mvset", b"k", b"final", got.items[1].val)
+    got = _cmd(n, b"mvget", b"k")
+    assert [b.val for b in got.items[0].items] == [b"final"]
+
+
+def test_mv_wrongtype_and_del():
+    n = Node(node_id=1)
+    _cmd(n, b"mvset", b"k", b"v")
+    bad = _cmd(n, b"sadd", b"k", b"m")
+    assert isinstance(bad, Err)
+    assert _cmd(n, b"del", b"k") == Int(1)
+    assert _cmd(n, b"mvget", b"k") == Nil()
+    # write-after-delete resurrects (add-wins)
+    _cmd(n, b"mvset", b"k", b"back")
+    got = _cmd(n, b"mvget", b"k")
+    assert [b.val for b in got.items[0].items] == [b"back"]
+
+
+# ------------------------------------------------------------------- lists
+
+def test_list_single_node_ops():
+    n = Node(node_id=1)
+    assert _cmd(n, b"rpush", b"l", b"a", b"b", b"c") == Int(3)
+    assert _cmd(n, b"lpush", b"l", b"z") == Int(4)
+    got = _cmd(n, b"lrange", b"l", 0, -1)
+    assert [b.val for b in got.items] == [b"z", b"a", b"b", b"c"]
+    assert _cmd(n, b"linsert", b"l", 2, b"mid") == Int(5)
+    got = _cmd(n, b"lrange", b"l", 0, -1)
+    assert [b.val for b in got.items] == [b"z", b"a", b"mid", b"b", b"c"]
+    assert _cmd(n, b"llen", b"l") == Int(5)
+    assert _cmd(n, b"lrem", b"l", 0) == Int(1)
+    got = _cmd(n, b"lrange", b"l", 1, 2)
+    assert [b.val for b in got.items] == [b"mid", b"b"]
+    assert _cmd(n, b"del", b"l") == Int(1)
+    assert _cmd(n, b"llen", b"l") == Int(0)
+
+
+def test_list_range_edges():
+    n = Node(node_id=1)
+    _cmd(n, b"rpush", b"l", b"0", b"1", b"2", b"3")
+    assert [b.val for b in _cmd(n, b"lrange", b"l", -2, -1).items] == [b"2", b"3"]
+    assert _cmd(n, b"lrange", b"l", 3, 1) == Arr([])
+    assert _cmd(n, b"lrange", b"missing", 0, -1) == Arr([])
+
+
+# ------------------------------------------------------------ replication
+
+def test_mv_and_list_converge_over_mesh(tmp_path):
+    async def main():
+        apps = await make_cluster(3, str(tmp_path))
+        c = [await Client().connect(a.advertised_addr) for a in apps]
+        try:
+            # TRULY concurrent MV writes: both happen before the nodes ever
+            # meet, so neither write could have seen the other
+            await c[0].cmd("mvset", "mk", "from-n1")
+            await c[2].cmd("mvset", "mk", "from-n3")
+            await c[0].cmd("meet", apps[1].advertised_addr)
+            await c[2].cmd("meet", apps[1].advertised_addr)
+            await full_mesh(apps)
+            await converge(apps)
+            got = await c[1].cmd("mvget", "mk")
+            sibs = sorted(b.val for b in got.items[0].items)
+            assert sibs == [b"from-n1", b"from-n3"]
+            # resolve on node 2 with its merged context; all converge to one
+            await c[1].cmd("mvset", "mk", "resolved", got.items[1].val)
+            await converge(apps)
+            for cli in c:
+                got = await cli.cmd("mvget", "mk")
+                assert [b.val for b in got.items[0].items] == [b"resolved"]
+
+            # list ops from different nodes
+            await c[0].cmd("rpush", "ll", "a", "b")
+            await converge(apps)
+            await c[2].cmd("rpush", "ll", "c")
+            await c[1].cmd("lpush", "ll", "front")
+            await converge(apps)
+            views = []
+            for cli in c:
+                got = await cli.cmd("lrange", "ll", 0, -1)
+                views.append([b.val for b in got.items])
+            assert views[0] == views[1] == views[2]
+            assert set(views[0]) == {b"front", b"a", b"b", b"c"}
+            assert views[0][0] == b"front" and views[0].index(b"a") < views[0].index(b"b")
+
+            # delete + convergence
+            await c[1].cmd("del", "ll")
+            await converge(apps)
+            for cli in c:
+                assert await cli.cmd("llen", "ll") == Int(0)
+        finally:
+            for cli in c:
+                await cli.close()
+            await close_cluster(apps)
+    run(main())
+
+
+# ---------------------------------------------------------------- snapshot
+
+def test_mv_list_snapshot_roundtrip(tmp_path):
+    from constdb_tpu.engine.base import batch_from_keyspace
+    from constdb_tpu.persist.snapshot import (NodeMeta, dump_keyspace,
+                                              load_snapshot)
+    from constdb_tpu.store.keyspace import KeySpace
+
+    from constdb_tpu.crdt.multivalue import VClock, clock_to_bytes
+
+    n = Node(node_id=1)
+    _cmd(n, b"mvset", b"mk", b"v1")
+    # a concurrent sibling arriving from node 2's replication stream
+    n.apply_replicated(
+        b"mvwrite",
+        [Bulk(b"mk"), Bulk(clock_to_bytes(VClock().bump(2))), Bulk(b"v2")],
+        2, 2_000_000 << 22)
+    _cmd(n, b"rpush", b"ll", b"a", b"b", b"c")
+    _cmd(n, b"lrem", b"ll", 1)
+
+    path = str(tmp_path / "s.snap")
+    dump_keyspace(path, n.ks, NodeMeta(node_id=1, repl_last_uuid=7))
+    ks2 = KeySpace()
+    load_snapshot(path, ks2)
+    assert ks2.canonical() == n.ks.canonical()
+
+    # and through a second node's command surface
+    n2 = Node(node_id=2)
+    n2.ks = ks2
+    got = _cmd(n2, b"lrange", b"ll", 0, -1)
+    assert [b.val for b in got.items] == [b"a", b"c"]
+    got = _cmd(n2, b"mvget", b"mk")
+    assert sorted(b.val for b in got.items[0].items) == [b"v1", b"v2"]
