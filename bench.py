@@ -207,10 +207,16 @@ def main() -> None:
 
     tpu_t = time_engine(make_eng, chunks, repeats=2, group=group)
     rate = n_keys / tpu_t
+    eng = eng_holder["e"]
     print(f"[bench] device engine (resident, {jax.default_backend()}, "
-          f"group={group}, folds={eng_holder['e'].folds}): "
+          f"group={group}, folds={eng.folds}): "
           f"{tpu_t:.3f}s on {n_keys} keys = {rate:,.0f} keys/s",
           file=sys.stderr)
+    fam = getattr(eng, "family_secs", {})
+    if fam:
+        breakdown = " ".join(f"{k}={v:.3f}s" for k, v in sorted(fam.items()))
+        print(f"[bench] stage breakdown (last run, dispatch times; flush "
+              f"includes blocking downloads): {breakdown}", file=sys.stderr)
 
     out = {
         "metric": "snapshot_merge_keys_per_sec",

@@ -108,6 +108,10 @@ class TpuMergeEngine:
         self._devices = jax.devices()
         self.dense_fold = dense_fold
         self.folds = 0          # aligned folds performed (observability)
+        # cumulative host-side seconds per family (DISPATCH time — device
+        # work is async; the flush entry includes the blocking downloads)
+        self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
+                            "flush": 0.0}
         self._pallas_broken = False
         self.resident = resident
         self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
@@ -213,10 +217,14 @@ class TpuMergeEngine:
                 kid_of = self._resolve_keys(store, b, st)
                 memo[id(b.keys)] = kid_of
             resolved.append((b, kid_of))
-        self._merge_envelopes(store, resolved)
-        self._merge_registers(store, resolved)
-        self._merge_counter_rows(store, resolved, st)
-        self._merge_elem_rows(store, resolved, st)
+        import time as _time
+        for fam, call in (("env", lambda: self._merge_envelopes(store, resolved)),
+                          ("reg", lambda: self._merge_registers(store, resolved)),
+                          ("cnt", lambda: self._merge_counter_rows(store, resolved, st)),
+                          ("el", lambda: self._merge_elem_rows(store, resolved, st))):
+            t0 = _time.perf_counter()
+            call()
+            self.family_secs[fam] += _time.perf_counter() - t0
         for b, _ in resolved:
             for i, key in enumerate(b.del_keys):
                 store.record_key_delete(key, int(b.del_t[i]))
@@ -236,6 +244,8 @@ class TpuMergeEngine:
         enqueues element tombstones whose del_t advanced on device."""
         if not self.needs_flush:
             return
+        import time as _time
+        t0 = _time.perf_counter()
         get = self._jax.device_get
         for fam, res in self._res.items():
             n = res["n"]
@@ -260,6 +270,7 @@ class TpuMergeEngine:
             store.recompute_counter_sums()
         self.needs_flush = False
         self._seen_version = store.version
+        self.family_secs["flush"] += _time.perf_counter() - t0
 
     # ------------------------------------------------------ resident state
 

@@ -89,6 +89,22 @@ class ReplicaLink:
     def connected(self) -> bool:
         return self._serve_task is not None and not self._serve_task.done()
 
+    # ------------------------------------------------------ byte accounting
+    # replication traffic counts into the node's net totals plus dedicated
+    # repl_* gauges (reference buf_read.rs:218-236 / buf_write.rs:165-183
+    # count every socket byte; a node mid-catch-up is busiest exactly here)
+
+    def _count_in(self, n: int) -> None:
+        st = self.node.stats
+        st.net_in_bytes += n
+        st.repl_in_bytes += n
+
+    def _write(self, writer, data: bytes) -> None:
+        st = self.node.stats
+        st.net_out_bytes += len(data)
+        st.repl_out_bytes += len(data)
+        writer.write(data)
+
     async def _close_conn(self) -> None:
         w, self._writer = self._writer, None
         if w is not None:
@@ -118,7 +134,7 @@ class ReplicaLink:
         host, port = self.meta.addr.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
-            writer.write(encode_msg(Arr([
+            self._write(writer, encode_msg(Arr([
                 Bulk(SYNC), Int(0), Int(self.node.node_id),
                 Bulk(self.node.alias.encode()),
                 Bulk(self.app.advertised_addr.encode()),
@@ -126,7 +142,8 @@ class ReplicaLink:
             await writer.drain()
             parser = RespParser()
             msg = await _read_msg(reader, parser,
-                                  timeout=self.app.handshake_timeout)
+                                  timeout=self.app.handshake_timeout,
+                                  count=self._count_in)
             peer_resume = self._check_sync_reply(msg)
         except BaseException:
             writer.close()
@@ -205,7 +222,7 @@ class ReplicaLink:
                         meta.uuid_i_sent):
                     resume = peer_resume if not synced else meta.uuid_i_sent
                     if node.repl_log.can_resume_from(resume):
-                        writer.write(encode_msg(Arr([Bulk(PARTSYNC)])))
+                        self._write(writer, encode_msg(Arr([Bulk(PARTSYNC)])))
                         meta.uuid_i_sent = resume
                     else:
                         await self._send_snapshot(writer)
@@ -213,7 +230,7 @@ class ReplicaLink:
 
                 sent = 0
                 while (e := node.repl_log.next_after(meta.uuid_i_sent)) is not None:
-                    writer.write(encode_msg(Arr([
+                    self._write(writer, encode_msg(Arr([
                         Bulk(REPLICATE), Int(node.node_id), Int(e.prev_uuid),
                         Int(e.uuid), Bulk(e.name), *e.args])))
                     meta.uuid_i_sent = e.uuid
@@ -230,7 +247,7 @@ class ReplicaLink:
                     # idle nodes don't pin the cluster GC horizon at 0
                     drained = meta.uuid_i_sent >= node.repl_log.last_uuid
                     beacon = node.hlc.current if drained else 0
-                    writer.write(encode_msg(Arr([
+                    self._write(writer, encode_msg(Arr([
                         Bulk(REPLACK), Int(meta.uuid_he_sent), Int(now_ms()),
                         Int(beacon)])))
                     meta.uuid_he_acked = meta.uuid_he_sent
@@ -254,11 +271,11 @@ class ReplicaLink:
         dump = await self.app.shared_dump.acquire()
         self.node.stats.extra["full_syncs_sent"] = \
             self.node.stats.extra.get("full_syncs_sent", 0) + 1
-        writer.write(encode_msg(Arr([Bulk(FULLSYNC), Int(dump.size),
-                                     Int(dump.repl_last)])))
+        self._write(writer, encode_msg(Arr([Bulk(FULLSYNC), Int(dump.size),
+                                            Int(dump.repl_last)])))
         with open(dump.path, "rb") as f:
             while piece := f.read(_READ_CHUNK):
-                writer.write(piece)
+                self._write(writer, piece)
                 await writer.drain()
         self.meta.uuid_i_sent = dump.repl_last
 
@@ -268,7 +285,7 @@ class ReplicaLink:
         """Inbound half (reference pull.rs): apply replicate frames with
         watermark checks; load snapshots through the MergeEngine."""
         while True:
-            msg = await _read_msg(reader, parser)
+            msg = await _read_msg(reader, parser, count=self._count_in)
             items = msg.items if isinstance(msg, Arr) else None
             if not items:
                 raise CstError(f"unexpected frame from {self.meta.addr}: {msg!r}")
@@ -325,6 +342,7 @@ class ReplicaLink:
                     got = await reader.read(min(remaining, _READ_CHUNK))
                     if not got:
                         raise ConnectionError("EOF during snapshot download")
+                    self._count_in(len(got))
                 f.write(got)
                 remaining -= len(got)
         node = self.node
@@ -354,8 +372,9 @@ class ReplicaLink:
 
 
 async def _read_msg(reader: asyncio.StreamReader, parser: RespParser,
-                    timeout: Optional[float] = None):
-    """Next complete RESP message from the stream."""
+                    timeout: Optional[float] = None, count=None):
+    """Next complete RESP message from the stream; `count` observes raw
+    byte arrivals (replication byte accounting)."""
     while True:
         msg = parser.next_msg()
         if msg is not None:
@@ -364,4 +383,6 @@ async def _read_msg(reader: asyncio.StreamReader, parser: RespParser,
         data = await (asyncio.wait_for(coro, timeout) if timeout else coro)
         if not data:
             raise ConnectionError("EOF")
+        if count is not None:
+            count(len(data))
         parser.feed(data)

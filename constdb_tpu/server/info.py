@@ -59,6 +59,8 @@ def _section_stats(node, out):
     out.append(("total_commands_replicated", st.cmds_replicated))
     out.append(("total_net_input_bytes", st.net_in_bytes))
     out.append(("total_net_output_bytes", st.net_out_bytes))
+    out.append(("repl_net_input_bytes", st.repl_in_bytes))
+    out.append(("repl_net_output_bytes", st.repl_out_bytes))
     out.append(("merge_batches", st.merges))
     out.append(("merge_rows", st.merge_rows))
     out.append(("merge_seconds_total", round(st.merge_secs, 6)))
@@ -66,10 +68,32 @@ def _section_stats(node, out):
         out.append(("merge_rows_per_sec",
                     int(st.merge_rows / st.merge_secs)))
     out.append(("flush_seconds_total", round(st.flush_secs, 6)))
+    fam = getattr(node.engine, "family_secs", None)
+    if fam:
+        for name, secs in sorted(fam.items()):
+            out.append((f"merge_{name}_seconds", round(secs, 6)))
+    folds = getattr(node.engine, "folds", None)
+    if folds is not None:
+        out.append(("merge_folds", folds))
     out.append(("engine", node.engine.name))
     out.append(("gc_freed", st.gc_freed))
     for k, v in sorted(st.extra.items()):
         out.append((k, v))
+
+
+def _section_cpu(node, out):
+    """(reference src/stats.rs CPU section)"""
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out.append(("used_cpu_user", round(ru.ru_utime, 3)))
+    out.append(("used_cpu_sys", round(ru.ru_stime, 3)))
+    rc = resource.getrusage(resource.RUSAGE_CHILDREN)
+    out.append(("used_cpu_user_children", round(rc.ru_utime, 3)))
+    out.append(("used_cpu_sys_children", round(rc.ru_stime, 3)))
+    try:
+        out.append(("voluntary_ctx_switches", ru.ru_nvcsw))
+        out.append(("involuntary_ctx_switches", ru.ru_nivcsw))
+    except AttributeError:  # pragma: no cover
+        pass
 
 
 def _section_replication(node, out):
@@ -116,6 +140,7 @@ SECTIONS = {
     "clients": _section_clients,
     "memory": _section_memory,
     "stats": _section_stats,
+    "cpu": _section_cpu,
     "replication": _section_replication,
     "keyspace": _section_keyspace,
 }

@@ -27,6 +27,12 @@ class NodeStats:
     cmds_replicated: int = 0
     net_in_bytes: int = 0
     net_out_bytes: int = 0
+    # replication-link traffic, also included in the net totals (the
+    # reference counts every socket byte through its buffers —
+    # buf_read.rs:218-236, buf_write.rs:165-183; round 1 only counted
+    # client connections, leaving the dominant flow invisible)
+    repl_in_bytes: int = 0
+    repl_out_bytes: int = 0
     connections_accepted: int = 0
     current_clients: int = 0
     merges: int = 0

@@ -211,3 +211,33 @@ def test_sync_survives_injected_snapshot_failure(tmp_path):
             await c1.close()
             await close_cluster(apps)
     run(main())
+
+
+def test_repl_bytes_and_cpu_section(tmp_path):
+    """Replication traffic must be visible in INFO: repl_* gauges count
+    link bytes into the net totals (round-1 blind spot), and the CPU
+    section exists (reference stats.rs)."""
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        try:
+            for i in range(100):
+                await c.cmd("set", f"k{i}", "x" * 50)
+            await c.cmd("meet", apps[1].advertised_addr)
+            await converge(apps)
+            for app in apps:
+                st = app.node.stats
+                assert st.repl_out_bytes > 0, "push traffic uncounted"
+                assert st.repl_in_bytes > 0, "pull traffic uncounted"
+                assert st.net_out_bytes >= st.repl_out_bytes
+                assert st.net_in_bytes >= st.repl_in_bytes
+            # the receiver pulled at least the ~5KB of replicated values
+            assert apps[1].node.stats.repl_in_bytes > 4000
+            info = await c.cmd("info", "cpu")
+            assert b"used_cpu_user" in info.val and b"used_cpu_sys" in info.val
+            info = await c.cmd("info", "stats")
+            assert b"repl_net_input_bytes" in info.val
+        finally:
+            await c.close()
+            await close_cluster(apps)
+    run(main())
