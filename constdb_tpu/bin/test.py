@@ -144,6 +144,59 @@ async def test_dict(conns, rng, n_ops):
     print(f"  dict: {n_ops} ops, {len(oracle)} fields on all nodes ✓")
 
 
+async def bench_ops(addr: str, n_reqs: int, pipeline: int,
+                    n_conns: int) -> dict:
+    """redis-benchmark-style pipelined op-path throughput against ONE node
+    (the evidence behind the reference's qualitative "much efficient" IO
+    claim, README.md:12).  -> {cmd: ops_per_sec}."""
+    results = {}
+    val = b"x" * 32
+
+    def encode(kind: bytes, i: int) -> bytes:
+        key = b"bench:%d" % (i % 1000)
+        if kind == b"set":
+            return encode_msg(Arr([Bulk(b"set"), Bulk(key), Bulk(val)]))
+        if kind == b"get":
+            return encode_msg(Arr([Bulk(b"get"), Bulk(key)]))
+        return encode_msg(Arr([Bulk(b"incr"), Bulk(b"bench:cnt:%d" % (i % 16))]))
+
+    async def worker(conn: Conn, kind: bytes, n: int) -> None:
+        sent = 0
+        while sent < n:
+            burst = min(pipeline, n - sent)
+            buf = bytearray()
+            for i in range(sent, sent + burst):
+                buf += encode(kind, i)
+            conn.writer.write(bytes(buf))
+            await conn.writer.drain()
+            got = 0
+            while got < burst:
+                m = conn.parser.next_msg()
+                if m is not None:
+                    got += 1
+                    continue
+                data = await conn.reader.read(1 << 16)
+                if not data:
+                    raise ConnectionError("EOF")
+                conn.parser.feed(data)
+            sent += burst
+
+    for kind in (b"set", b"get", b"incr"):
+        conns = [await Conn().connect(addr) for _ in range(n_conns)]
+        per = n_reqs // n_conns
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(c, kind, per) for c in conns))
+        dt = time.perf_counter() - t0
+        ops = per * n_conns / dt
+        results[kind.decode()] = int(ops)
+        print(f"  {kind.decode():5s}: {per * n_conns} reqs, "
+              f"pipeline={pipeline}, conns={n_conns}: "
+              f"{ops:,.0f} ops/sec", flush=True)
+        for c in conns:
+            c.writer.close()
+    return results
+
+
 async def amain(addrs: list[str], n_ops: int, seed: int) -> None:
     rng = random.Random(seed)
     conns = [await Conn().connect(a) for a in addrs]
@@ -169,7 +222,17 @@ def main(argv=None) -> None:
                     help="host:port of ≥2 running nodes")
     ap.add_argument("--ops", type=int, default=300)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--bench", action="store_true",
+                    help="pipelined GET/SET/INCR throughput against the "
+                         "first replica instead of the convergence suite")
+    ap.add_argument("--bench-requests", type=int, default=100_000)
+    ap.add_argument("--bench-pipeline", type=int, default=64)
+    ap.add_argument("--bench-conns", type=int, default=4)
     ns = ap.parse_args(argv)
+    if ns.bench:
+        asyncio.run(bench_ops(ns.replicas[0], ns.bench_requests,
+                              ns.bench_pipeline, ns.bench_conns))
+        return
     if len(ns.replicas) < 2:
         print("need at least 2 replicas", file=sys.stderr)
         sys.exit(2)

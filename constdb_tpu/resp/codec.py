@@ -95,9 +95,69 @@ class RespParser:
     def next_msg(self) -> Optional[Msg]:
         """One complete message, or None if more bytes are needed.
         Raises InvalidRequestMsg on malformed input."""
-        if self._pos >= len(self._buf):
+        buf = self._buf
+        pos = self._pos
+        blen = len(buf)
+        if pos >= blen:
             return None
-        start = self._pos
+        if buf[pos] == 0x2A:  # '*' — fast path: flat array of bulk strings,
+            # the shape of every client command (pipelined op throughput
+            # lives or dies here); anything else falls back to _parse
+            find = buf.find
+            e = find(_CRLF, pos + 1)
+            if e < 0:
+                if blen - pos > 1 << 20:
+                    raise InvalidRequestMsg("line too long")
+                return None
+            try:
+                n = int(buf[pos + 1:e])
+            except ValueError:
+                raise InvalidRequestMsg("invalid array length") from None
+            if 0 <= n <= 1 << 20:
+                items = []
+                p = e + 2
+                for _ in range(n):
+                    if p >= blen:
+                        break
+                    c = buf[p]
+                    if c == 0x24:  # '$' bulk
+                        e = find(_CRLF, p + 1)
+                        if e < 0:
+                            break
+                        try:
+                            ln = int(buf[p + 1:e])
+                        except ValueError:
+                            raise InvalidRequestMsg(
+                                "invalid bulk length") from None
+                        if ln < 0:
+                            break  # $-1 Nil inside arrays: general path
+                        end = e + 2 + ln + 2
+                        if end > blen:
+                            break
+                        if buf[end - 2:end] != _CRLF:
+                            raise InvalidRequestMsg("bulk string missing CRLF")
+                        items.append(Bulk(bytes(buf[e + 2:end - 2])))
+                        p = end
+                    elif c == 0x3A:  # ':' int (replication frames)
+                        e = find(_CRLF, p + 1)
+                        if e < 0:
+                            break
+                        try:
+                            items.append(Int(int(buf[p + 1:e])))
+                        except ValueError:
+                            raise InvalidRequestMsg(
+                                "invalid integer line") from None
+                        p = e + 2
+                    else:
+                        break  # nested/unusual item: general path
+                else:
+                    self._pos = p
+                    if p >= _COMPACT_THRESHOLD:
+                        del buf[:p]
+                        self._pos = 0
+                    return Arr(items)
+                # partial or non-flat frame: fall through to _parse below
+        start = pos
         try:
             m = self._parse(0)
         except _NeedMore:

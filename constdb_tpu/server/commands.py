@@ -92,7 +92,10 @@ class ArgIter:
 
     def _next(self) -> Msg:
         if self.pos >= len(self.items):
-            raise WrongArity(self.cmd)
+            cmd = self.cmd
+            if isinstance(cmd, bytes):
+                cmd = cmd.decode("utf-8", "replace")
+            raise WrongArity(cmd)
         m = self.items[self.pos]
         self.pos += 1
         return m
@@ -137,20 +140,28 @@ def execute(node: "Node", req, client=None) -> Msg:
     items = req.items if isinstance(req, Arr) else list(req)
     if not items:
         return Err(b"empty command")
-    try:
-        name = as_bytes(items[0]).lower()
-    except CstError as e:
-        return Err(e.resp_error())
+    head = items[0]
+    name = head.val if type(head) is Bulk else None
+    if name is None:
+        try:
+            name = as_bytes(head)
+        except CstError as e:
+            return Err(e.resp_error())
     cmd = COMMANDS.get(name)
     if cmd is None:
-        return Err(UnknownCmd(name.decode("utf-8", "replace")).resp_error())
+        # commands usually arrive lowercase already; pay for .lower() only
+        # on the miss
+        name = name.lower()
+        cmd = COMMANDS.get(name)
+        if cmd is None:
+            return Err(UnknownCmd(name.decode("utf-8", "replace")).resp_error())
     if cmd.flags & CMD_REPL_ONLY:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
     node.ensure_flushed()  # device-resident merge results become readable
     uuid = node.hlc.tick(cmd.is_write)
     ctx = ExecCtx(uuid, node.node_id, False, client)
-    args = ArgIter(items[1:], name.decode())
+    args = ArgIter(items[1:], name)
     try:
         reply = cmd.handler(node, ctx, args)
     except CstError as e:
@@ -166,16 +177,18 @@ def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
                      uuid: int) -> Msg:
     """Replication-path dispatch with the originator's identity
     (reference Cmd::exec_detail with repl=false, pull.rs:184-235)."""
-    cmd = COMMANDS.get(name.lower())
+    cmd = COMMANDS.get(name)
     if cmd is None:
-        raise UnknownCmd(name.decode("utf-8", "replace"))
+        cmd = COMMANDS.get(name.lower())
+        if cmd is None:
+            raise UnknownCmd(name.decode("utf-8", "replace"))
     if cmd.flags & CMD_CLIENT_ONLY:
         raise InvalidRequestMsg(f"'{name.decode()}' cannot come from a replica")
     node.stats.cmds_replicated += 1
     node.ensure_flushed()
     node.hlc.observe(uuid)
     ctx = ExecCtx(uuid, origin_nodeid, True, None)
-    reply = cmd.handler(node, ctx, ArgIter(args, name.decode()))
+    reply = cmd.handler(node, ctx, ArgIter(args, name))
     if cmd.is_write:
         node.ks.version += 1
     return reply

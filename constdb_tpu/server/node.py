@@ -118,11 +118,11 @@ class Node:
     def ensure_flushed(self) -> None:
         """Sync device-resident merge state back to the host keyspace
         before any read/write of the numeric plane."""
-        flush = getattr(self.engine, "flush", None)
-        if flush is not None and getattr(self.engine, "needs_flush", False):
+        engine = self.engine
+        if getattr(engine, "needs_flush", False):
             import time
             t0 = time.perf_counter()
-            flush(self.ks)
+            engine.flush(self.ks)
             self.stats.flush_secs += time.perf_counter() - t0
 
     def canonical(self) -> dict:

@@ -58,7 +58,12 @@ class ReplLog:
     def push(self, uuid: int, name: bytes, args: list) -> None:
         if uuid <= self.last_uuid:
             raise ValueError(f"repl_log uuids must be increasing: {uuid} <= {self.last_uuid}")
-        size = len(name) + sum(msg_size(a) for a in args)
+        # args are almost always Bulk; avoid the recursive msg_size call on
+        # the op hot path
+        size = len(name)
+        for a in args:
+            v = getattr(a, "val", None)
+            size += len(v) if type(v) is bytes else msg_size(a)
         self._entries.append(ReplEntry(uuid, self.last_uuid, name, args, size))
         self._uuids.append(uuid)
         self._bytes += size

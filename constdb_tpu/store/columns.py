@@ -30,12 +30,18 @@ class Columns:
             new[: self.n] = old[: self.n]
             setattr(self, "_" + name, new)
         self._cap = cap
+        self._drop_views()
+
+    def _drop_views(self) -> None:
+        for name in self._spec:
+            self.__dict__.pop(name, None)
 
     def append(self, **vals) -> int:
         row = self.n
         if row >= self._cap:
             self._grow(row + 1)
         self.n = row + 1
+        self._drop_views()  # length-n views are stale
         for name, v in vals.items():
             getattr(self, "_" + name)[row] = v
         return row
@@ -46,19 +52,25 @@ class Columns:
         if start + n > self._cap:
             self._grow(start + n)
         self.n = start + n
+        self._drop_views()
         for name, arr in arrays.items():
             getattr(self, "_" + name)[start:start + n] = arr
         return np.arange(start, start + n, dtype=np.int64)
 
     def col(self, name: str) -> np.ndarray:
         """Live view of a column (length n)."""
-        return getattr(self, "_" + name)[: self.n]
+        return getattr(self, name)
 
     def __getattr__(self, name: str):
-        # convenience: cols.ct -> live view  (only called for missing attrs)
+        # cols.ct -> live [0, n) view, CACHED as a real instance attribute so
+        # repeat access costs a dict hit, not a slice build (the op path
+        # touches columns ~10x per command).  append/_grow drop the caches.
         spec = object.__getattribute__(self, "_spec")
         if name in spec:
-            return object.__getattribute__(self, "_" + name)[: object.__getattribute__(self, "n")]
+            view = object.__getattribute__(self, "_" + name)[
+                : object.__getattribute__(self, "n")]
+            object.__setattr__(self, name, view)
+            return view
         raise AttributeError(name)
 
     def __len__(self) -> int:
