@@ -1,0 +1,532 @@
+"""Chunked columnar snapshot format: writer, loader, dump/restore.
+
+Capability parity with the reference's snapshot layer (reference
+src/snapshot.rs:9-69 `SnapshotWriter` with its running checksum,
+src/snapshot.rs:100-301 incremental validated loading, src/server.rs:183-250
+dump orchestration), redesigned for the columnar keyspace: instead of one
+varint record per key (the reference walks `DB::iter` one Object at a
+time), the body is a sequence of CHUNK sections, each holding a
+`ColumnarBatch` slice of the keyspace — numeric planes as raw
+little-endian i64 columns (zlib-compressed), bytes planes as length-column
++ blob.  A loaded chunk goes straight into `MergeEngine.merge` without any
+per-row Python work, which is what lets snapshot ingest ride the batched
+TPU merge path (engine/tpu.py) instead of a 10M-iteration loop.
+
+File layout (all multi-byte scalars big-endian varints per utils/varint.py,
+bulk columns little-endian raw):
+
+    magic   b"CSTPU1\\n\\x00" (8 bytes)
+    alg     1 byte — checksum algorithm tag (utils/checksum.StreamChecksum)
+    section*:
+        kind    1 byte  (1=NODE, 2=REPLICAS, 3=BATCH)
+        flag    1 byte  (0=raw payload, 1=zlib payload)
+        length  uvarint (stored payload bytes)
+        payload
+    end     1 byte 0xFF
+    digest  8 bytes big-endian — checksum of every byte above (magic
+            through the end marker)
+
+The checksum covers the whole stream, so a loader that streams chunks into
+an engine learns of corruption only at the end marker — callers that merge
+into a live store must treat `InvalidSnapshotChecksum` as "discard the
+store" (load_snapshot targets fresh keyspaces: boot restore and full-sync
+download both do).  Truncation anywhere raises `InvalidSnapshot`
+immediately, exactly like the reference's short-read handling
+(src/snapshot.rs:207-214).
+
+Varint scalars use the zigzag encoding from utils/varint.py (well-defined
+for negatives — the reference's encoder corrupts them, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from dataclasses import dataclass
+from typing import IO, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.base import ColumnarBatch, batch_from_keyspace
+from ..errors import InvalidSnapshot, InvalidSnapshotChecksum
+from ..utils.checksum import StreamChecksum
+from ..utils.varint import VarintReader, write_uvarint
+
+_I64 = np.int64
+
+MAGIC = b"CSTPU1\n\x00"
+SEC_NODE = 1
+SEC_REPLICAS = 2
+SEC_BATCH = 3
+SEC_END = 0xFF
+
+# a stored section larger than this is corruption, not data (guards the
+# loader against allocating on a bit-flipped length field)
+_MAX_SECTION = 1 << 31
+
+_KIND_NAMES = {SEC_NODE: "node", SEC_REPLICAS: "replicas", SEC_BATCH: "batch"}
+
+
+@dataclass
+class NodeMeta:
+    """NODE section: the dumping node's identity + replication watermark
+    (reference src/snapshot.rs:45-49 writes uuid/addr ahead of the body)."""
+
+    node_id: int = 0
+    alias: str = ""
+    addr: str = ""
+    repl_last_uuid: int = 0
+
+
+@dataclass
+class ReplicaRecord:
+    """One row of the REPLICAS section: membership LWW state + the pull
+    watermarks a restored node resumes from (reference
+    src/replica/replica.rs:131-147 ReplicaMeta, persisted subset)."""
+
+    addr: str
+    node_id: int = 0
+    alias: str = ""
+    add_t: int = 0
+    del_t: int = 0
+    uuid_he_sent: int = 0
+    uuid_he_acked: int = 0
+
+
+# --------------------------------------------------------------------------
+# payload primitives
+
+
+def _write_str(out: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    write_uvarint(out, len(b))
+    out += b
+
+
+def _read_str(r: VarintReader) -> str:
+    return r.take(r.uvarint()).decode("utf-8", "replace")
+
+
+def _write_i64_col(out: bytearray, arr: np.ndarray) -> None:
+    out += np.ascontiguousarray(arr, dtype="<i8").tobytes()
+
+
+def _read_i64_col(r: VarintReader, n: int) -> np.ndarray:
+    return np.frombuffer(r.take(8 * n), dtype="<i8")
+
+
+def _write_bytes_list(out: bytearray, items: list) -> None:
+    """None-able bytes column: i32 length-plus-one per slot (0 encodes
+    None, so empty bytes stay distinct — tests/test_snapshot.py
+    test_none_values_roundtrip), then the concatenated blob."""
+    lens = np.zeros(len(items), dtype="<i4")
+    for i, b in enumerate(items):
+        if b is not None:
+            lens[i] = len(b) + 1
+    out += lens.tobytes()
+    out += b"".join(b for b in items if b is not None)
+
+
+def _read_bytes_list(r: VarintReader, n: int) -> list:
+    lens = np.frombuffer(r.take(4 * n), dtype="<i4")
+    total = int(lens.sum()) - int(np.count_nonzero(lens)) if n else 0
+    if total < 0:
+        raise ValueError("negative bytes-column length")
+    blob = r.take(total)
+    out: list = []
+    pos = 0
+    for ln in lens.tolist():
+        if ln == 0:
+            out.append(None)
+        else:
+            end = pos + ln - 1
+            out.append(blob[pos:end])
+            pos = end
+    return out
+
+
+def _encode_node(meta: NodeMeta) -> bytearray:
+    out = bytearray()
+    write_uvarint(out, meta.node_id)
+    _write_str(out, meta.alias)
+    _write_str(out, meta.addr)
+    write_uvarint(out, meta.repl_last_uuid)
+    return out
+
+
+def _decode_node(payload: bytes) -> NodeMeta:
+    r = VarintReader(payload)
+    return NodeMeta(node_id=r.uvarint(), alias=_read_str(r),
+                    addr=_read_str(r), repl_last_uuid=r.uvarint())
+
+
+def _encode_replicas(records: Iterable[ReplicaRecord]) -> bytearray:
+    records = list(records)
+    out = bytearray()
+    write_uvarint(out, len(records))
+    for rec in records:
+        _write_str(out, rec.addr)
+        write_uvarint(out, rec.node_id)
+        _write_str(out, rec.alias)
+        write_uvarint(out, rec.add_t)
+        write_uvarint(out, rec.del_t)
+        write_uvarint(out, rec.uuid_he_sent)
+        write_uvarint(out, rec.uuid_he_acked)
+    return out
+
+
+def _decode_replicas(payload: bytes) -> List[ReplicaRecord]:
+    r = VarintReader(payload)
+    return [ReplicaRecord(addr=_read_str(r), node_id=r.uvarint(),
+                          alias=_read_str(r), add_t=r.uvarint(),
+                          del_t=r.uvarint(), uuid_he_sent=r.uvarint(),
+                          uuid_he_acked=r.uvarint())
+            for _ in range(r.uvarint())]
+
+
+def _encode_batch(b: ColumnarBatch) -> bytearray:
+    out = bytearray()
+    n = b.n_keys
+    write_uvarint(out, n)
+    _write_bytes_list(out, b.keys)
+    out += np.ascontiguousarray(b.key_enc, dtype=np.int8).tobytes()
+    for col in (b.key_ct, b.key_mt, b.key_dt, b.key_expire, b.reg_t,
+                b.reg_node):
+        _write_i64_col(out, col)
+    _write_bytes_list(out, b.reg_val)
+
+    write_uvarint(out, len(b.cnt_ki))
+    for col in (b.cnt_ki, b.cnt_node, b.cnt_val, b.cnt_uuid, b.cnt_base,
+                b.cnt_base_t):
+        _write_i64_col(out, col)
+
+    write_uvarint(out, len(b.el_ki))
+    for col in (b.el_ki, b.el_add_t, b.el_add_node, b.el_del_t):
+        _write_i64_col(out, col)
+    _write_bytes_list(out, b.el_member)
+    _write_bytes_list(out, b.el_val)
+
+    write_uvarint(out, len(b.del_keys))
+    _write_bytes_list(out, b.del_keys)
+    _write_i64_col(out, b.del_t)
+    out.append(1 if b.rows_unique_per_slot else 0)
+    return out
+
+
+def _decode_batch(payload: bytes) -> ColumnarBatch:
+    r = VarintReader(payload)
+    b = ColumnarBatch()
+    n = r.uvarint()
+    b.keys = _read_bytes_list(r, n)
+    b.key_enc = np.frombuffer(r.take(n), dtype=np.int8)
+    b.key_ct = _read_i64_col(r, n)
+    b.key_mt = _read_i64_col(r, n)
+    b.key_dt = _read_i64_col(r, n)
+    b.key_expire = _read_i64_col(r, n)
+    b.reg_t = _read_i64_col(r, n)
+    b.reg_node = _read_i64_col(r, n)
+    b.reg_val = _read_bytes_list(r, n)
+
+    nc = r.uvarint()
+    b.cnt_ki = _read_i64_col(r, nc)
+    b.cnt_node = _read_i64_col(r, nc)
+    b.cnt_val = _read_i64_col(r, nc)
+    b.cnt_uuid = _read_i64_col(r, nc)
+    b.cnt_base = _read_i64_col(r, nc)
+    b.cnt_base_t = _read_i64_col(r, nc)
+
+    ne = r.uvarint()
+    b.el_ki = _read_i64_col(r, ne)
+    b.el_add_t = _read_i64_col(r, ne)
+    b.el_add_node = _read_i64_col(r, ne)
+    b.el_del_t = _read_i64_col(r, ne)
+    b.el_member = _read_bytes_list(r, ne)
+    b.el_val = _read_bytes_list(r, ne)
+
+    nd = r.uvarint()
+    b.del_keys = _read_bytes_list(r, nd)
+    b.del_t = _read_i64_col(r, nd)
+    b.rows_unique_per_slot = bool(r.byte())
+    return b
+
+
+# --------------------------------------------------------------------------
+# chunking
+
+
+def batch_chunks(batch: ColumnarBatch,
+                 chunk_keys: int) -> Iterator[ColumnarBatch]:
+    """Split a batch into key-range chunks of at most `chunk_keys` keys.
+
+    Chunk boundaries are positional, so chunks of same-shape batches from
+    different replicas stay slot-ALIGNED (the engine's fused dense-fold
+    path relies on this — engine/tpu.py merge_many).  Counter/element rows
+    are routed to the chunk owning their key and re-indexed chunk-locally;
+    key-level delete tombstones ride the first chunk (merge order is
+    immaterial: every component merge is commutative).
+    """
+    n = batch.n_keys
+    if chunk_keys <= 0:
+        chunk_keys = max(n, 1)
+
+    if n == 0:
+        if batch.del_keys:
+            c = ColumnarBatch()
+            c.rows_unique_per_slot = batch.rows_unique_per_slot
+            c.del_keys = list(batch.del_keys)
+            c.del_t = np.asarray(batch.del_t, dtype=_I64)
+            yield c
+        return
+
+    # one stable sort per plane, then each chunk is a searchsorted slice
+    cnt_order = np.argsort(batch.cnt_ki, kind="stable")
+    cnt_sorted = np.asarray(batch.cnt_ki)[cnt_order]
+    el_order = np.argsort(batch.el_ki, kind="stable")
+    el_sorted = np.asarray(batch.el_ki)[el_order]
+
+    for lo in range(0, n, chunk_keys):
+        hi = min(n, lo + chunk_keys)
+        c = ColumnarBatch()
+        c.rows_unique_per_slot = batch.rows_unique_per_slot
+        c.keys = batch.keys[lo:hi]
+        c.key_enc = batch.key_enc[lo:hi]
+        c.key_ct = batch.key_ct[lo:hi]
+        c.key_mt = batch.key_mt[lo:hi]
+        c.key_dt = batch.key_dt[lo:hi]
+        c.key_expire = batch.key_expire[lo:hi]
+        c.reg_val = batch.reg_val[lo:hi]
+        c.reg_t = batch.reg_t[lo:hi]
+        c.reg_node = batch.reg_node[lo:hi]
+
+        a, z = np.searchsorted(cnt_sorted, (lo, hi))
+        rows = cnt_order[a:z]
+        c.cnt_ki = np.asarray(batch.cnt_ki)[rows] - lo
+        c.cnt_node = np.asarray(batch.cnt_node)[rows]
+        c.cnt_val = np.asarray(batch.cnt_val)[rows]
+        c.cnt_uuid = np.asarray(batch.cnt_uuid)[rows]
+        c.cnt_base = np.asarray(batch.cnt_base)[rows]
+        c.cnt_base_t = np.asarray(batch.cnt_base_t)[rows]
+
+        a, z = np.searchsorted(el_sorted, (lo, hi))
+        rows = el_order[a:z]
+        c.el_ki = np.asarray(batch.el_ki)[rows] - lo
+        c.el_add_t = np.asarray(batch.el_add_t)[rows]
+        c.el_add_node = np.asarray(batch.el_add_node)[rows]
+        c.el_del_t = np.asarray(batch.el_del_t)[rows]
+        idx = rows.tolist()
+        c.el_member = [batch.el_member[i] for i in idx]
+        c.el_val = [batch.el_val[i] for i in idx]
+
+        if lo == 0 and batch.del_keys:
+            c.del_keys = list(batch.del_keys)
+            c.del_t = np.asarray(batch.del_t, dtype=_I64)
+        yield c
+
+
+def iter_keyspace_chunks(ks, chunk_keys: int = 1 << 16,
+                         include_deletes: bool = True) -> Iterator[ColumnarBatch]:
+    """Chunked columnar dump of a keyspace (the snapshot body producer —
+    reference src/server.rs:183-220 walks the DB per key instead)."""
+    yield from batch_chunks(batch_from_keyspace(ks, include_deletes),
+                            chunk_keys)
+
+
+# --------------------------------------------------------------------------
+# writer
+
+
+class SnapshotWriter:
+    """Streams sections to any binary file object with a running checksum
+    (reference src/snapshot.rs:9-69 `checksum_writter`; ours tags the
+    algorithm in the header so native CRC64 and the hashlib fallback
+    interoperate)."""
+
+    def __init__(self, f: IO[bytes], compress_level: int = 1,
+                 alg: Optional[int] = None):
+        self._f = f
+        self._level = compress_level
+        self._sum = StreamChecksum(alg)
+        self._finished = False
+        header = MAGIC + bytes([self._sum.alg])
+        self._emit(header)
+
+    def _emit(self, data: bytes) -> None:
+        self._sum.update(data)
+        self._f.write(data)
+
+    def _section(self, kind: int, payload: bytearray) -> None:
+        assert not self._finished, "writer already finished"
+        flag = 0
+        body = bytes(payload)
+        if self._level > 0:
+            packed = zlib.compress(body, self._level)
+            if len(packed) < len(body):
+                flag, body = 1, packed
+        head = bytearray([kind, flag])
+        write_uvarint(head, len(body))
+        self._emit(bytes(head))
+        self._emit(body)
+
+    def write_node(self, meta: NodeMeta) -> None:
+        self._section(SEC_NODE, _encode_node(meta))
+
+    def write_replicas(self, records: Iterable[ReplicaRecord]) -> None:
+        self._section(SEC_REPLICAS, _encode_replicas(records))
+
+    def write_chunk(self, batch: ColumnarBatch) -> None:
+        self._section(SEC_BATCH, _encode_batch(batch))
+
+    def finish(self) -> None:
+        """End marker + digest.  The digest covers the marker, so dropping
+        trailing sections can't go unnoticed."""
+        self._emit(bytes([SEC_END]))
+        self._f.write(self._sum.digest().to_bytes(8, "big"))
+        self._finished = True
+
+
+# --------------------------------------------------------------------------
+# loader
+
+
+class SnapshotLoader:
+    """Incremental section iterator over a binary file object.
+
+    Yields `(kind, payload)` with kind in {"node", "replicas", "batch"} and
+    payload NodeMeta / list[ReplicaRecord] / ColumnarBatch.  Magic is
+    validated at construction; every malformed or truncated byte raises
+    `InvalidSnapshot(offset)`; the end-marker digest raises
+    `InvalidSnapshotChecksum` on mismatch (reference
+    src/snapshot.rs:100-301).  Batch numeric columns are zero-copy
+    read-only views over the section payload — engines only read them.
+    """
+
+    def __init__(self, f: IO[bytes]):
+        self._f = f
+        self._off = 0
+        self._done = False
+        head = self._read(len(MAGIC) + 1, checked=False)
+        if head[: len(MAGIC)] != MAGIC:
+            raise InvalidSnapshot(0)
+        try:
+            self._sum = StreamChecksum(head[len(MAGIC)])
+        except ValueError:
+            raise InvalidSnapshot(len(MAGIC)) from None
+        self._sum.update(head)
+
+    def _read(self, n: int, checked: bool = True) -> bytes:
+        data = self._f.read(n)
+        if len(data) != n:
+            raise InvalidSnapshot(self._off + len(data))
+        self._off += n
+        if checked:
+            self._sum.update(data)
+        return data
+
+    def _read_uvarint(self) -> int:
+        first = self._read(1)
+        tag = first[0] >> 6
+        extra = (0, 1, 3, 8)[tag]
+        buf = first + (self._read(extra) if extra else b"")
+        try:
+            return VarintReader(buf).uvarint()
+        except (ValueError, IndexError):
+            raise InvalidSnapshot(self._off) from None
+
+    def __iter__(self) -> Iterator[Tuple[str, object]]:
+        return self
+
+    def __next__(self) -> Tuple[str, object]:
+        if self._done:
+            raise StopIteration
+        kind = self._read(1)[0]
+        if kind == SEC_END:
+            digest = self._f.read(8)
+            if len(digest) != 8:
+                raise InvalidSnapshot(self._off + len(digest))
+            self._off += 8
+            if int.from_bytes(digest, "big") != self._sum.digest():
+                raise InvalidSnapshotChecksum()
+            self._done = True
+            raise StopIteration
+        name = _KIND_NAMES.get(kind)
+        if name is None:
+            raise InvalidSnapshot(self._off - 1)
+        flag = self._read(1)[0]
+        length = self._read_uvarint()
+        if flag not in (0, 1) or length > _MAX_SECTION:
+            raise InvalidSnapshot(self._off)
+        payload = self._read(length)
+        try:
+            if flag == 1:
+                # bound the inflated size too: this format arrives over the
+                # network during full sync, and zlib expands up to ~1032x —
+                # a corrupt length must not OOM the node before the
+                # end-of-stream digest can reject the file
+                d = zlib.decompressobj()
+                payload = d.decompress(payload, _MAX_SECTION)
+                if d.unconsumed_tail:
+                    raise ValueError("decompressed section exceeds size cap")
+            if kind == SEC_NODE:
+                return name, _decode_node(payload)
+            if kind == SEC_REPLICAS:
+                return name, _decode_replicas(payload)
+            return name, _decode_batch(payload)
+        except (zlib.error, ValueError, IndexError) as e:
+            raise InvalidSnapshot(self._off) from e
+
+
+# --------------------------------------------------------------------------
+# high-level dump / restore
+
+
+def dump_keyspace(path: str, ks, meta: NodeMeta,
+                  replicas: Iterable[ReplicaRecord] = (),
+                  chunk_keys: int = 1 << 16,
+                  compress_level: int = 1) -> int:
+    """Atomic whole-keyspace dump (reference src/server.rs:183-220, minus
+    the fork: the columnar capture is the consistent cut).  Returns the
+    file size."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            w = SnapshotWriter(f, compress_level=compress_level)
+            w.write_node(meta)
+            records = list(replicas)
+            if records:
+                w.write_replicas(records)
+            for chunk in iter_keyspace_chunks(ks, chunk_keys):
+                w.write_chunk(chunk)
+            w.finish()
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return os.path.getsize(path)
+
+
+def load_snapshot(path: str, ks, engine=None
+                  ) -> Tuple[NodeMeta, List[ReplicaRecord]]:
+    """Stream a snapshot file into a keyspace through a MergeEngine
+    (boot-time restore — server/io.py start_node; the reference restarts
+    empty, SURVEY.md §5.4).  Targets a FRESH keyspace: if the trailing
+    checksum fails, partial merges have already been applied and the
+    keyspace must be discarded.  Returns (NodeMeta, replica records)."""
+    if engine is None:
+        from ..engine.cpu import CpuMergeEngine
+        engine = CpuMergeEngine()
+    meta = NodeMeta()
+    records: List[ReplicaRecord] = []
+    with open(path, "rb") as f:
+        for kind, payload in SnapshotLoader(f):
+            if kind == "node":
+                meta = payload
+            elif kind == "replicas":
+                records = payload
+            else:
+                engine.merge(ks, payload)
+    if getattr(engine, "needs_flush", False):
+        engine.flush(ks)
+    return meta, records

@@ -1,0 +1,50 @@
+"""Tripwire: every module in the package must import, and no tracked-dir
+source file may be gitignored.
+
+Round 3 lost constdb_tpu/persist/snapshot.py to a `.gitignore` pattern that
+silently excluded it from every commit; the dangling import then broke the
+persist/replica/server layers two rounds later.  These tests make that
+class of loss fail the suite at the first commit instead.
+"""
+
+import importlib
+import pkgutil
+import subprocess
+from pathlib import Path
+
+import constdb_tpu
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def test_every_module_imports():
+    failures = []
+    for info in pkgutil.walk_packages(constdb_tpu.__path__,
+                                      prefix="constdb_tpu."):
+        try:
+            importlib.import_module(info.name)
+        except Exception as e:  # noqa: BLE001 — report them all at once
+            failures.append(f"{info.name}: {type(e).__name__}: {e}")
+    assert not failures, "unimportable modules:\n" + "\n".join(failures)
+
+
+def test_no_gitignored_source_files():
+    """`git status --ignored` over the package must show no .py/.cpp files
+    (a gitignored source file silently vanishes from every commit)."""
+    if not (REPO_ROOT / ".git").exists():
+        return  # not a git checkout (sdist install) — nothing to check
+    try:
+        # --ignored=matching lists individual files even when a whole
+        # directory is ignored (the default mode collapses to "dir/")
+        proc = subprocess.run(
+            ["git", "status", "--ignored=matching", "--porcelain",
+             "--", "constdb_tpu/", "tests/", "native/"],
+            capture_output=True, text=True, timeout=30, cwd=REPO_ROOT)
+    except (OSError, subprocess.TimeoutExpired):
+        return
+    assert proc.returncode == 0, f"git status failed: {proc.stderr}"
+    bad = [line for line in proc.stdout.splitlines()
+           if line.startswith("!!") and line.endswith((".py", ".cpp", ".h"))
+           and "__pycache__" not in line and "/_native/" not in line]
+    assert not bad, "gitignored source files (would be lost on reset):\n" \
+        + "\n".join(bad)
