@@ -98,9 +98,21 @@ def build_engine(kind: str):
                     raise
                 force_cpu_platform()
         elif kind == "tpu":
-            raise RuntimeError(
-                f"engine='tpu' requested but no healthy device backend: "
-                f"{probe.error or f'default backend is {probe.platform}'}")
+            # a node that cannot find its accelerator must still SERVE: the
+            # XLA engine on the CPU backend runs the same batched kernels
+            # (falling back keeps the operator's config portable; the
+            # warning makes the degradation visible in INFO/logs)
+            import logging
+            logging.getLogger(__name__).warning(
+                "engine='tpu' requested but no healthy device backend (%s); "
+                "falling back to the XLA-on-CPU engine",
+                probe.error or f"default backend is {probe.platform}")
+            force_cpu_platform()
+            try:
+                from .engine.tpu import TpuMergeEngine
+                return TpuMergeEngine()
+            except Exception:
+                pass  # no usable XLA at all: plain CPU engine below
         if not probe.ok:
             force_cpu_platform()
     from .engine.cpu import CpuMergeEngine

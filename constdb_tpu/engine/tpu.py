@@ -74,6 +74,52 @@ def _host_table(store: KeySpace, fam: str):
                                          else store.keys)
 
 
+# ------------------------------------------------------- host group combine
+# Transfer-bound devices (a TPU behind a tunnel moves ~100 MB/s with ~80 ms
+# per-transfer latency) pay per BYTE and per TRANSFER, so a group of staged
+# batches is pre-combined ON HOST whenever that shrinks either:
+#   * aligned rows (R replica snapshots of one keyspace) fold R× down with
+#     vectorized numpy lex-max — upload drops R×;
+#   * disjoint rows (consecutive chunks of ONE snapshot) concatenate into a
+#     single batch — same bytes, one transfer + one kernel instead of R.
+# Both reductions compute exactly crdt/semantics.py (lexicographic (t, v)
+# max / plain max), so device results are bit-identical either way.
+
+
+def _rows_aligned(staged) -> bool:
+    if len(staged) < 2:
+        return False
+    r0 = staged[0][0]
+    return all(len(s[0]) == len(r0) and np.array_equal(s[0], r0)
+               for s in staged[1:])
+
+
+def _rows_disjoint_cat(staged):
+    """Concatenated row array if no row repeats across entries, else None."""
+    cat = np.concatenate([s[0] for s in staged])
+    if len(np.unique(cat)) == len(cat):
+        return cat
+    return None
+
+
+def _lex_fold(t_s: np.ndarray, v_s: np.ndarray):
+    """[R, N] lexicographic (t, v) max -> (t[N], v[N], win_batch[N]).
+    Mirrors ops/bulk.py _pair_win / crdt/semantics.py lww_wins."""
+    wt = t_s.max(axis=0)
+    cand = t_s == wt
+    wv = np.where(cand, v_s, K.NEUTRAL_T).max(axis=0)
+    wb = np.argmax(cand & (v_s == wv), axis=0)
+    return wt, wv, wb
+
+
+def _sel_obj(lists, wb: np.ndarray) -> np.ndarray:
+    """Pick lists[wb[j]][j] for every j, vectorized via an object matrix."""
+    obj = np.empty((len(lists), len(wb)), dtype=object)
+    for i, v in enumerate(lists):
+        obj[i, :] = v
+    return obj[wb, np.arange(len(wb))]
+
+
 def _fam_rows(store: KeySpace, fam: str) -> int:
     return _host_table(store, fam).n
 
@@ -115,6 +161,10 @@ class TpuMergeEngine:
         self._pallas_broken = False
         self.resident = resident
         self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
+        # deferred win-value resolution (resident mode): host value pool the
+        # device-resident `src` planes index into; resolved once at flush
+        self._val_pool: list[tuple[int, list]] = []
+        self._pool_size = 0
         self._seen_version = -1
         self.needs_flush = False
         self._mesh = mesh
@@ -127,6 +177,44 @@ class TpuMergeEngine:
             self._jit_cache: dict = {}
         else:
             self._kv_n = 1
+
+    def _host_combine(self) -> bool:
+        """Host group pre-combine is on unless a device fold backend is
+        explicitly forced (those test paths must still execute) or folding
+        is off entirely."""
+        return self.dense_fold == "auto"
+
+    def _combine_groups(self, staged, fold_fn, cat_fn):
+        """Collapse a multi-batch staged list on host (see the host-combine
+        block comment above): aligned rows fold via `fold_fn` (counted as a
+        fold), disjoint rows concatenate via `cat_fn(staged, cat)`; an
+        overlapping-unaligned group stays as-is (sequential kernels)."""
+        if not self._host_combine() or len(staged) < 2:
+            return staged
+        if _rows_aligned(staged):
+            self.folds += 1
+            return [fold_fn(staged)]
+        cat = _rows_disjoint_cat(staged)
+        if cat is not None:
+            return [cat_fn(staged, cat)]
+        return staged
+
+    def _pool_add(self, vals) -> np.ndarray:
+        base = self._pool_size
+        vals = list(vals)
+        self._val_pool.append((base, vals))
+        self._pool_size = base + len(vals)
+        return np.arange(base, base + len(vals), dtype=_I64)
+
+    def _src_state(self, fam: str, sp: int):
+        """Device win-source plane for `fam`, grown to sp (fill -1)."""
+        res = self._res.get(fam) or {}
+        src = res.get("src")
+        if src is None:
+            return B.device_full(sp, -1)
+        if src.shape[0] < sp:
+            src = self._grow(src, sp - src.shape[0], -1)
+        return src
 
     # ----------------------------------------------------- device placement
 
@@ -246,7 +334,32 @@ class TpuMergeEngine:
             return
         import time as _time
         t0 = _time.perf_counter()
-        get = self._jax.device_get
+        # dispatch every download first (device-side [:n] slice so padding
+        # never crosses the link; copy_to_host_async overlaps transfers),
+        # then consume — one latency wait instead of one per column
+        pending: dict[tuple, object] = {}
+        for fam, res in self._res.items():
+            n = res["n"]
+            if n == 0:
+                continue
+            cols = res["cols"]
+            names = ["stack"] if fam == "env" else \
+                [name for name, _ in _FAMILIES[fam]]
+            written = res.get("written")
+            for name in names:
+                if written is not None and name not in written:
+                    continue  # mirror column never scattered into: the
+                    # host column it was built from is still exact
+                pending[(fam, name)] = cols[name][:n]
+            if res.get("src") is not None:
+                pending[(fam, "src")] = res["src"][:n]
+        for arr in pending.values():
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        host = {k: np.asarray(v) for k, v in pending.items()}
+
         for fam, res in self._res.items():
             n = res["n"]
             if n == 0:
@@ -254,23 +367,57 @@ class TpuMergeEngine:
             table = _host_table(store, fam)
             if fam == "el":
                 old_dt = table.del_t[:n].copy()
-            cols = res["cols"]
             if fam == "env":
-                out = np.asarray(get(cols["stack"]))[:n]
+                out = host[(fam, "stack")]
                 for i, (name, _) in enumerate(_FAMILIES["env"]):
                     table.col(name)[:n] = out[:, i]
             else:
                 for name, _ in _FAMILIES[fam]:
-                    table.col(name)[:n] = np.asarray(get(cols[name]))[:n]
+                    if (fam, name) in host:
+                        table.col(name)[:n] = host[(fam, name)]
+            if (fam, "src") in host:
+                self._resolve_src(store, fam, host[(fam, "src")])
+                res["src"] = None  # resolved; fresh tracking next round
+            if res.get("written") is not None:
+                # downloaded state now equals the host columns: only columns
+                # dirtied AFTER this flush need the next download
+                res["written"] = set()
             if fam == "el":
                 self._enqueue_elem_garbage(store, np.arange(n),
                                            table.add_t[:n], table.del_t[:n],
                                            old_dt)
+        self._val_pool.clear()
+        self._pool_size = 0
         if "cnt" in self._res and self._res["cnt"]["n"]:
             store.recompute_counter_sums()
         self.needs_flush = False
         self._seen_version = store.version
         self.family_secs["flush"] += _time.perf_counter() - t0
+
+    def _resolve_src(self, store: KeySpace, fam: str,
+                     src_h: np.ndarray) -> None:
+        """Assign deferred win VALUES: slots whose src plane points into the
+        host value pool take that pool entry (set rows — valueless by
+        construction — are skipped wholesale)."""
+        n = len(src_h)
+        if fam == "reg":
+            mask = src_h >= 0
+            target = store.reg_val
+        else:
+            mask = (src_h >= 0) & np.isin(
+                store.keys.enc[store.el.kid[:n]], S.VALUE_ENCS)
+            target = store.el_val
+        rows = np.nonzero(mask)[0]
+        if not len(rows):
+            return
+        gids = src_h[rows]
+        bases = np.fromiter((b for b, _ in self._val_pool), dtype=_I64,
+                            count=len(self._val_pool))
+        segs = np.searchsorted(bases, gids, side="right") - 1
+        pool = self._val_pool
+        for r, s, g in zip(rows.tolist(), segs.tolist(), gids.tolist()):
+            b, vals = pool[s]
+            target[r] = vals[g - b]
 
     # ------------------------------------------------------ resident state
 
@@ -300,11 +447,23 @@ class TpuMergeEngine:
         else:
             cols = res["cols"]
             cap = res["cap"]
-        self._res[fam] = {"cols": cols, "n": n, "cap": cap}
+        self._res[fam] = {"cols": cols, "n": n, "cap": cap,
+                          "src": res.get("src") if res else None,
+                          "written": res.get("written", set()) if res
+                          else set()}
         return cols, cap
 
-    def _family_done(self, fam: str, cols: dict, n: int, cap: int) -> None:
-        self._res[fam] = {"cols": cols, "n": n, "cap": cap}
+    def _family_done(self, fam: str, cols: dict, n: int, cap: int,
+                     src=None, written=None) -> None:
+        """Record post-merge device state.  `written` marks which columns
+        the kernels actually scattered into since the mirror was created —
+        flush downloads only those (an untouched mirror column equals the
+        host column it was uploaded from, padding included).  None = all."""
+        prev = self._res.get(fam) or {}
+        w = prev.get("written", set())
+        w |= set(cols) if written is None else written
+        self._res[fam] = {"cols": cols, "n": n, "cap": cap, "written": w,
+                          "src": src if src is not None else prev.get("src")}
         self.needs_flush = True
 
     def _drop_family(self, store: KeySpace, fam: str) -> None:
@@ -417,13 +576,8 @@ class TpuMergeEngine:
     # align for repeated syncs from the SAME origin (replica snapshots
     # carry per-(key, node) slots, which differ per replica).
 
-    @staticmethod
-    def _aligned(staged) -> bool:
-        if len(staged) < 2:
-            return False
-        r0 = staged[0][0]
-        return all(len(s[0]) == len(r0) and np.array_equal(s[0], r0)
-                   for s in staged[1:])
+    # the device-fold path shares the host pre-combine's alignment rule
+    _aligned = staticmethod(_rows_aligned)
 
     def _fold_prep(self, staged, base: int, sp: int):
         """Common fold staging: (rows0, nA, np_, device idx)."""
@@ -525,6 +679,13 @@ class TpuMergeEngine:
                                 b.key_dt[valid], b.key_expire[valid]]))
         if not staged:
             return
+        staged = self._combine_groups(
+            staged,
+            lambda st: (st[0][0],
+                        [np.maximum.reduce([s[1][i] for s in st])
+                         for i in range(4)]),
+            lambda st, cat: (cat, [np.concatenate([s[1][i] for s in st])
+                                   for i in range(4)]))
         total = sum(len(p) for p, _ in staged)
         n = store.keys.n
         base, size, all_new = self._bulk_region([p for p, _ in staged],
@@ -607,6 +768,19 @@ class TpuMergeEngine:
                                [b.reg_val[i] for i in idx]))
         if not staged:
             return
+        def _fold_reg(st):
+            t_f, n_f, wb = _lex_fold(np.stack([s[1] for s in st]),
+                                     np.stack([s[2] for s in st]))
+            return (st[0][0], t_f, n_f, list(_sel_obj([s[3] for s in st], wb)))
+
+        def _cat_reg(st, cat):
+            vals_cat: list = []
+            for s in st:
+                vals_cat.extend(s[3])
+            return (cat, np.concatenate([s[1] for s in st]),
+                    np.concatenate([s[2] for s in st]), vals_cat)
+
+        staged = self._combine_groups(staged, _fold_reg, _cat_reg)
         total = sum(len(p) for p, *_ in staged)
         n = store.keys.n
         base, size, all_new = self._bulk_region([p for p, *_ in staged],
@@ -622,6 +796,21 @@ class TpuMergeEngine:
                 t = self._state_up(store.keys.rv_t, base, size, sp, 0, all_new)
                 nd = self._state_up(store.keys.rv_node, base, size, sp, 0,
                                     all_new)
+            if self.resident and self._host_combine():
+                # deferred value resolution: no blocking win download — the
+                # winning row's pool id lands in the resident src plane and
+                # resolves once at flush (ops/bulk.py bulk_lww_src)
+                src = self._src_state("reg", sp)
+                for p, bt_, bn_, vals in staged:
+                    ids = self._pool_add(vals)
+                    idx, dbt, dbn, dsrc = self._upload_batch(
+                        p, base, sp, [(bt_, K.NEUTRAL_T), (bn_, K.NEUTRAL_T),
+                                      (ids, -1)])
+                    t, nd, src = B.bulk_lww_src(t, nd, src, idx, dbt, dbn,
+                                                dsrc)
+                self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp,
+                                  src=src)
+                return
             fold = self._fold_backend() != "off" and self._aligned(staged)
             if fold:
                 rows0, nA, np_, idx = self._fold_prep(staged, base, sp)
@@ -705,6 +894,20 @@ class TpuMergeEngine:
                            b.cnt_base[keep], b.cnt_base_t[keep]))
         if not staged:
             return
+        def _fold_cnt(st):
+            # both (value @ time) pairs fold independently on host
+            f_uuid, f_val, _ = _lex_fold(np.stack([s[2] for s in st]),
+                                         np.stack([s[1] for s in st]))
+            f_bt, f_base, _ = _lex_fold(np.stack([s[4] for s in st]),
+                                        np.stack([s[3] for s in st]))
+            return (st[0][0], f_val, f_uuid, f_base, f_bt)
+
+        # disjoint is the common catch-up shape here: R replicas each carry
+        # their own node's slots
+        staged = self._combine_groups(
+            staged, _fold_cnt,
+            lambda st, cat: (cat,) + tuple(
+                np.concatenate([s[i] for s in st]) for i in range(1, 5)))
         n = store.cnt.n
         total = sum(len(r) for r, *_ in staged)
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
@@ -723,6 +926,7 @@ class TpuMergeEngine:
                 cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
                 cbt = self._state_up(store.cnt.base_t, base, size, sp,
                                      K.NEUTRAL_T, all_new)
+            written = {"val", "uuid", "base", "base_t"}
             if self._fold_backend() != "off" and self._aligned(staged):
                 # aligned counter rows (same (key, node) slots per batch —
                 # repeated syncs from one origin): fold both (value @ time)
@@ -737,16 +941,32 @@ class TpuMergeEngine:
                 val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
                                                      idx, fv, fu, fb, fbt)
             else:
-                dev = [self._upload_batch(
-                    r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
-                                  (bt, K.NEUTRAL_T)])
-                    for r, v, u, bb, bt in staged]
-                for idx, v, u, bb, bt in dev:
-                    val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
-                                                         idx, v, u, bb, bt)
+                # a batch whose base plane is neutral (no counter deletes —
+                # the common case) skips uploading and merging it entirely
+                written = {"val", "uuid"}
+                dev = []  # [(uploaded arrays, with_base)]
+                for r, v, u, bb, bt in staged:
+                    if self.resident and (bt == K.NEUTRAL_T).all():
+                        dev.append((self._upload_batch(
+                            r, base, sp, [(v, 0), (u, K.NEUTRAL_T)]), False))
+                    else:
+                        dev.append((self._upload_batch(
+                            r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
+                                          (bt, K.NEUTRAL_T)]), True))
+                for up, with_base in dev:
+                    if with_base:
+                        idx, v, u, bb, bt = up
+                        val, uuid, cb, cbt = B.bulk_counters(
+                            val, uuid, cb, cbt, idx, v, u, bb, bt)
+                        written |= {"base", "base_t"}
+                    else:
+                        idx, v, u = up
+                        val, uuid = B.bulk_counters_vu(val, uuid, idx, v, u)
             if self.resident:
                 self._family_done("cnt", {"val": val, "uuid": uuid,
-                                          "base": cb, "base_t": cbt}, n, sp)
+                                          "base": cb, "base_t": cbt}, n, sp,
+                                  written=written if self._host_combine()
+                                  else None)
                 return
             store.cnt.val[base:n] = np.asarray(val)[:size]
             store.cnt.uuid[base:n] = np.asarray(uuid)[:size]
@@ -833,6 +1053,26 @@ class TpuMergeEngine:
                            any(v is not None for v in vals)))
         if not staged:
             return
+        def _fold_el(st):
+            f_at, f_an, wb = _lex_fold(np.stack([s[1] for s in st]),
+                                       np.stack([s[2] for s in st]))
+            f_dt = np.maximum.reduce([s[3] for s in st])
+            hv = any(s[5] for s in st)
+            vals = list(_sel_obj([s[4] for s in st], wb)) if hv \
+                else [None] * len(wb)
+            return (st[0][0], f_at, f_an, f_dt, vals, hv)
+
+        def _cat_el(st, cat):
+            vals_cat: list = []
+            for s in st:
+                vals_cat.extend(s[4])
+            return (cat,
+                    np.concatenate([s[1] for s in st]),
+                    np.concatenate([s[2] for s in st]),
+                    np.concatenate([s[3] for s in st]),
+                    vals_cat, any(s[5] for s in st))
+
+        staged = self._combine_groups(staged, _fold_el, _cat_el)
         n = store.el.n
         total = sum(len(r) for r, *_ in staged)
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
@@ -843,6 +1083,34 @@ class TpuMergeEngine:
                 at, an, dt = cols["add_t"], cols["add_node"], cols["del_t"]
                 base, size = 0, n
                 old_dt = None  # garbage enqueue deferred to flush
+                if self._host_combine():
+                    # deferred value resolution (see _merge_registers): a
+                    # src plane is tracked only once dict VALUES are in play
+                    # — pure set traffic never pays the src download
+                    have_src = (self._res.get("el") or {}).get("src") is not None
+                    need_src = have_src or any(s[5] for s in staged) or any(
+                        np.isin(store.keys.enc[store.el.kid[s[0]]],
+                                S.VALUE_ENCS).any() for s in staged)
+                    src = self._src_state("el", sp) if need_src else None
+                    for rows_, a_, x_, d_, vals, _hv in staged:
+                        if src is not None:
+                            ids = self._pool_add(vals)
+                            idx, da, dx, dd, dsrc = self._upload_batch(
+                                rows_, base, sp,
+                                [(a_, K.NEUTRAL_T), (x_, K.NEUTRAL_T),
+                                 (d_, 0), (ids, -1)])
+                            at, an, dt, src = B.bulk_elems_src(
+                                at, an, dt, src, idx, da, dx, dd, dsrc)
+                        else:
+                            idx, da, dx, dd = self._upload_batch(
+                                rows_, base, sp,
+                                [(a_, K.NEUTRAL_T), (x_, K.NEUTRAL_T),
+                                 (d_, 0)])
+                            at, an, dt, _win = B.bulk_elems(at, an, dt, idx,
+                                                            da, dx, dd)
+                    self._family_done("el", {"add_t": at, "add_node": an,
+                                             "del_t": dt}, n, sp, src=src)
+                    return
             else:
                 sp = self._sp_size(size)
                 old_dt = (np.zeros(size, dtype=_I64) if all_new

@@ -40,7 +40,8 @@ import jax.numpy as jnp  # noqa: E402
 from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
 __all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
-           "bulk_counters", "bulk_elems"]
+           "bulk_counters", "bulk_counters_vu", "bulk_elems",
+           "bulk_lww_src", "bulk_elems_src"]
 
 
 @partial(jax.jit, static_argnames=("n", "fill"))
@@ -77,6 +78,22 @@ def bulk_lww(t, n, idx, bt, bn):
     return t, n, win
 
 
+@partial(jax.jit, donate_argnums=(0, 1))
+def bulk_counters_vu(val, uuid, idx, bv, bt):
+    """Counter value pair only — batches with a neutral base plane (no
+    counter deletes anywhere in the batch, the overwhelmingly common case)
+    skip uploading and merging the base columns entirely."""
+    size = val.shape[0]
+    ic = jnp.minimum(idx, size - 1)
+    cv, ct = val[ic], uuid[ic]
+    win = _pair_win(cv, ct, bv, bt, idx < size)
+    val = val.at[idx].set(jnp.where(win, bv, cv), mode="drop",
+                          unique_indices=True)
+    uuid = uuid.at[idx].set(jnp.where(win, bt, ct), mode="drop",
+                            unique_indices=True)
+    return val, uuid
+
+
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def bulk_counters(val, uuid, base, base_t, idx, bv, bt, bb, bbt):
     """Counter slots: two independent (value @ time) pairs per slot, each
@@ -100,6 +117,41 @@ def bulk_counters(val, uuid, base, base_t, idx, bv, bt, bb, bbt):
     base_t = base_t.at[idx].set(jnp.where(win, bbt, cbt), mode="drop",
                                 unique_indices=True)
     return val, uuid, base, base_t
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def bulk_lww_src(t, n, src, idx, bt, bn, bsrc):
+    """bulk_lww with DEFERRED value resolution: instead of returning win
+    flags (whose download blocks the pipeline every call — fatal when the
+    device hangs off a high-latency link), the winning batch row's host
+    value-pool id scatters into the resident `src` plane.  The engine
+    downloads `src` ONCE at flush and resolves every winner in one pass."""
+    size = t.shape[0]
+    ic = jnp.minimum(idx, size - 1)
+    ct, cn, cs = t[ic], n[ic], src[ic]
+    win = _pair_win(cn, ct, bn, bt, idx < size)
+    t = t.at[idx].set(jnp.where(win, bt, ct), mode="drop", unique_indices=True)
+    n = n.at[idx].set(jnp.where(win, bn, cn), mode="drop", unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, bsrc, cs), mode="drop",
+                          unique_indices=True)
+    return t, n, src
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def bulk_elems_src(at, an, dt, src, idx, bat, ban, bdt, bsrc):
+    """bulk_elems with deferred value resolution (see bulk_lww_src)."""
+    size = at.shape[0]
+    ic = jnp.minimum(idx, size - 1)
+    ca, cn, cd, cs = at[ic], an[ic], dt[ic], src[ic]
+    win = _pair_win(cn, ca, ban, bat, idx < size)
+    at = at.at[idx].set(jnp.where(win, bat, ca), mode="drop",
+                        unique_indices=True)
+    an = an.at[idx].set(jnp.where(win, ban, cn), mode="drop",
+                        unique_indices=True)
+    dt = dt.at[idx].max(bdt, mode="drop", unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, bsrc, cs), mode="drop",
+                          unique_indices=True)
+    return at, an, dt, src
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))

@@ -36,7 +36,7 @@ import random
 from typing import Optional, TYPE_CHECKING
 
 from ..errors import CstError, ReplicateCommandsLost
-from ..persist.snapshot import SnapshotLoader
+from ..persist.snapshot import SnapshotLoader, batch_chunks
 from ..resp.codec import RespParser, encode_msg
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
 from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
@@ -152,7 +152,7 @@ class ReplicaLink:
 
     def _check_sync_reply(self, msg) -> int:
         from ..resp.message import Err
-        if isinstance(msg, Err) and b"forgotten" in msg.val:
+        if isinstance(msg, Err) and msg.val.startswith(b"FORGOTTEN"):
             # the peer expelled us (FORGET): stop dialing it.  The flag is
             # cleared when someone re-MEETs us and dials in (adopt()).
             self.meta.dial_suspended = True
@@ -347,6 +347,51 @@ class ReplicaLink:
                 remaining -= len(got)
         node = self.node
         applied_rows = 0
+        # Grouped apply cadence: accumulate up to `sync_merge_group` chunks
+        # and merge them in ONE engine call (Node.merge_batches → engine
+        # merge_many: aligned groups fold in a fused [R, N] device pass;
+        # unaligned ones still share one state roundtrip per family —
+        # reference pull.rs:66-74 batches ≤32 entries per apply for the same
+        # reason).  Adaptive liveness: if a call overruns the budget the
+        # group shrinks, then chunks SPLIT (batch_chunks re-chunks any
+        # batch) so a CPU-engine catch-up never wedges the event loop on
+        # one 64Ki-key merge.
+        group: list = []
+        max_group = max(1, self.app.sync_merge_group)
+        budget = self.app.sync_merge_budget
+        target = 1
+        # ramp UP from small sub-chunks so the first call can never wedge
+        # the loop, regardless of engine speed: fast calls first grow the
+        # split size to whole chunks, then the group size to max_group;
+        # slow calls walk the same ladder back down
+        split_keys = max(0, self.app.sync_initial_split)
+        loop = asyncio.get_running_loop()
+
+        async def apply_group() -> None:
+            nonlocal applied_rows, target, split_keys
+            if not group:
+                return
+            t0 = loop.time()
+            node.merge_batches(group)
+            dt = loop.time() - t0
+            applied_rows += sum(b.n_rows for b in group)
+            if dt > budget:
+                if target > 1:
+                    target = max(1, target // 2)
+                elif split_keys == 0:
+                    split_keys = 1 << 15
+                else:
+                    split_keys = max(1024, split_keys // 2)
+            elif dt < budget / 4:
+                if split_keys:
+                    split_keys <<= 1
+                    if split_keys >= (1 << 17):
+                        split_keys = 0  # chunks applied whole from here on
+                elif target < max_group:
+                    target = min(max_group, target * 2)
+            group.clear()
+            await asyncio.sleep(0)
+
         with open(path, "rb") as f:
             for kind, payload in SnapshotLoader(f):
                 if kind == "node":
@@ -357,9 +402,16 @@ class ReplicaLink:
                     node.replicas.merge_records(
                         payload, my_addr=self.app.advertised_addr)
                 else:
-                    node.merge_batch(payload)
-                    applied_rows += payload.n_rows
-                    await asyncio.sleep(0)
+                    if split_keys and payload.n_keys > split_keys:
+                        for sub in batch_chunks(payload, split_keys):
+                            group.append(sub)
+                            if len(group) >= target:
+                                await apply_group()
+                    else:
+                        group.append(payload)
+                    if len(group) >= target:
+                        await apply_group()
+            await apply_group()
         if repl_last > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = repl_last
         node.hlc.observe(repl_last)

@@ -129,6 +129,10 @@ class RespParser:
                         except ValueError:
                             raise InvalidRequestMsg(
                                 "invalid bulk length") from None
+                        if ln > 512 << 20:
+                            # same cap as the general path below: a huge
+                            # declared length must fail fast, not buffer
+                            raise InvalidRequestMsg("bulk string too large")
                         if ln < 0:
                             break  # $-1 Nil inside arrays: general path
                         end = e + 2 + ln + 2

@@ -723,7 +723,10 @@ def lpush_command(node, ctx, args):
     values = args.rest_bytes()
     if not values:
         raise WrongArity("lpush")
-    return Int(_list_insert(node, ctx, key, 0, values))
+    # redis convention: LPUSH k a b c pushes one at a time to the HEAD, so
+    # the list reads c, b, a.  _list_insert places values consecutively, so
+    # feed it the reversed order.
+    return Int(_list_insert(node, ctx, key, 0, list(reversed(values))))
 
 
 @register("rpush", CMD_WRITE | CMD_NO_REPLICATE)

@@ -39,7 +39,10 @@ class ServerApp:
                  handshake_timeout: float = 10.0,
                  snapshot_chunk_keys: int = 1 << 16,
                  gc_interval: float = 1.0,
-                 snapshot_path: str = ""):
+                 snapshot_path: str = "",
+                 sync_merge_group: int = 8,
+                 sync_merge_budget: float = 0.1,
+                 sync_initial_split: int = 4096):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -55,6 +58,12 @@ class ServerApp:
         self.snapshot_chunk_keys = snapshot_chunk_keys
         self.gc_interval = gc_interval
         self.snapshot_path = snapshot_path
+        # snapshot-apply cadence: chunks per engine call (ceiling), the
+        # per-call liveness budget (seconds) the adaptive controller steers
+        # toward, and the sub-chunk size the ramp starts from
+        self.sync_merge_group = sync_merge_group
+        self.sync_merge_budget = sync_merge_budget
+        self.sync_initial_split = sync_initial_split
         self._server: Optional[asyncio.base_events.Server] = None
         self._cron_task: Optional[asyncio.Task] = None
         self._conn_tasks: set[asyncio.Task] = set()
@@ -93,11 +102,18 @@ class ServerApp:
         for m in list(self.node.replicas.peers.values()):
             if isinstance(m.link, ReplicaLink):
                 await m.link.stop()
+        # stop accepting FIRST, then cancel handlers, then wait: on Python
+        # 3.12+ Server.wait_closed waits for every spawned handler, so
+        # waiting before the cancel sweep deadlocks on any live client —
+        # and cancelling before close() would miss a handler accepted
+        # during the link-stop awaits above
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+        await asyncio.sleep(0)  # let just-accepted handlers register
         for t in list(self._conn_tasks):
             t.cancel()
+        if self._server is not None:
+            await self._server.wait_closed()
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -210,7 +226,10 @@ class ServerApp:
             # an explicit MEET re-admits the address (Redis CLUSTER
             # FORGET-style ban).  Auto-re-adding here resurrected forgotten
             # peers across the whole mesh within one reconnect_delay.
-            writer.write(b"-forgotten: removed from this mesh; "
+            # structured error CODE (first token) — the dialing link matches
+            # on this prefix to suspend, so an unrelated error that merely
+            # mentions the word can never trip it (replica/link.py)
+            writer.write(b"-FORGOTTEN removed from this mesh; "
                          b"an explicit MEET is required to rejoin\r\n")
             writer.close()
             return

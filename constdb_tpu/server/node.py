@@ -113,7 +113,39 @@ class Node:
         self.stats.merge_secs += time.perf_counter() - t0
         self.stats.merges += 1
         self.stats.merge_rows += batch.n_rows
+        self._dump_stale()
         return st
+
+    def _dump_stale(self) -> None:
+        """Bulk-merged state bypasses the repl_log, so a cached full-sync
+        dump plus a log tail would silently omit it: force the next peer to
+        get a fresh dump (persist/share.py reuse rule covers only LOGGED
+        writes)."""
+        app = self.app
+        if app is not None and getattr(app, "shared_dump", None) is not None:
+            app.shared_dump.invalidate()
+
+    def merge_batches(self, batches: list) -> None:
+        """Merge a GROUP of columnar batches in one engine call when the
+        engine supports it (engine/tpu.py merge_many reduces aligned groups
+        in one fused [R, N] device pass, and unaligned groups still share
+        one state roundtrip per family); per-batch merges otherwise."""
+        if not batches:
+            return
+        if len(batches) == 1 or not hasattr(self.engine, "merge_many"):
+            for b in batches:
+                self.merge_batch(b)
+            return
+        import time
+        t0 = time.perf_counter()
+        self.engine.merge_many(self.ks, batches)
+        self.stats.merge_secs += time.perf_counter() - t0
+        self.stats.merges += 1
+        self.stats.merge_rows += sum(b.n_rows for b in batches)
+        x = self.stats.extra
+        x["group_merges"] = x.get("group_merges", 0) + 1
+        x["group_merge_batches"] = x.get("group_merge_batches", 0) + len(batches)
+        self._dump_stale()
 
     def ensure_flushed(self) -> None:
         """Sync device-resident merge state back to the host keyspace

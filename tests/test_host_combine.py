@@ -1,0 +1,137 @@
+"""Host group pre-combine + deferred win resolution (engine/tpu.py).
+
+The transfer-bound paths: aligned groups fold on host before upload,
+disjoint groups concatenate to one transfer, and resident mode resolves
+win VALUES from the device src plane once at flush instead of downloading
+win flags per call.  All must stay bit-identical to the CPU engine.
+"""
+
+import numpy as np
+import pytest
+
+from constdb_tpu.engine.base import batch_from_keyspace
+from constdb_tpu.engine.cpu import CpuMergeEngine
+from constdb_tpu.engine.tpu import TpuMergeEngine
+from constdb_tpu.persist.snapshot import batch_chunks
+from constdb_tpu.resp.message import Bulk
+from constdb_tpu.server.node import Node
+from constdb_tpu.store.keyspace import KeySpace
+
+from test_merge_properties import gen_store
+
+
+def _cmd(node, *parts):
+    return node.execute([Bulk(p if isinstance(p, bytes) else str(p).encode())
+                         for p in parts])
+
+
+def _cpu_ref(batches):
+    st = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in batches:
+        cpu.merge(st, b)
+    return st
+
+
+@pytest.mark.parametrize("resident", [False, True])
+def test_aligned_group_host_folds(resident):
+    """R replica dumps over one key list fold on host: folds > 0, exact."""
+    import bench
+    batches = bench.make_workload(400, 4, seed=5)
+    eng = TpuMergeEngine(resident=resident)
+    st = KeySpace()
+    eng.merge_many(st, batches)
+    if eng.needs_flush:
+        eng.flush(st)
+    assert eng.folds > 0
+    assert st.canonical() == _cpu_ref(batches).canonical()
+
+
+@pytest.mark.parametrize("resident", [False, True])
+def test_disjoint_group_combines(resident):
+    """Consecutive chunks of ONE snapshot (disjoint key ranges) merge as a
+    single combined call — the link's grouped cadence."""
+    src = gen_store(seed=77, node=3)
+    chunks = list(batch_chunks(batch_from_keyspace(src), 5))
+    assert len(chunks) > 2
+    eng = TpuMergeEngine(resident=resident)
+    st = KeySpace()
+    eng.merge_many(st, chunks)
+    if eng.needs_flush:
+        eng.flush(st)
+    assert st.canonical() == _cpu_ref(chunks).canonical()
+
+
+def test_deferred_dict_values_resolve_at_flush():
+    """Dict VALUES win through the src plane and appear only after flush."""
+    a, b = Node(node_id=1), Node(node_id=2)
+    for i in range(40):
+        _cmd(a, b"hset", b"h%d" % (i % 5), b"f%d" % i, b"va%d" % i)
+    for i in range(40):
+        _cmd(b, b"hset", b"h%d" % (i % 5), b"f%d" % i, b"vb%d" % i)
+    batches = [batch_from_keyspace(a.ks), batch_from_keyspace(b.ks)]
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    eng.merge_many(st, batches)
+    assert eng.needs_flush
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref(batches).canonical()
+    # a second flush with no merges must not re-resolve a cleared pool
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref(batches).canonical()
+
+
+def test_valueless_add_clears_stale_dict_value():
+    """A winning None-valued element (set-style row on a dict key) must
+    CLEAR a stored value through the deferred path, exactly like the CPU
+    engine's local-loses rule."""
+    a = Node(node_id=1)
+    _cmd(a, b"hset", b"h", b"f", b"old")
+    base = batch_from_keyspace(a.ks)
+
+    newer = Node(node_id=2)
+    _cmd(newer, b"hset", b"h", b"f", b"mid")
+    nb = batch_from_keyspace(newer.ks)
+    # strip the value but keep a LATER add (valueless winning add)
+    nb.el_val = [None] * len(nb.el_val)
+    nb.el_add_t = base.el_add_t + (1 << 30)
+
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    eng.merge(st, base)
+    eng.merge(st, nb)
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref([base, nb]).canonical()
+
+
+def test_pure_set_traffic_has_no_src_plane():
+    """Set-only groups never materialize the el src plane (no value bytes
+    to resolve → no extra download at flush)."""
+    batches = []
+    for r in range(3):
+        n = Node(node_id=r + 1)
+        for i in range(50):
+            _cmd(n, b"sadd", b"s%d" % (i % 9), b"m%d-%d" % (r, i))
+        batches.append(batch_from_keyspace(n.ks))
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    eng.merge_many(st, batches)
+    res = eng._res.get("el")
+    assert res is not None and res.get("src") is None
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref(batches).canonical()
+
+
+def test_mixed_streaming_groups_match_cpu():
+    """Streaming grouped catch-up from several replicas (the bench shape,
+    interleaved chunk arrival) stays exact across group boundaries."""
+    srcs = [gen_store(seed=50 + i, node=i + 1) for i in range(3)]
+    per = [list(batch_chunks(batch_from_keyspace(s), 17)) for s in srcs]
+    interleaved = [p[i] for i in range(max(map(len, per)))
+                   for p in per if i < len(p)]
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    for i in range(0, len(interleaved), 3):
+        eng.merge_many(st, interleaved[i:i + 3])
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref(interleaved).canonical()

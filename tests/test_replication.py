@@ -414,3 +414,78 @@ def test_gc_after_acks(tmp_path):
                 await cli.close()
             await close_cluster(apps)
     run(main())
+
+
+def test_full_sync_grouped_merge(tmp_path):
+    """Multi-chunk snapshot apply batches chunks into merge_many groups
+    (the fold-capable production cadence — link.py apply_group)."""
+    async def main():
+        from constdb_tpu.engine.tpu import TpuMergeEngine
+        apps = await make_cluster(2, str(tmp_path), snapshot_chunk_keys=64,
+                                  engine=TpuMergeEngine())
+        try:
+            c1 = await Client().connect(apps[0].advertised_addr)
+            for i in range(600):
+                if i % 2:
+                    await c1.cmd("incr", f"g{i}")
+                else:
+                    await c1.cmd("sadd", f"g{i}", "x", "y")
+            # force FULL sync: pretend the history below the current uuid
+            # fell off the ring (a joiner resuming at 0 must get a snapshot)
+            n1 = apps[0].node
+            n1.repl_log.evicted_up_to = n1.repl_log.last_uuid
+            await c1.cmd("meet", apps[1].advertised_addr)
+            await converge(apps, timeout=30.0)
+            x = apps[1].node.stats.extra
+            # the joiner applied >1 chunk per engine call at least once
+            assert x.get("group_merges", 0) >= 1, x
+            assert x.get("group_merge_batches", 0) > x.get("group_merges", 0), x
+            await c1.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
+
+
+def test_cpu_catchup_keeps_loop_live(tmp_path):
+    """Client RTT on the JOINING node stays bounded while it merges a large
+    full sync with the per-row CPU engine (the adaptive split in
+    link.py apply_group; reference pull.rs:66,92 yields between batches)."""
+    async def main():
+        import numpy as np
+        from bench import make_workload
+        apps = await make_cluster(2, str(tmp_path),
+                                  snapshot_chunk_keys=1 << 16,
+                                  sync_merge_budget=0.05)
+        try:
+            # populate n1's keyspace in bulk (fast vectorized ingest), then
+            # let n2 catch up through its (slow, per-row) CPU engine
+            from constdb_tpu.engine.tpu import TpuMergeEngine
+            n1 = apps[0].node
+            batch = make_workload(40_000, 1, seed=11)[0]
+            TpuMergeEngine().merge(n1.ks, batch)
+            n1.ks.version += 1
+            top = int(batch.key_mt.max())
+            n1.hlc.observe(top)
+            # bulk-ingested state is not in the repl_log: joiners must get
+            # a snapshot, never a silently-empty PARTSYNC (io.py start_node
+            # applies the same rule after a boot restore)
+            n1.repl_log.last_uuid = top
+            n1.repl_log.evicted_up_to = top
+
+            c2 = await Client().connect(apps[1].advertised_addr)
+            await c2.cmd("meet", apps[0].advertised_addr)
+            loop = asyncio.get_running_loop()
+            worst = 0.0
+            deadline = loop.time() + 60.0
+            while apps[1].node.ks.n_keys() < 40_000:
+                t0 = loop.time()
+                await c2.cmd("ping")
+                worst = max(worst, loop.time() - t0)
+                if loop.time() > deadline:
+                    raise AssertionError("catch-up did not finish in 60s")
+                await asyncio.sleep(0.01)
+            assert worst < 1.0, f"loop wedged {worst:.2f}s during catch-up"
+            await c2.close()
+        finally:
+            await close_cluster(apps)
+    run(main())
