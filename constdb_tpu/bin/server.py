@@ -28,9 +28,36 @@ def setup_logging(cfg: Config) -> None:
     level = getattr(logging, cfg.log_level.upper(), logging.INFO)
     fmt = "%(asctime)s %(levelname)s %(filename)s:%(lineno)d - %(message)s"
     if cfg.log and cfg.log != "console":
-        logging.basicConfig(level=level, format=fmt, filename=cfg.log)
+        # size-capped rolling file (reference src/lib.rs:109-136 rolls its
+        # log by size too)
+        from logging.handlers import RotatingFileHandler
+        handler = RotatingFileHandler(cfg.log, maxBytes=cfg.log_max_bytes,
+                                      backupCount=cfg.log_backups)
+        handler.setFormatter(logging.Formatter(fmt))
+        logging.basicConfig(level=level, handlers=[handler])
     else:
         logging.basicConfig(level=level, format=fmt)
+
+
+def daemonize(cfg: Config) -> str:
+    """Detach (double fork + setsid), point stdio at /dev/null, and write
+    the pid file (reference src/lib.rs:89-108).  Returns the pid path."""
+    import os
+
+    if os.fork() > 0:
+        os._exit(0)
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    devnull = os.open(os.devnull, os.O_RDWR)
+    for fd in (0, 1, 2):
+        os.dup2(devnull, fd)
+    os.close(devnull)
+    pid_path = cfg.pid_file or os.path.join(cfg.work_dir, "constdb.pid")
+    os.makedirs(cfg.work_dir, exist_ok=True)
+    with open(pid_path, "w") as f:
+        f.write(str(os.getpid()))
+    return pid_path
 
 
 async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
@@ -81,7 +108,9 @@ async def amain(cfg: Config) -> None:
         heartbeat=float(cfg.replica_heartbeat_frequency),
         reconnect_delay=float(cfg.replica_gossip_frequency) / 3.0,
         snapshot_chunk_keys=cfg.snapshot_chunk_keys,
-        snapshot_path=cfg.snapshot_path)
+        snapshot_path=cfg.snapshot_path,
+        tcp_backlog=cfg.tcp_backlog,
+        gc_peer_retention=float(cfg.gc_peer_retention))
     log.info("constdb-tpu node %d (engine=%s) serving on %s",
              node.node_id, node.engine.name, app.advertised_addr)
 
@@ -109,12 +138,28 @@ async def amain(cfg: Config) -> None:
 
 
 def main(argv=None) -> None:
+    import os
+
     cfg = load_config(argv)
+    pid_path = ""
+    if cfg.daemon:
+        if not cfg.log or cfg.log == "console":
+            # stdio points at /dev/null after detaching — console logging
+            # would be silently discarded, so force a file
+            cfg.log = os.path.join(cfg.work_dir, "constdb.log")
+        pid_path = daemonize(cfg)
     setup_logging(cfg)
     try:
         asyncio.run(amain(cfg))
     except KeyboardInterrupt:
         pass
+    finally:
+        if pid_path:
+            import os
+            try:
+                os.unlink(pid_path)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

@@ -22,15 +22,23 @@ from dataclasses import dataclass
 @dataclass
 class Config:
     # reference fields (src/conf.rs:63-88)
-    daemon: bool = False          # accepted; daemonization itself is left to
-    node_id: int = 0              # the process supervisor (systemd/k8s)
+    daemon: bool = False          # detach (double-fork), write a pid file,
+    #                               and log to a rolling file (bin/server.py;
+    #                               reference src/lib.rs:89-136)
+    node_id: int = 0
     node_alias: str = ""
     ip: str = "127.0.0.1"
     port: int = 9001
-    threads: int = 1              # IO concurrency is asyncio; kept for parity
+    threads: int = 1              # parsed for config-file compatibility with
+    #                               the reference's N-IO-thread design
+    #                               (src/lib.rs:138-142); this build's IO is
+    #                               one asyncio loop (the loop IS the single
+    #                               exec thread, so there is no parse-thread
+    #                               pool to size) — values > 1 are ignored
     log: str = "console"          # "console" | path to a log file
     work_dir: str = "./"
-    tcp_backlog: int = 1024
+    tcp_backlog: int = 1024       # wired to the listen backlog (server/io.py;
+    #                               reference src/server.rs:96-101)
     replica_heartbeat_frequency: int = 4   # seconds (wired, unlike reference)
     replica_gossip_frequency: int = 15     # seconds between reconnect dials
     # new (TPU build)
@@ -41,6 +49,12 @@ class Config:
     snapshot_chunk_keys: int = 1 << 16
     repl_log_cap: int = 1_024_000  # reference src/server.rs:81
     log_level: str = "info"
+    pid_file: str = ""            # default: <work_dir>/constdb.pid (daemon)
+    log_max_bytes: int = 64 << 20  # rolling-log size cap per file
+    log_backups: int = 4           # rolled files kept
+    # a peer silent for longer than this stops pinning the GC tombstone
+    # horizon; on return it is forced through a full resync (replica/)
+    gc_peer_retention: int = 3600  # seconds
 
 
 def load_config(argv: list[str] | None = None) -> Config:

@@ -177,6 +177,7 @@ class ReplicaLink:
         self._install(reader, writer, parser, peer_resume)
 
     def _install(self, reader, writer, parser, peer_resume: int) -> None:
+        self.meta.last_seen_ms = now_ms()
         old_task, old_writer = self._serve_task, self._writer
         self._writer = writer
         self._serve_task = asyncio.create_task(
@@ -222,11 +223,16 @@ class ReplicaLink:
                         meta.uuid_i_sent):
                     resume = peer_resume if not synced else meta.uuid_i_sent
                     if node.repl_log.can_resume_from(resume):
+                        # partial replay is always the lossless choice when
+                        # the log covers the resume point: delete OPS are
+                        # still in the ring even after their tombstones
+                        # were physically collected (manager.min_uuid)
                         self._write(writer, encode_msg(Arr([Bulk(PARTSYNC)])))
                         meta.uuid_i_sent = resume
                     else:
                         await self._send_snapshot(writer)
                     synced = True
+                    meta.needs_full = False
 
                 sent = 0
                 while (e := node.repl_log.next_after(meta.uuid_i_sent)) is not None:
@@ -286,6 +292,7 @@ class ReplicaLink:
         watermark checks; load snapshots through the MergeEngine."""
         while True:
             msg = await _read_msg(reader, parser, count=self._count_in)
+            self.meta.last_seen_ms = now_ms()
             items = msg.items if isinstance(msg, Arr) else None
             if not items:
                 raise CstError(f"unexpected frame from {self.meta.addr}: {msg!r}")

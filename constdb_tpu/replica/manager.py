@@ -48,6 +48,17 @@ class ReplicaMeta:
     # inbound connection (someone re-MET us) clears it.  Kept out of the
     # add_t/del_t LWW so it never corrupts replicated membership.
     dial_suspended: bool = field(default=False, compare=False)
+    # runtime liveness (not replicated): wall-ms of the last frame received
+    # from this peer; 0 = never.  Drives the GC-horizon retention rule.
+    last_seen_ms: int = field(default=0, compare=False)
+    # observability flag (not replicated): this peer was excluded from the
+    # GC horizon at least once; if it returns after its unseen tombstones
+    # were both collected AND evicted from the repl_log, those deletions
+    # can resurrect — the standard bounded-tombstone-retention tradeoff
+    # (size `gc_peer_retention` >= the repl_log coverage window, and FORGET
+    # permanently-dead peers).  While the log still covers its resume
+    # point, partial replay redelivers the delete OPS losslessly.
+    needs_full: bool = field(default=False, compare=False)
 
     @property
     def alive(self) -> bool:
@@ -64,6 +75,10 @@ class ReplicaManager:
         # hook: called with (addr, meta) when a NEW live peer appears through
         # a merge (transitive mesh join — reference pull.rs:136-153)
         self.on_new_peer: Optional[Callable[[ReplicaMeta], None]] = None
+        # a peer silent beyond this stops pinning min_uuid (0 = never —
+        # the reference's behavior, where one dead peer pins GC forever,
+        # replica/replica.rs:87-89).  ServerApp wires the config value.
+        self.gc_peer_retention_ms: int = 3_600_000
 
     # ------------------------------------------------------------ membership
 
@@ -75,7 +90,13 @@ class ReplicaManager:
         """MEET: (re-)register a peer at time `uuid` (add-side LWW)."""
         m = self.peers.get(addr)
         if m is None:
-            m = ReplicaMeta(addr, node_id=node_id, alias=alias, add_t=uuid)
+            from ..utils.hlc import now_ms
+            # the retention clock starts at registration: a peer we never
+            # hear from gets exactly one retention window before it stops
+            # pinning the GC horizon (a 0 stamp would exempt restored-dead
+            # peers forever)
+            m = ReplicaMeta(addr, node_id=node_id, alias=alias, add_t=uuid,
+                            last_seen_ms=now_ms())
             self.peers[addr] = m
         else:
             if uuid > m.add_t:
@@ -114,7 +135,8 @@ class ReplicaManager:
                 continue
             m = self.peers.get(addr := r.addr)
             if m is None:
-                m = ReplicaMeta(addr)
+                from ..utils.hlc import now_ms
+                m = ReplicaMeta(addr, last_seen_ms=now_ms())
                 self.peers[addr] = m
                 is_new = True
             else:
@@ -142,11 +164,30 @@ class ReplicaManager:
 
     def min_uuid(self) -> Optional[int]:
         """GC tombstone horizon (see module docstring); None when no live
-        peers (standalone nodes collect up to their own clock)."""
+        peers (standalone nodes collect up to their own clock).
+
+        Retention rule: a live peer SILENT for longer than
+        `gc_peer_retention_ms` stops pinning the horizon — otherwise one
+        crashed peer freezes tombstone collection mesh-wide forever.  The
+        tradeoff is bounded: a returning excluded peer is lossless while
+        the repl_log still covers its resume point (delete OPS replay even
+        after their tombstones were physically collected); only past BOTH
+        windows can its stale keys resurrect (see ReplicaMeta.needs_full)."""
+        from ..utils.hlc import now_ms
         live = self.live_peers()
         if not live:
             return None
-        return min(min(m.uuid_i_acked, m.uuid_he_sent) for m in live)
+        retention = self.gc_peer_retention_ms
+        now = now_ms()
+        pinning = []
+        for m in live:
+            if retention and now - m.last_seen_ms > retention:
+                m.needs_full = True
+                continue
+            pinning.append(m)
+        if not pinning:
+            return None
+        return min(min(m.uuid_i_acked, m.uuid_he_sent) for m in pinning)
 
     # ------------------------------------------------------------- REPLICAS
 

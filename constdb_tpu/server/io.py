@@ -42,7 +42,9 @@ class ServerApp:
                  snapshot_path: str = "",
                  sync_merge_group: int = 8,
                  sync_merge_budget: float = 0.1,
-                 sync_initial_split: int = 4096):
+                 sync_initial_split: int = 4096,
+                 tcp_backlog: int = 1024,
+                 gc_peer_retention: float = 3600.0):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -64,6 +66,10 @@ class ServerApp:
         self.sync_merge_group = sync_merge_group
         self.sync_merge_budget = sync_merge_budget
         self.sync_initial_split = sync_initial_split
+        self.tcp_backlog = tcp_backlog
+        # peers silent beyond this stop pinning the GC horizon
+        self.gc_peer_retention = gc_peer_retention
+        node.replicas.gc_peer_retention_ms = int(gc_peer_retention * 1000)
         self._server: Optional[asyncio.base_events.Server] = None
         self._cron_task: Optional[asyncio.Task] = None
         self._conn_tasks: set[asyncio.Task] = set()
@@ -87,7 +93,8 @@ class ServerApp:
             log.info("auto-assigned node_id %d", self.node.node_id)
         self.node.stats.start_time = time.time()
         self._server = await asyncio.start_server(
-            self._on_connection, self.host, self.port)
+            self._on_connection, self.host, self.port,
+            backlog=self.tcp_backlog)
         self.port = self._server.sockets[0].getsockname()[1]
         self._cron_task = asyncio.create_task(self._cron())
         # reconnect links for membership restored from a snapshot
@@ -122,15 +129,28 @@ class ServerApp:
     # ----------------------------------------------------------------- cron
 
     async def _cron(self) -> None:
-        """(reference server.rs:134-146: 100ms tick — advance uuid, gc)"""
+        """(reference server.rs:134-146: 100ms tick — advance uuid, gc).
+
+        The tick sleep doubles as an event wait: a key-level delete
+        (EVENT_DELETED — new garbage) or an advanced ack watermark
+        (EVENT_REPLICA_ACKED — the horizon moved) triggers a GC sweep at
+        the next tick instead of waiting out the full gc_interval."""
+        from .events import EVENT_DELETED, EVENT_REPLICA_ACKED
+        consumer = self.node.events.new_consumer(
+            EVENT_DELETED | EVENT_REPLICA_ACKED)
         last_gc = 0.0
-        while True:
-            await asyncio.sleep(0.1)
-            self.node.hlc.tick(False)
-            now = asyncio.get_running_loop().time()
-            if now - last_gc >= self.gc_interval:
-                self.node.gc()
-                last_gc = now
+        try:
+            while True:
+                woke = await consumer.wait(timeout=0.1)
+                self.node.hlc.tick(False)
+                now = asyncio.get_running_loop().time()
+                due = now - last_gc >= self.gc_interval
+                early = woke and now - last_gc >= self.gc_interval / 4
+                if due or early:
+                    self.node.gc()
+                    last_gc = now
+        finally:
+            consumer.close()
 
     # ---------------------------------------------------------------- links
 

@@ -53,6 +53,8 @@ class Node:
         self.addr = addr
         self.hlc = HLC() if clock is None else HLC(clock)
         self.ks = KeySpace()
+        from .events import EVENT_DELETED
+        self.ks.on_key_delete = lambda: self.events.trigger(EVENT_DELETED)
         self.repl_log = ReplLog(repl_log_cap)
         self.events = EventBus()
         self.engine = engine if engine is not None else CpuMergeEngine()

@@ -101,6 +101,9 @@ class KeySpace:
         # key-level tombstone record for snapshot DELETES + GC
         # (parity: reference db.rs `deletes` map)
         self.key_deletes: dict[bytes, int] = {}
+        # optional hook fired when a key-level tombstone is recorded (the
+        # Node routes it to EVENT_DELETED so the GC cron can sweep early)
+        self.on_key_delete = None
         # min-heap of (uuid, seq, key, member-or-None): merge and replicated
         # ops enqueue out-of-order timestamps, so a plain FIFO (the
         # reference's LinkedList, db.rs) would stall collection behind one
@@ -188,6 +191,8 @@ class KeySpace:
         if self.key_deletes.get(key, -1) < t:
             self.key_deletes[key] = t
             self._enqueue_garbage(t, key, None)
+            if self.on_key_delete is not None:
+                self.on_key_delete()
 
     # -------------------------------------------------------------- counters
 

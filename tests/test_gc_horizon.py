@@ -1,0 +1,84 @@
+"""GC horizon retention: a long-silent peer stops pinning tombstone
+collection and is forced through a full resync on return (VERDICT round-3
+item 10; contrast reference replica/replica.rs:87-89, where one dead peer
+pins GC forever)."""
+
+import asyncio
+
+from constdb_tpu.replica.manager import ReplicaManager, ReplicaMeta
+from constdb_tpu.utils.hlc import now_ms
+
+
+def _mgr(retention_ms=1000):
+    m = ReplicaManager()
+    m.gc_peer_retention_ms = retention_ms
+    return m
+
+
+def test_silent_peer_stops_pinning():
+    mgr = _mgr(retention_ms=1000)
+    fresh = mgr.add("a:1", uuid=10)
+    fresh.uuid_i_acked = fresh.uuid_he_sent = 500
+    fresh.last_seen_ms = now_ms()
+    stale = mgr.add("b:2", uuid=10)
+    stale.uuid_i_acked = stale.uuid_he_sent = 7   # would pin the horizon
+    stale.last_seen_ms = now_ms() - 60_000        # silent for a minute
+    assert mgr.min_uuid() == 500
+    assert stale.needs_full is True
+    assert fresh.needs_full is False
+
+
+def test_all_peers_silent_unpins_entirely():
+    mgr = _mgr(retention_ms=1000)
+    stale = mgr.add("a:1", uuid=10)
+    stale.uuid_i_acked = stale.uuid_he_sent = 7
+    stale.last_seen_ms = now_ms() - 60_000
+    assert mgr.min_uuid() is None  # collect to own clock, like no peers
+
+
+def test_retention_zero_keeps_reference_behavior():
+    mgr = _mgr(retention_ms=0)
+    stale = mgr.add("a:1", uuid=10)
+    stale.uuid_i_acked = stale.uuid_he_sent = 7
+    stale.last_seen_ms = now_ms() - 60_000
+    assert mgr.min_uuid() == 7  # 0 = never exclude (pin forever)
+
+
+def test_fresh_meet_pins_for_one_retention_window():
+    """A just-registered peer (fresh MEET, dial still in progress) pins
+    for exactly one retention window: the clock starts at registration,
+    so a restored-dead peer cannot pin the horizon forever."""
+    mgr = _mgr(retention_ms=1000)
+    m = mgr.add("a:1", uuid=10)
+    m.uuid_i_acked = m.uuid_he_sent = 3
+    assert m.last_seen_ms > 0          # stamped at registration
+    assert mgr.min_uuid() == 3         # pins within the window
+    m.last_seen_ms -= 60_000           # window long gone, still silent
+    assert mgr.min_uuid() is None      # stops pinning
+    assert m.needs_full is True
+
+
+def test_restored_membership_gets_retention_clock():
+    """Membership restored from a snapshot REPLICAS section starts its
+    retention clock at restore time (runtime last_seen is not persisted)."""
+    from constdb_tpu.persist.snapshot import ReplicaRecord
+    mgr = _mgr(retention_ms=1000)
+    mgr.merge_records([ReplicaRecord("dead:1", 9, "d", add_t=5)])
+    m = mgr.get("dead:1")
+    assert m is not None and m.last_seen_ms > 0
+
+
+def test_delete_event_fires_and_wakes_cron_consumer():
+    from constdb_tpu.resp.message import Bulk
+    from constdb_tpu.server.events import EVENT_DELETED
+    from constdb_tpu.server.node import Node
+
+    async def main():
+        node = Node(node_id=1)
+        consumer = node.events.new_consumer(EVENT_DELETED)
+        node.execute([Bulk(b"set"), Bulk(b"k"), Bulk(b"v")])
+        assert await consumer.wait(timeout=0.05) is False  # no delete yet
+        node.execute([Bulk(b"del"), Bulk(b"k")])
+        assert await consumer.wait(timeout=1.0) is True
+        consumer.close()
+    asyncio.run(main())
