@@ -13,7 +13,7 @@ import asyncio
 import shlex
 import sys
 
-from ..resp.codec import RespParser, encode_msg
+from ..resp.codec import make_parser, encode_msg
 from ..resp.message import Arr, Bulk, Err, Int, Msg, Nil, Simple
 
 try:
@@ -46,7 +46,7 @@ def render(m: Msg, indent: int = 0) -> str:
 
 async def repl(host: str, port: int) -> None:
     reader, writer = await asyncio.open_connection(host, port)
-    parser = RespParser()
+    parser = make_parser()
     prompt = f"{host}:{port}> "
     loop = asyncio.get_running_loop()
     while True:

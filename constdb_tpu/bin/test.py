@@ -23,7 +23,7 @@ import random
 import sys
 import time
 
-from ..resp.codec import RespParser, encode_msg
+from ..resp.codec import make_parser, encode_msg
 from ..resp.message import Arr, Bulk, Err, Int, Msg, Nil
 
 
@@ -31,7 +31,7 @@ class Conn:
     def __init__(self) -> None:
         self.reader = None
         self.writer = None
-        self.parser = RespParser()
+        self.parser = make_parser()
 
     async def connect(self, addr: str) -> "Conn":
         host, port = addr.rsplit(":", 1)

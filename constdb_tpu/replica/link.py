@@ -37,7 +37,7 @@ from typing import Optional, TYPE_CHECKING
 
 from ..errors import CstError, ReplicateCommandsLost
 from ..persist.snapshot import SnapshotLoader, batch_chunks
-from ..resp.codec import RespParser, encode_msg
+from ..resp.codec import RespParser, encode_msg, make_parser
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
 from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
 from ..utils.hlc import now_ms
@@ -140,7 +140,7 @@ class ReplicaLink:
                 Bulk(self.app.advertised_addr.encode()),
                 Int(self.meta.uuid_he_sent)])))
             await writer.drain()
-            parser = RespParser()
+            parser = make_parser()
             msg = await _read_msg(reader, parser,
                                   timeout=self.app.handshake_timeout,
                                   count=self._count_in)

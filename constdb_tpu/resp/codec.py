@@ -78,6 +78,14 @@ class RespParser:
     def buffered(self) -> int:
         return len(self._buf) - self._pos
 
+    def _compact(self) -> None:
+        """Drop consumed bytes once they pass the threshold (single home
+        for the policy — next_msg fast/general paths, take_raw, and the
+        native subclass all share it)."""
+        if self._pos >= _COMPACT_THRESHOLD:
+            del self._buf[: self._pos]
+            self._pos = 0
+
     def take_raw(self, n: int) -> bytes:
         """Up to n RAW bytes from the internal buffer.  Snapshot transfer
         interleaves length-delimited raw byte runs with RESP frames on one
@@ -87,9 +95,7 @@ class RespParser:
         end = min(self._pos + n, len(self._buf))
         data = bytes(self._buf[self._pos:end])
         self._pos = end
-        if self._pos >= _COMPACT_THRESHOLD:
-            del self._buf[: self._pos]
-            self._pos = 0
+        self._compact()
         return data
 
     def next_msg(self) -> Optional[Msg]:
@@ -156,9 +162,7 @@ class RespParser:
                         break  # nested/unusual item: general path
                 else:
                     self._pos = p
-                    if p >= _COMPACT_THRESHOLD:
-                        del buf[:p]
-                        self._pos = 0
+                    self._compact()
                     return Arr(items)
                 # partial or non-flat frame: fall through to _parse below
         start = pos
@@ -167,9 +171,7 @@ class RespParser:
         except _NeedMore:
             self._pos = start
             return None
-        if self._pos >= _COMPACT_THRESHOLD:
-            del self._buf[: self._pos]
-            self._pos = 0
+        self._compact()
         return m
 
     # --- internals ---
@@ -231,3 +233,69 @@ class RespParser:
                 raise InvalidRequestMsg("array too large")
             return Arr([self._parse(depth + 1) for _ in range(n)])
         raise InvalidRequestMsg(f"unexpected type byte {bytes([t])!r}")
+
+
+class NativeRespParser(RespParser):
+    """RespParser with the flat-command fast path in C.
+
+    `native/resp.cpp resp_parse` scans the shared buffer and returns
+    fully-constructed Arr/Bulk/Int messages (built at C speed via
+    tp_alloc + slot set); anything it cannot fast-parse — nested arrays,
+    replies, `$-1`/`*0` — is handed, one message at a time, to the
+    inherited pure-Python parser, so the output is bit-identical either
+    way.  The op path is parse-bound (OPBENCH.md); this is our answer to
+    the reference's N-parse-threads design (reference src/lib.rs:138-142)
+    under the single-writer loop.
+    """
+
+    __slots__ = ("_q", "_qpos")
+
+    def __init__(self, max_depth: int = 32):
+        super().__init__(max_depth)
+        self._q: list = []
+        self._qpos = 0
+
+    def next_msg(self) -> Optional[Msg]:
+        q = self._q
+        if self._qpos < len(q):
+            m = q[self._qpos]
+            self._qpos += 1
+            if self._qpos >= len(q):
+                q.clear()
+                self._qpos = 0
+            return m
+        ext = _ext()
+        if ext is None:
+            return super().next_msg()
+        try:
+            msgs, new_pos, fallback = ext.resp_parse(
+                self._buf, self._pos, Arr, Bulk, Int, Simple, Err, NIL)
+        except ValueError as e:
+            raise InvalidRequestMsg(str(e)) from None
+        self._pos = new_pos
+        self._compact()
+        if msgs:
+            self._q = msgs
+            self._qpos = 1
+            return msgs[0]
+        if fallback:
+            return super().next_msg()
+        return None
+
+
+_EXT_CACHE: list = []
+
+
+def _ext():
+    if not _EXT_CACHE:
+        from ..utils.native_tables import load_ext
+        mod = load_ext()
+        _EXT_CACHE.append(mod if mod is not None and
+                          hasattr(mod, "resp_parse") else None)
+    return _EXT_CACHE[0]
+
+
+def make_parser() -> RespParser:
+    """The fastest available parser: native fast path when the extension
+    is built, pure Python otherwise (identical message objects)."""
+    return NativeRespParser() if _ext() is not None else RespParser()

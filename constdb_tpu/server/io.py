@@ -21,7 +21,7 @@ from typing import Optional
 from ..errors import CstError
 from ..replica.link import ReplicaLink, SYNC
 from ..replica.manager import ReplicaManager, ReplicaMeta
-from ..resp.codec import RespParser, encode_into
+from ..resp.codec import RespParser, encode_into, make_parser
 from ..resp.message import Arr, Bulk, Err, Int, NoReply, as_bytes, as_int
 from .node import Node
 
@@ -175,7 +175,7 @@ class ServerApp:
         self._conn_tasks.add(task)
         self.node.stats.connections_accepted += 1
         self.node.stats.current_clients += 1
-        parser = RespParser()
+        parser = make_parser()
         out = bytearray()
         upgraded = False
         try:

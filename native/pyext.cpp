@@ -14,6 +14,7 @@
 #include <Python.h>
 
 #include "tables.cpp"  // self-contained: StrTable / I64Table definitions
+#include "resp.cpp"    // RESP flat-array fast parser (py_resp_parse)
 
 namespace {
 
@@ -283,6 +284,9 @@ PyMethodDef methods[] = {
     {"i64_lookup_batch", py_i64_lookup_batch, METH_VARARGS, ""},
     {"i64_put_batch", py_i64_put_batch, METH_VARARGS, ""},
     {"i64_get_or_assign_batch", py_i64_get_or_assign_batch, METH_VARARGS, ""},
+    {"resp_parse", py_resp_parse, METH_VARARGS,
+     "resp_parse(buf, pos, Arr, Bulk, Int, Simple, Err, nil[, max]) -> "
+     "(msgs, new_pos, fallback)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -1,0 +1,321 @@
+// Native RESP fast path: parse flat command arrays at C speed.
+//
+// The op path is parse-bound (OPBENCH.md): every pipelined client command
+// is a flat `*N` array of `$` bulks / `:` ints, and the pure-Python
+// scanner costs ~10us per message.  The reference answers the same
+// pressure with N parse THREADS feeding one exec thread (reference
+// README.md:12, src/lib.rs:138-142); this build keeps the single-writer
+// asyncio loop and moves the parse itself into C instead.
+//
+// resp_parse(buffer, pos, Arr, Bulk, Int, Simple, Err, nil[, max_msgs])
+// scans from `pos` and returns (messages, new_pos, fallback):
+//   * messages — list of fully-constructed message objects (instances
+//     built via tp_alloc + slot set, skipping __init__ bytecode).
+//     Top-level coverage: flat `*N` command arrays of bulks/ints, plus
+//     the reply types `+simple`, `-err`, `:int`, `$bulk`, `$-1` (nil) —
+//     i.e. both directions of the protocol;
+//   * new_pos  — first unconsumed byte (a partial trailing message is
+//     left unconsumed);
+//   * fallback — true when the next message needs the general parser:
+//     nested array, `*0`/`*-1`, unknown type byte, or ANY shape this fast
+//     path cannot parse cleanly (overlong integers, malformed framing,
+//     oversized bulks...).  The pure-Python parser is the semantics
+//     reference — it either accepts what C was too strict for (e.g. a
+//     bare CR inside a simple line, a >64-bit integer) or raises its own
+//     InvalidRequestMsg — so deferring to it on every non-clean parse
+//     keeps behavior bit-identical, error text included.  The C side
+//     itself raises only on CPython allocation failures.
+//
+// Messages parsed BEFORE a bad frame in the same scan are still returned
+// (the caller executes them, then the pure parser surfaces the error) —
+// the same delivery order the pure parser produces one call at a time.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+
+namespace resp {
+
+constexpr Py_ssize_t kMaxLine = 1 << 20;
+constexpr Py_ssize_t kMaxArr = 1 << 20;
+constexpr long long kMaxBulk = 512LL << 20;
+constexpr Py_ssize_t kMaxDigits = 18;  // always < LLONG_MAX: no overflow UB
+
+struct Names {
+    PyObject* val = nullptr;
+    PyObject* items = nullptr;
+};
+
+inline Names& names() {
+    static Names n;
+    if (!n.val) {
+        n.val = PyUnicode_InternFromString("val");
+        n.items = PyUnicode_InternFromString("items");
+    }
+    return n;
+}
+
+// Object construction without __init__: alloc the (slotted, dict-less)
+// instance and set its single slot.  Steals `value`.
+inline PyObject* make1(PyObject* type, PyObject* name, PyObject* value) {
+    if (!value) return nullptr;
+    PyTypeObject* t = reinterpret_cast<PyTypeObject*>(type);
+    PyObject* obj = t->tp_alloc(t, 0);
+    if (!obj) {
+        Py_DECREF(value);
+        return nullptr;
+    }
+    if (PyObject_SetAttr(obj, name, value) != 0) {
+        Py_DECREF(value);
+        Py_DECREF(obj);
+        return nullptr;
+    }
+    Py_DECREF(value);
+    return obj;
+}
+
+// Scan an integer line "<digits>\r\n" (optionally signed) starting at p.
+// Returns: 1 ok, 0 need-more, -1 not fast-parseable (caller falls back to
+// the pure parser; no python error is set).
+inline int int_line(const char* b, Py_ssize_t len, Py_ssize_t p,
+                    long long* out, Py_ssize_t* next) {
+    const char* cr = static_cast<const char*>(
+        memchr(b + p, '\r', static_cast<size_t>(len - p)));
+    if (!cr || cr - b + 1 >= len) {
+        if (len - p > kMaxLine) return -1;  // pure parser raises
+        return 0;
+    }
+    Py_ssize_t e = cr - b;
+    if (b[e + 1] != '\n') return -1;  // bare CR: defer to pure parser
+    bool neg = false;
+    Py_ssize_t i = p;
+    if (i < e && (b[i] == '-' || b[i] == '+')) {
+        neg = b[i] == '-';
+        i++;
+    }
+    // > kMaxDigits would overflow long long (UB) — and the pure parser
+    // handles arbitrary-precision integers correctly, so defer
+    if (i >= e || e - i > kMaxDigits) return -1;
+    long long v = 0;
+    for (; i < e; i++) {
+        if (b[i] < '0' || b[i] > '9') return -1;
+        v = v * 10 + (b[i] - '0');
+    }
+    *out = neg ? -v : v;
+    *next = e + 2;
+    return 1;
+}
+
+}  // namespace resp
+
+static PyObject* py_resp_parse(PyObject*, PyObject* args) {
+    Py_buffer view;
+    Py_ssize_t pos;
+    PyObject *arr_t, *bulk_t, *int_t, *simple_t, *err_t, *nil_obj;
+    Py_ssize_t max_msgs = 1024;
+    if (!PyArg_ParseTuple(args, "y*nOOOOOO|n", &view, &pos, &arr_t, &bulk_t,
+                          &int_t, &simple_t, &err_t, &nil_obj, &max_msgs))
+        return nullptr;
+    const char* b = static_cast<const char*>(view.buf);
+    const Py_ssize_t len = view.len;
+    resp::Names& nm = resp::names();
+
+    PyObject* out = PyList_New(0);
+    int fallback = 0;
+    if (!out) {
+        PyBuffer_Release(&view);
+        return nullptr;
+    }
+
+    while (PyList_GET_SIZE(out) < max_msgs && pos < len) {
+        char top = b[pos];
+        if (top == '+' || top == '-') {
+            // simple / error line reply.  The pure parser's _line scans
+            // for the CRLF PAIR, so a bare CR inside the line is part of
+            // the payload there — defer rather than diverge.
+            const char* cr = static_cast<const char*>(memchr(
+                b + pos, '\r', static_cast<size_t>(len - pos)));
+            if (!cr || cr - b + 1 >= len) {
+                if (len - pos > resp::kMaxLine) {
+                    fallback = 1;  // pure parser raises "line too long"
+                    break;
+                }
+                break;  // need more
+            }
+            Py_ssize_t e = cr - b;
+            if (b[e + 1] != '\n') {
+                fallback = 1;
+                break;
+            }
+            PyObject* obj = resp::make1(
+                top == '+' ? simple_t : err_t, nm.val,
+                PyBytes_FromStringAndSize(b + pos + 1, e - pos - 1));
+            if (!obj) goto fail;
+            int rc = PyList_Append(out, obj);
+            Py_DECREF(obj);
+            if (rc != 0) goto fail;
+            pos = e + 2;
+            continue;
+        }
+        if (top == ':') {
+            long long v;
+            Py_ssize_t q;
+            int st = resp::int_line(b, len, pos + 1, &v, &q);
+            if (st < 0) {
+                fallback = 1;
+                break;
+            }
+            if (st == 0) break;
+            PyObject* obj = resp::make1(int_t, nm.val,
+                                        PyLong_FromLongLong(v));
+            if (!obj) goto fail;
+            int rc = PyList_Append(out, obj);
+            Py_DECREF(obj);
+            if (rc != 0) goto fail;
+            pos = q;
+            continue;
+        }
+        if (top == '$') {
+            long long ln;
+            Py_ssize_t q;
+            int st = resp::int_line(b, len, pos + 1, &ln, &q);
+            if (st < 0) {
+                fallback = 1;
+                break;
+            }
+            if (st == 0) break;
+            PyObject* obj;
+            if (ln < 0) {
+                if (ln != -1) {
+                    fallback = 1;  // pure parser raises
+                    break;
+                }
+                Py_INCREF(nil_obj);
+                obj = nil_obj;
+            } else {
+                if (ln > resp::kMaxBulk) {
+                    fallback = 1;  // pure parser raises "too large"
+                    break;
+                }
+                if (q + ln + 2 > len) break;  // need more
+                if (b[q + ln] != '\r' || b[q + ln + 1] != '\n') {
+                    fallback = 1;  // pure parser raises "missing CRLF"
+                    break;
+                }
+                obj = resp::make1(bulk_t, nm.val,
+                                  PyBytes_FromStringAndSize(b + q, ln));
+                if (!obj) goto fail;
+                q += ln + 2;
+            }
+            int rc = PyList_Append(out, obj);
+            Py_DECREF(obj);
+            if (rc != 0) goto fail;
+            pos = q;
+            continue;
+        }
+        if (top != '*') {
+            fallback = 1;
+            break;
+        }
+        long long cnt;
+        Py_ssize_t p;
+        int st = resp::int_line(b, len, pos + 1, &cnt, &p);
+        if (st <= 0) {
+            if (st < 0) fallback = 1;
+            break;  // need more bytes, or defer to the pure parser
+        }
+        if (cnt <= 0 || cnt > resp::kMaxArr) {
+            fallback = 1;  // *0 / *-1 / oversized: general parser
+            break;
+        }
+        {
+            PyObject* items = PyList_New(cnt);
+            if (!items) goto fail;
+            bool partial = false, fb = false;
+            for (long long i = 0; i < cnt; i++) {
+                if (p >= len) {
+                    partial = true;
+                    break;
+                }
+                char c = b[p];
+                if (c == '$') {
+                    long long ln;
+                    Py_ssize_t q;
+                    st = resp::int_line(b, len, p + 1, &ln, &q);
+                    if (st < 0) {
+                        fb = true;
+                        break;
+                    }
+                    if (st == 0) {
+                        partial = true;
+                        break;
+                    }
+                    if (ln < 0 || ln > resp::kMaxBulk) {
+                        fb = true;  // $-1 / oversized: general path
+                        break;
+                    }
+                    if (q + ln + 2 > len) {
+                        partial = true;
+                        break;
+                    }
+                    if (b[q + ln] != '\r' || b[q + ln + 1] != '\n') {
+                        fb = true;  // pure parser raises "missing CRLF"
+                        break;
+                    }
+                    PyObject* obj = resp::make1(
+                        bulk_t, nm.val,
+                        PyBytes_FromStringAndSize(b + q, ln));
+                    if (!obj) {
+                        Py_DECREF(items);
+                        goto fail;
+                    }
+                    PyList_SET_ITEM(items, i, obj);
+                    p = q + ln + 2;
+                } else if (c == ':') {
+                    long long v;
+                    Py_ssize_t q;
+                    st = resp::int_line(b, len, p + 1, &v, &q);
+                    if (st < 0) {
+                        fb = true;
+                        break;
+                    }
+                    if (st == 0) {
+                        partial = true;
+                        break;
+                    }
+                    PyObject* obj = resp::make1(int_t, nm.val,
+                                                PyLong_FromLongLong(v));
+                    if (!obj) {
+                        Py_DECREF(items);
+                        goto fail;
+                    }
+                    PyList_SET_ITEM(items, i, obj);
+                    p = q;
+                } else {
+                    fb = true;  // nested array / inline type: general path
+                    break;
+                }
+            }
+            if (partial || fb) {
+                Py_DECREF(items);  // safe: unfilled tail slots are NULL
+                if (fb) fallback = 1;
+                break;
+            }
+            PyObject* arr = resp::make1(arr_t, nm.items, items);
+            if (!arr) goto fail;
+            int rc = PyList_Append(out, arr);
+            Py_DECREF(arr);
+            if (rc != 0) goto fail;
+            pos = p;
+        }
+    }
+
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(Nni)", out, pos, fallback);
+
+fail:
+    Py_DECREF(out);
+    PyBuffer_Release(&view);
+    return nullptr;
+}
