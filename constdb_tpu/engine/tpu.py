@@ -18,10 +18,11 @@ replica link applies a snapshot chunk-by-chunk, and each chunk is one
 `merge()` — pays row uploads only, never a state round-trip per chunk.
 Merged state flushes back to the host keyspace lazily (`flush()`), which
 the Node triggers before any command touches the numeric plane
-(`Node.ensure_flushed`); `KeySpace.version` bumps on op-path writes so the
-engine knows its mirror went stale.  Win-flags (which batch row's VALUE
-replaces a slot's bytes) still download per call — value bytes live only
-on the host.
+(`Node.ensure_flushed`); op-path writes bump the touched plane's
+`KeySpace.fam_ver` entry, so the engine rebuilds ONLY that plane's mirror
+(mixed op/merge traffic keeps the other mirrors resident).  Win VALUES
+(dict fields / register bytes) resolve through a device src plane at
+flush — no per-call win-flag download; value bytes live only on the host.
 
 Batches whose rows are NOT unique per slot (raw op streams) always take the
 scatter path — its reductions tolerate intra-batch collisions; the bulk
@@ -154,6 +155,10 @@ class TpuMergeEngine:
         self._devices = jax.devices()
         self.dense_fold = dense_fold
         self.folds = 0          # aligned folds performed (observability)
+        # stale-mirror rebuilds per family (observability: mixed op/merge
+        # traffic must keep these O(writes-to-that-plane), never O(ops))
+        from ..store.keyspace import FAMILIES
+        self.mirror_rebuilds = dict.fromkeys(FAMILIES, 0)
         # cumulative host-side seconds per family (DISPATCH time — device
         # work is async; the flush entry includes the blocking downloads)
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
@@ -165,7 +170,6 @@ class TpuMergeEngine:
         # device-resident `src` planes index into; resolved once at flush
         self._val_pool: list[tuple[int, list]] = []
         self._pool_size = 0
-        self._seen_version = -1
         self.needs_flush = False
         self._mesh = mesh
         if mesh is not None:
@@ -288,12 +292,9 @@ class TpuMergeEngine:
         # the bulk path scatters each slot once per batch, which is only a
         # merge if slots are unique within every batch
         self._unique_ok = all(b.rows_unique_per_slot for b in batches)
-        if self.resident and store.version != self._seen_version:
-            # host moved underneath us; resident mirrors are stale.  The
-            # Node flushes before op writes, so nothing unflushed is lost.
-            assert not self.needs_flush, "op write before flush"
-            self._res.clear()
-            self._seen_version = store.version
+        # resident-mirror staleness is checked PER FAMILY in
+        # _resident_state (KeySpace.fam_ver): an op write to one CRDT
+        # plane no longer drops every other plane's device mirror
         self._n0_keys = store.keys.n
         # replica snapshots of one keyspace often share the key-list object;
         # resolve each distinct list once (ids are stable within this merge)
@@ -391,7 +392,6 @@ class TpuMergeEngine:
         if "cnt" in self._res and self._res["cnt"]["n"]:
             store.recompute_counter_sums()
         self.needs_flush = False
-        self._seen_version = store.version
         self.family_secs["flush"] += _time.perf_counter() - t0
 
     def _resolve_src(self, store: KeySpace, fam: str,
@@ -423,8 +423,26 @@ class TpuMergeEngine:
 
     def _resident_state(self, store: KeySpace, fam: str, n: int):
         """Device state dict for family `fam` covering rows [0, n); grows
-        (neutral-filled) as the host table grows.  Returns (cols, cap)."""
+        (neutral-filled) as the host table grows.  Returns (cols, cap).
+
+        Staleness: the mirror records the host plane's write version at
+        build time; an op-path write or GC to THIS plane (KeySpace.touch)
+        forces a rebuild from host — other planes' mirrors survive."""
         res = self._res.get(fam)
+        ver = store.fam_ver[fam]
+        if res is not None and res.get("ver") != ver:
+            # rebuild from host.  A stale mirror never holds unflushed
+            # device data: the Node flushes before every op-path write, so
+            # whatever bumped this plane's version found the mirror already
+            # synced.  (needs_flush may be True here from EARLIER families
+            # of this same merge round — their mirrors are not stale.)
+            # Dropping a stale mirror that still holds unflushed merged
+            # columns would silently lose merge results — that is a broken
+            # flush-before-touch invariant somewhere upstream; fail loud.
+            assert not res.get("written"), \
+                f"{fam} mirror invalidated with unflushed merge data"
+            self.mirror_rebuilds[fam] += 1
+            res = None
         cap = self._sp_size(n)
         spec = _FAMILIES[fam]
         if res is None:
@@ -447,7 +465,7 @@ class TpuMergeEngine:
         else:
             cols = res["cols"]
             cap = res["cap"]
-        self._res[fam] = {"cols": cols, "n": n, "cap": cap,
+        self._res[fam] = {"cols": cols, "n": n, "cap": cap, "ver": ver,
                           "src": res.get("src") if res else None,
                           "written": res.get("written", set()) if res
                           else set()}
@@ -463,6 +481,7 @@ class TpuMergeEngine:
         w = prev.get("written", set())
         w |= set(cols) if written is None else written
         self._res[fam] = {"cols": cols, "n": n, "cap": cap, "written": w,
+                          "ver": prev.get("ver"),
                           "src": src if src is not None else prev.get("src")}
         self.needs_flush = True
 

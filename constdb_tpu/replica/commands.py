@@ -25,7 +25,7 @@ def _app(node):
     return getattr(node, "app", None)
 
 
-@register("meet", CMD_WRITE)
+@register("meet", CMD_WRITE, families=())
 def meet_command(node, ctx, args):
     """(reference replica.rs:49-75)"""
     addr = args.next_str()
@@ -40,7 +40,7 @@ def meet_command(node, ctx, args):
     return OK
 
 
-@register("forget", CMD_WRITE)
+@register("forget", CMD_WRITE, families=())
 def forget_command(node, ctx, args):
     """(reference replica.rs:77-86, unregistered there)"""
     addr = args.next_str()

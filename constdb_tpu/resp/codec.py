@@ -19,6 +19,9 @@ from .message import Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, Nil, NoReply, Simp
 
 _CRLF = b"\r\n"
 _COMPACT_THRESHOLD = 1 << 16
+# interned small-int reply lines (parity: reference src/resp.rs:12-27
+# pre-encodes the common counter replies)
+_INT_REPLY = [b":%d\r\n" % i for i in range(1024)]
 
 
 def encode_into(out: bytearray, m: Msg) -> None:
@@ -35,7 +38,8 @@ def encode_into(out: bytearray, m: Msg) -> None:
         out += m.val
         out += _CRLF
     elif isinstance(m, Int):
-        out += b":%d\r\n" % m.val
+        v = m.val
+        out += _INT_REPLY[v] if 0 <= v < 1024 else b":%d\r\n" % v
     elif isinstance(m, Bulk):
         out += b"$%d\r\n" % len(m.val)
         out += m.val

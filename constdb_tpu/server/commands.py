@@ -55,13 +55,21 @@ CMD_REPL_ONLY = 32
 CMD_CLIENT_ONLY = 64
 
 
-class Command:
-    __slots__ = ("name", "handler", "flags")
+from ..store.keyspace import FAMILIES as ALL_FAMILIES  # noqa: E402
 
-    def __init__(self, name: bytes, handler: Callable, flags: int):
+
+class Command:
+    __slots__ = ("name", "handler", "flags", "families")
+
+    def __init__(self, name: bytes, handler: Callable, flags: int,
+                 families: tuple = ALL_FAMILIES):
         self.name = name
         self.handler = handler
         self.flags = flags
+        # CRDT planes a write can touch — scopes the keyspace version bump
+        # so a resident merge engine only drops the device mirrors this
+        # command could actually have invalidated (engine/tpu.py)
+        self.families = families
 
     @property
     def is_write(self) -> bool:
@@ -71,9 +79,9 @@ class Command:
 COMMANDS: dict[bytes, Command] = {}
 
 
-def register(name: str, flags: int):
+def register(name: str, flags: int, families: tuple = ALL_FAMILIES):
     def deco(fn):
-        cmd = Command(name.encode(), fn, flags)
+        cmd = Command(name.encode(), fn, flags, families)
         COMMANDS[cmd.name] = cmd
         return fn
     return deco
@@ -167,7 +175,7 @@ def execute(node: "Node", req, client=None) -> Msg:
     except CstError as e:
         return Err(e.resp_error())
     if cmd.is_write:
-        node.ks.version += 1
+        node.ks.touch(*cmd.families)
         if not (cmd.flags & CMD_NO_REPLICATE):
             node.replicate_cmd(uuid, name, items[1:])
     return reply
@@ -190,7 +198,7 @@ def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
     ctx = ExecCtx(uuid, origin_nodeid, True, None)
     reply = cmd.handler(node, ctx, ArgIter(args, name))
     if cmd.is_write:
-        node.ks.version += 1
+        node.ks.touch(*cmd.families)
     return reply
 
 
@@ -219,7 +227,7 @@ def _invalid_type():
     return InvalidType()
 
 
-@register("set", CMD_WRITE)
+@register("set", CMD_WRITE, families=("env", "reg"))
 def set_command(node, ctx, args):
     key = args.next_bytes()
     val = args.next_bytes()
@@ -239,7 +247,7 @@ def desc_command(node, ctx, args):
     return Arr([Bulk(f"{k}: {v}") for k, v in d.items()])
 
 
-@register("del", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY)
+@register("del", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY, families=("env", "cnt", "el"))
 def del_command(node, ctx, args):
     """Rewrites itself into type-specific REPL_ONLY tombstone commands
     (reference src/cmd.rs:220-296)."""
@@ -290,7 +298,7 @@ _DEL_COLLECTION_CMD = {S.ENC_SET: b"delset", S.ENC_DICT: b"deldict",
                        S.ENC_MV: b"delmv", S.ENC_LIST: b"dellist"}
 
 
-@register("delbytes", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("delbytes", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env",))
 def delbytes_command(node, ctx, args):
     key = args.next_bytes()
     ks = node.ks
@@ -360,17 +368,17 @@ def _counter_step(node, ctx, args, delta: int) -> Msg:
     return Int(v)
 
 
-@register("incr", CMD_WRITE | CMD_NO_REPLICATE)
+@register("incr", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "cnt"))
 def incr_command(node, ctx, args):
     return _counter_step(node, ctx, args, 1)
 
 
-@register("decr", CMD_WRITE | CMD_NO_REPLICATE)
+@register("decr", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "cnt"))
 def decr_command(node, ctx, args):
     return _counter_step(node, ctx, args, -1)
 
 
-@register("cntset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("cntset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "cnt"))
 def cntset_command(node, ctx, args):
     """Replicated counter write: assign the originator's lifetime total."""
     key = args.next_bytes()
@@ -381,7 +389,7 @@ def cntset_command(node, ctx, args):
     return NO_REPLY
 
 
-@register("delcnt", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("delcnt", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "cnt"))
 def delcnt_command(node, ctx, args):
     """Counter delete: tombstone the key and assign each listed slot's
     delete-observed base (visible value becomes total - base)."""
@@ -406,7 +414,7 @@ def delcnt_command(node, ctx, args):
 # set commands (reference src/type_set.rs)
 # ====================================================================
 
-@register("sadd", CMD_WRITE)
+@register("sadd", CMD_WRITE, families=("env", "el"))
 def sadd_command(node, ctx, args):
     key = args.next_bytes()
     members = args.rest_bytes()
@@ -426,7 +434,7 @@ def sadd_command(node, ctx, args):
     return Int(cnt)
 
 
-@register("srem", CMD_WRITE)
+@register("srem", CMD_WRITE, families=("env", "el"))
 def srem_command(node, ctx, args):
     key = args.next_bytes()
     members = args.rest_bytes()
@@ -451,7 +459,7 @@ def smembers_command(node, ctx, args):
     return Arr([Bulk(m) for m, _v, _t in ks.elem_live(kid)])
 
 
-@register("spop", CMD_WRITE | CMD_NO_REPLICATE)
+@register("spop", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def spop_command(node, ctx, args):
     key = args.next_bytes()
     ks = node.ks
@@ -486,7 +494,7 @@ def _del_collection(node, ctx, args, enc: int) -> Msg:
     return NO_REPLY
 
 
-@register("delset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("delset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def delset_command(node, ctx, args):
     return _del_collection(node, ctx, args, S.ENC_SET)
 
@@ -495,7 +503,7 @@ def delset_command(node, ctx, args):
 # hash commands (reference src/type_hash.rs)
 # ====================================================================
 
-@register("hset", CMD_WRITE)
+@register("hset", CMD_WRITE, families=("env", "el"))
 def hset_command(node, ctx, args):
     key = args.next_bytes()
     kvs = []
@@ -544,7 +552,7 @@ def hgetall_command(node, ctx, args):
                 for f, v, _t in ks.elem_live(kid)])
 
 
-@register("hdel", CMD_WRITE)
+@register("hdel", CMD_WRITE, families=("env", "el"))
 def hdel_command(node, ctx, args):
     key = args.next_bytes()
     fields = args.rest_bytes()
@@ -557,7 +565,7 @@ def hdel_command(node, ctx, args):
     return Int(cnt)
 
 
-@register("deldict", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("deldict", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def deldict_command(node, ctx, args):
     return _del_collection(node, ctx, args, S.ENC_DICT)
 
@@ -591,7 +599,7 @@ def _mv_apply(ks, kid, clock_bytes, wc, val, uuid, nodeid) -> None:
     ks.updated_at(kid, uuid)
 
 
-@register("mvset", CMD_WRITE | CMD_NO_REPLICATE)
+@register("mvset", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def mvset_command(node, ctx, args):
     """MVSET key value [context-token].  The token (from MVGET) is the
     causal context the writer observed; writing with it supersedes exactly
@@ -616,7 +624,7 @@ def mvset_command(node, ctx, args):
     return Bulk(wb)
 
 
-@register("mvwrite", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("mvwrite", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def mvwrite_command(node, ctx, args):
     from ..crdt.multivalue import clock_from_bytes
 
@@ -650,7 +658,7 @@ def mvget_command(node, ctx, args):
                 Bulk(clock_to_bytes(token))])
 
 
-@register("delmv", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("delmv", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def delmv_command(node, ctx, args):
     return _del_collection(node, ctx, args, S.ENC_MV)
 
@@ -707,7 +715,7 @@ def _list_insert(node, ctx, key, index: int, values: list) -> int:
     return len(_list_live(ks, kid))
 
 
-@register("linsert", CMD_WRITE | CMD_NO_REPLICATE)
+@register("linsert", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def linsert_command(node, ctx, args):
     key = args.next_bytes()
     index = args.next_int()
@@ -717,7 +725,7 @@ def linsert_command(node, ctx, args):
     return Int(_list_insert(node, ctx, key, index, values))
 
 
-@register("lpush", CMD_WRITE | CMD_NO_REPLICATE)
+@register("lpush", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def lpush_command(node, ctx, args):
     key = args.next_bytes()
     values = args.rest_bytes()
@@ -729,7 +737,7 @@ def lpush_command(node, ctx, args):
     return Int(_list_insert(node, ctx, key, 0, list(reversed(values))))
 
 
-@register("rpush", CMD_WRITE | CMD_NO_REPLICATE)
+@register("rpush", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def rpush_command(node, ctx, args):
     key = args.next_bytes()
     values = args.rest_bytes()
@@ -738,7 +746,7 @@ def rpush_command(node, ctx, args):
     return Int(_list_insert(node, ctx, key, 1 << 40, values))
 
 
-@register("lins", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("lins", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def lins_command(node, ctx, args):
     """Positional replicated insert: `lins key pos1 val1 [pos2 val2 ...]`."""
     key = args.next_bytes()
@@ -755,7 +763,7 @@ def lins_command(node, ctx, args):
     return NO_REPLY
 
 
-@register("lrem", CMD_WRITE | CMD_NO_REPLICATE)
+@register("lrem", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
 def lrem_command(node, ctx, args):
     """LREM key index — delete the element at live index; replicates as the
     positional `lremat` so every replica removes the SAME element."""
@@ -775,7 +783,7 @@ def lrem_command(node, ctx, args):
     return Int(1)
 
 
-@register("lremat", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("lremat", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def lremat_command(node, ctx, args):
     key = args.next_bytes()
     pos = args.next_bytes()
@@ -818,7 +826,7 @@ def llen_command(node, ctx, args):
     return Int(len(_list_live(node.ks, kid)))
 
 
-@register("dellist", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY)
+@register("dellist", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "el"))
 def dellist_command(node, ctx, args):
     return _del_collection(node, ctx, args, S.ENC_LIST)
 
@@ -828,7 +836,7 @@ def dellist_command(node, ctx, args):
 # command — SURVEY.md §"Known reference defects"; db.rs:53-71)
 # ====================================================================
 
-@register("expire", CMD_WRITE | CMD_NO_REPLICATE)
+@register("expire", CMD_WRITE | CMD_NO_REPLICATE, families=("env",))
 def expire_command(node, ctx, args):
     key = args.next_bytes()
     secs = args.next_uint()
@@ -843,7 +851,7 @@ def expire_command(node, ctx, args):
     return Int(1)
 
 
-@register("expireat", CMD_WRITE)
+@register("expireat", CMD_WRITE, families=("env",))
 def expireat_command(node, ctx, args):
     key = args.next_bytes()
     exp_uuid = args.next_uint()

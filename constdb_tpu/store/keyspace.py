@@ -42,6 +42,10 @@ from .columns import Columns
 
 _I64 = np.int64
 
+# the CRDT planes a resident merge engine mirrors — the ONE definition the
+# command table, the version setter, and the engine all derive from
+FAMILIES = ("env", "reg", "cnt", "el")
+
 
 class _KeyCols(Columns):
     def __init__(self) -> None:
@@ -76,9 +80,11 @@ class KeySpace:
         self.key_bytes: list[bytes] = []
         self.key_index = StrTable(8096)
         self.reg_val: list[Optional[bytes]] = []
-        # bumped by op-path writes; lets a device-resident merge engine know
-        # its mirror of the numeric plane has gone stale (engine/tpu.py)
-        self.version = 0
+        # per-CRDT-plane write versions, bumped by op-path writes: a
+        # device-resident merge engine drops ONLY the mirrors of planes
+        # that actually changed (engine/tpu.py; a global version made
+        # mixed traffic re-upload every table per frame)
+        self.fam_ver: dict[str, int] = dict.fromkeys(FAMILIES, 0)
 
         self.cnt = _CntCols()
         self.cnt_index = I64Dict(4096)
@@ -110,6 +116,24 @@ class KeySpace:
         # future entry; seq breaks comparison ties before the None member
         self.garbage: list[tuple[int, int, bytes, Optional[bytes]]] = []
         self._garbage_seq = 0
+
+    # ------------------------------------------------------------- versions
+
+    def touch(self, *families: str) -> None:
+        """Mark CRDT planes as host-modified (op path / GC)."""
+        fv = self.fam_ver
+        for f in families:
+            fv[f] += 1
+
+    @property
+    def version(self) -> int:
+        """Aggregate write version (monotonic; back-compat surface)."""
+        return sum(self.fam_ver.values())
+
+    @version.setter
+    def version(self, _value) -> None:
+        """`ks.version += 1` keeps meaning "everything may have changed"."""
+        self.touch(*FAMILIES)
 
     # ------------------------------------------------------------------ keys
 
@@ -155,6 +179,10 @@ class KeySpace:
             if exp > int(self.keys.mt[kid]):
                 self.keys.mt[kid] = exp
             self.record_key_delete(key, exp)
+            # this is a READ-path host write: without the bump a resident
+            # env mirror would flush its older dt back and resurrect the
+            # expired key
+            self.touch("env")
         return kid
 
     def alive(self, kid: int) -> bool:
@@ -485,7 +513,7 @@ class KeySpace:
             # which REORDERS rows) must invalidate them or later flushes
             # write stale columns over the collected table.  key_deletes-only
             # rounds touch no mirrored column and skip the bump.
-            self.version += 1
+            self.touch("el")
         if self.el_dead > 10_000 and self.el_dead * 2 > self.el.n:
             self._compact_elements()
         return freed
@@ -494,7 +522,7 @@ class KeySpace:
         """Rebuild element storage without dead rows (replaces free-list
         reuse: row ids must stay stable BETWEEN compactions so the batched
         engine's staged row indices never alias)."""
-        self.version += 1  # row ids change: resident device mirrors are stale
+        self.touch("el")  # row ids change: resident device mirrors are stale
         n = self.el.n
         live = np.nonzero(self.el.kid[:n] >= 0)[0]
         new_el = _ElCols()
