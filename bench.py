@@ -207,11 +207,12 @@ def main() -> None:
     chunks = chunk_batches(make_workload(n_keys, n_rep, seed=7), chunk)
     print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s "
           f"({len(chunks)} chunks)", file=sys.stderr)
-    # default to the grouped shape: n_replicas consecutive chunks in the
-    # interleaved arrival order are slot-ALIGNED, so each merge_many call
-    # takes the fused dense-fold path (one scatter per group) — the same
-    # cadence the replica link now uses in production (link.py apply_group)
-    group = int(os.environ.get("CONSTDB_BENCH_GROUP", str(n_rep)))
+    # default to the grouped shape: the engine's hierarchical host combine
+    # folds each aligned replica-cluster and concatenates the disjoint
+    # folds, so a group spanning several key ranges still collapses to ONE
+    # device call per family — the same cadence the replica link uses in
+    # production (link.py apply_group)
+    group = int(os.environ.get("CONSTDB_BENCH_GROUP", str(4 * n_rep)))
     fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
     eng_holder = {}
 

@@ -41,7 +41,7 @@ import numpy as np
 from ..crdt import semantics as S
 from ..ops import bulk as B
 from ..ops import segment as K
-from ..store.keyspace import KeySpace
+from ..store.keyspace import FAMILIES, KeySpace
 from .base import ColumnarBatch, MergeStats
 
 log = logging.getLogger(__name__)
@@ -157,7 +157,6 @@ class TpuMergeEngine:
         self.folds = 0          # aligned folds performed (observability)
         # stale-mirror rebuilds per family (observability: mixed op/merge
         # traffic must keep these O(writes-to-that-plane), never O(ops))
-        from ..store.keyspace import FAMILIES
         self.mirror_rebuilds = dict.fromkeys(FAMILIES, 0)
         # cumulative host-side seconds per family (DISPATCH time — device
         # work is async; the flush entry includes the blocking downloads)
@@ -190,18 +189,43 @@ class TpuMergeEngine:
 
     def _combine_groups(self, staged, fold_fn, cat_fn):
         """Collapse a multi-batch staged list on host (see the host-combine
-        block comment above): aligned rows fold via `fold_fn` (counted as a
-        fold), disjoint rows concatenate via `cat_fn(staged, cat)`; an
-        overlapping-unaligned group stays as-is (sequential kernels)."""
+        block comment above), hierarchically: entries with IDENTICAL row
+        sets cluster and fold R× via `fold_fn` (a large group covering
+        several key ranges from several replicas folds per range); then,
+        if the folded survivors are pairwise disjoint, they concatenate
+        into one transfer via `cat_fn`.  Overlapping-unaligned leftovers
+        stay as-is (sequential kernels)."""
         if not self._host_combine() or len(staged) < 2:
             return staged
-        if _rows_aligned(staged):
-            self.folds += 1
-            return [fold_fn(staged)]
-        cat = _rows_disjoint_cat(staged)
+        clusters: list[list] = []
+        by_sig: dict = {}
+        for s in staged:
+            r = s[0]
+            sig = (len(r), int(r[0]) if len(r) else -1,
+                   int(r[-1]) if len(r) else -1)
+            placed = False
+            for cl in by_sig.get(sig, ()):
+                if np.array_equal(cl[0][0], r):
+                    cl.append(s)
+                    placed = True
+                    break
+            if not placed:
+                cl = [s]
+                clusters.append(cl)
+                by_sig.setdefault(sig, []).append(cl)
+        folded = []
+        for cl in clusters:
+            if len(cl) > 1:
+                self.folds += 1
+                folded.append(fold_fn(cl))
+            else:
+                folded.append(cl[0])
+        if len(folded) == 1:
+            return folded
+        cat = _rows_disjoint_cat(folded)
         if cat is not None:
-            return [cat_fn(staged, cat)]
-        return staged
+            return [cat_fn(folded, cat)]
+        return folded
 
     def _pool_add(self, vals) -> np.ndarray:
         base = self._pool_size

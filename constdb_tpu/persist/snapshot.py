@@ -278,11 +278,19 @@ def batch_chunks(batch: ColumnarBatch,
             yield c
         return
 
-    # one stable sort per plane, then each chunk is a searchsorted slice
-    cnt_order = np.argsort(batch.cnt_ki, kind="stable")
-    cnt_sorted = np.asarray(batch.cnt_ki)[cnt_order]
-    el_order = np.argsort(batch.el_ki, kind="stable")
-    el_sorted = np.asarray(batch.el_ki)[el_order]
+    # each chunk is a searchsorted slice.  When a plane's key ids are
+    # already non-decreasing (true for keyspace dumps built in kid order,
+    # and the common case generally) the slice is CONTIGUOUS: columns
+    # become zero-copy views and the bytes lists plain list slices —
+    # otherwise one stable sort per plane fixes the order first.
+    cnt_arr = np.asarray(batch.cnt_ki)
+    cnt_presorted = bool(len(cnt_arr) == 0 or (np.diff(cnt_arr) >= 0).all())
+    cnt_order = None if cnt_presorted else np.argsort(cnt_arr, kind="stable")
+    cnt_sorted = cnt_arr if cnt_presorted else cnt_arr[cnt_order]
+    el_arr = np.asarray(batch.el_ki)
+    el_presorted = bool(len(el_arr) == 0 or (np.diff(el_arr) >= 0).all())
+    el_order = None if el_presorted else np.argsort(el_arr, kind="stable")
+    el_sorted = el_arr if el_presorted else el_arr[el_order]
 
     for lo in range(0, n, chunk_keys):
         hi = min(n, lo + chunk_keys)
@@ -298,24 +306,29 @@ def batch_chunks(batch: ColumnarBatch,
         c.reg_t = batch.reg_t[lo:hi]
         c.reg_node = batch.reg_node[lo:hi]
 
-        a, z = np.searchsorted(cnt_sorted, (lo, hi))
-        rows = cnt_order[a:z]
-        c.cnt_ki = np.asarray(batch.cnt_ki)[rows] - lo
+        a, z = (int(x) for x in np.searchsorted(cnt_sorted, (lo, hi)))
+        rows = slice(a, z) if cnt_presorted else cnt_order[a:z]
+        c.cnt_ki = cnt_arr[rows] - lo
         c.cnt_node = np.asarray(batch.cnt_node)[rows]
         c.cnt_val = np.asarray(batch.cnt_val)[rows]
         c.cnt_uuid = np.asarray(batch.cnt_uuid)[rows]
         c.cnt_base = np.asarray(batch.cnt_base)[rows]
         c.cnt_base_t = np.asarray(batch.cnt_base_t)[rows]
 
-        a, z = np.searchsorted(el_sorted, (lo, hi))
-        rows = el_order[a:z]
-        c.el_ki = np.asarray(batch.el_ki)[rows] - lo
+        a, z = (int(x) for x in np.searchsorted(el_sorted, (lo, hi)))
+        if el_presorted:
+            rows = slice(a, z)
+            c.el_member = batch.el_member[a:z]
+            c.el_val = batch.el_val[a:z]
+        else:
+            rows = el_order[a:z]
+            idx = rows.tolist()
+            c.el_member = [batch.el_member[i] for i in idx]
+            c.el_val = [batch.el_val[i] for i in idx]
+        c.el_ki = el_arr[rows] - lo
         c.el_add_t = np.asarray(batch.el_add_t)[rows]
         c.el_add_node = np.asarray(batch.el_add_node)[rows]
         c.el_del_t = np.asarray(batch.el_del_t)[rows]
-        idx = rows.tolist()
-        c.el_member = [batch.el_member[i] for i in idx]
-        c.el_val = [batch.el_val[i] for i in idx]
 
         if lo == 0 and batch.del_keys:
             c.del_keys = list(batch.del_keys)

@@ -40,6 +40,7 @@ from ..errors import (CstError, InvalidRequestMsg, UnknownCmd, UnknownSubCmd,
                       WrongArity)
 from ..resp.message import (Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, OK,
                             as_bytes, as_int, as_uint)
+from ..store.keyspace import FAMILIES as ALL_FAMILIES
 from ..utils.hlc import now_ms, SEQ_BITS
 
 if TYPE_CHECKING:
@@ -53,9 +54,6 @@ CMD_NO_REPLICATE = 8
 CMD_NO_REPLY = 16
 CMD_REPL_ONLY = 32
 CMD_CLIENT_ONLY = 64
-
-
-from ..store.keyspace import FAMILIES as ALL_FAMILIES  # noqa: E402
 
 
 class Command:

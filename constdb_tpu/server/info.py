@@ -75,6 +75,10 @@ def _section_stats(node, out):
     folds = getattr(node.engine, "folds", None)
     if folds is not None:
         out.append(("merge_folds", folds))
+    rebuilds = getattr(node.engine, "mirror_rebuilds", None)
+    if rebuilds is not None:
+        for name, cnt in sorted(rebuilds.items()):
+            out.append((f"mirror_rebuilds_{name}", cnt))
     out.append(("engine", node.engine.name))
     out.append(("gc_freed", st.gc_freed))
     for k, v in sorted(st.extra.items()):

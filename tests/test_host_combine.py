@@ -135,3 +135,20 @@ def test_mixed_streaming_groups_match_cpu():
         eng.merge_many(st, interleaved[i:i + 3])
     eng.flush(st)
     assert st.canonical() == _cpu_ref(interleaved).canonical()
+
+
+def test_hierarchical_mixed_group_combines():
+    """A group spanning several key RANGES from several REPLICAS (the
+    large-group catch-up shape) folds per aligned cluster, then the folds
+    concatenate — one engine call for the whole group, still exact."""
+    import bench
+    batches = bench.make_workload(300, 4, seed=9)
+    per = [list(batch_chunks(b, 100)) for b in batches]      # 3 ranges x 4
+    mixed = [p[i] for i in range(3) for p in per]            # interleaved
+    assert len(mixed) == 12
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    eng.merge_many(st, mixed)     # ONE call with all 12 chunks
+    eng.flush(st)
+    assert eng.folds >= 3         # one fold per aligned range cluster
+    assert st.canonical() == _cpu_ref(mixed).canonical()
