@@ -1,0 +1,112 @@
+"""Chaos convergence: randomized concurrent writes with repeated node
+crashes and restarts (cold from snapshot or warm in-memory) must still
+converge to the oracle.
+
+This extends the reference's randomized-workload strategy (reference
+bin/test.rs:131-144, SURVEY.md §4) with the failure dimension §5.3 calls
+for: nodes leave mid-stream, lose their process state, boot-restore from
+their last snapshot, and rejoin through partial OR full resync depending
+on what the survivors' repl-logs still cover.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
+from constdb_tpu.resp.message import Bulk, Int
+from constdb_tpu.server.io import ServerApp, start_node
+from constdb_tpu.server.node import Node
+
+from cluster_util import Client, close_cluster, converge, make_cluster, FAST
+
+
+async def _restart_cold(app: ServerApp, work_dir: str) -> ServerApp:
+    """Crash + cold boot: dump the node's state, close, then build a FRESH
+    Node restored from the snapshot on the same port (the subprocess path
+    start_node uses — io.py boot restore)."""
+    old = app.node
+    snap = os.path.join(work_dir, f"chaos.{old.node_id}.snapshot")
+    old.ensure_flushed()
+    dump_keyspace(snap, old.ks,
+                  NodeMeta(node_id=old.node_id, alias=old.alias,
+                           repl_last_uuid=old.repl_log.last_uuid),
+                  old.replicas.records())
+    port = app.port
+    await app.close()
+    node = Node(node_id=old.node_id, alias=old.alias)
+    return await start_node(node, host="127.0.0.1", port=port,
+                            work_dir=work_dir, snapshot_path=snap, **FAST)
+
+
+async def _restart_warm(app: ServerApp, work_dir: str) -> ServerApp:
+    """Close the server but keep the Node object (process hiccup: state
+    survives, connections do not)."""
+    port = app.port
+    await app.close()
+    app2 = ServerApp(app.node, host="127.0.0.1", port=port,
+                     work_dir=work_dir, **FAST)
+    await app2.start()
+    return app2
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_chaos_restarts_converge(tmp_path, seed):
+    async def main():
+        rng = random.Random(seed)
+        apps = await make_cluster(3, str(tmp_path))
+        try:
+            c0 = await Client().connect(apps[0].advertised_addr)
+            for other in apps[1:]:
+                await c0.cmd("meet", other.advertised_addr)
+            await converge(apps)
+            await c0.close()
+
+            oracle_counts: dict[str, int] = {}
+            oracle_sets: dict[str, set] = {}
+            for round_no in range(6):
+                # a burst of writes spread over whichever nodes are up
+                clients = [await Client().connect(a.advertised_addr)
+                           for a in apps]
+                for i in range(40):
+                    c = rng.choice(clients)
+                    if rng.random() < 0.5:
+                        k = f"cnt{rng.randrange(8)}"
+                        await c.cmd("incr", k)
+                        oracle_counts[k] = oracle_counts.get(k, 0) + 1
+                    else:
+                        k = f"set{rng.randrange(8)}"
+                        m = f"m{round_no}-{i}"
+                        await c.cmd("sadd", k, m)
+                        oracle_sets.setdefault(k, set()).add(m)
+                for c in clients:
+                    await c.close()
+
+                # crash / restart one node (skip some rounds)
+                victim = rng.randrange(len(apps))
+                style = rng.random()
+                if style < 0.4:
+                    apps[victim] = await _restart_cold(apps[victim],
+                                                       str(tmp_path))
+                elif style < 0.8:
+                    apps[victim] = await _restart_warm(apps[victim],
+                                                       str(tmp_path))
+                await asyncio.sleep(0.1)
+
+            await converge(apps, timeout=45.0)
+            # converged state must equal the oracle on EVERY node
+            for app in apps:
+                c = await Client().connect(app.advertised_addr)
+                for k, want in oracle_counts.items():
+                    assert await c.cmd("get", k) == Int(want), (k, app.port)
+                for k, want in oracle_sets.items():
+                    got = await c.cmd("smembers", k)
+                    assert {b.val.decode() for b in got.items} == want, k
+                await c.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
