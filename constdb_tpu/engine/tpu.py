@@ -101,13 +101,14 @@ def _rows_disjoint_cat(staged):
     Cheap interval test first: slot rows are created in contiguous blocks
     during catch-up, so non-overlapping [min, max] ranges prove cross-part
     disjointness without the O(n log n) sort."""
-    parts = [np.asarray(s[0]) for s in staged if len(s[0])]
-    if len(parts) < 2:
+    parts = [np.asarray(s[0]) for s in staged]
+    nonempty = [p for p in parts if len(p)]
+    if len(nonempty) < 2:
         return np.concatenate(parts) if parts else np.zeros(0, _I64)
-    iv = sorted((int(p.min()), int(p.max())) for p in parts)
+    iv = sorted((int(p.min()), int(p.max())) for p in nonempty)
     if all(iv[i][1] < iv[i + 1][0] for i in range(len(iv) - 1)):
-        return np.concatenate([np.asarray(s[0]) for s in staged])
-    cat = np.concatenate([np.asarray(s[0]) for s in staged])
+        return np.concatenate(parts)
+    cat = np.concatenate(parts)
     if len(np.unique(cat)) == len(cat):
         return cat
     return None

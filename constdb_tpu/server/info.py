@@ -51,6 +51,10 @@ def _section_memory(node, out):
         out.append(("device", str(dev)))
     except (AttributeError, RuntimeError, IndexError):
         pass
+    # store-exact accounting (reference src/lib.rs:63-78 exposes the
+    # allocator gauge; the columnar numeric plane is exactly countable)
+    for name, val in node.ks.memory_report().items():
+        out.append((f"store_{name}", val))
 
 
 def _section_stats(node, out):

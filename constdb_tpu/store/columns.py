@@ -75,3 +75,9 @@ class Columns:
 
     def __len__(self) -> int:
         return self.n
+
+    def nbytes(self) -> int:
+        """Allocated bytes of every column (capacity, not just rows) —
+        allocator-true accounting for INFO (reference src/lib.rs:63-78
+        exposes jemalloc's allocated gauge; this is the store-exact part)."""
+        return sum(getattr(self, "_" + name).nbytes for name in self._spec)

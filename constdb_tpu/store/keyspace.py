@@ -586,3 +586,21 @@ class KeySpace:
         else:
             d["elems"] = sorted(self.elem_all(kid))
         return d
+
+    def memory_report(self) -> dict:
+        """Store memory accounting for INFO: exact numeric-plane bytes
+        (column capacities) plus row/byte-string counts (the blob planes
+        are Python bytes objects; counting them exactly would walk O(rows)
+        objects, so INFO reports counts and lets RSS cover the rest —
+        reference src/lib.rs:63-78 leans on jemalloc the same way)."""
+        return {
+            "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
+                              + self.el.nbytes()),
+            "keys": self.keys.n,
+            "counter_slots": self.cnt.n,
+            "element_rows": self.el.n,
+            "element_rows_dead": self.el_dead,
+            "interned_members": len(self.member_index),
+            "key_tombstones": len(self.key_deletes),
+            "garbage_queue": len(self.garbage),
+        }

@@ -18,7 +18,7 @@ import random
 import pytest
 
 from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
-from constdb_tpu.resp.message import Bulk, Int
+from constdb_tpu.resp.message import Int
 from constdb_tpu.server.io import ServerApp, start_node
 from constdb_tpu.server.node import Node
 

@@ -66,6 +66,9 @@ def test_info_sections(tmp_path):
                 assert section in info, info
             assert "connected_replicas:1" in info
             assert "counters:1" in info
+            # store-exact memory accounting (L0 gauge)
+            assert "store_numeric_bytes:" in info
+            assert "store_keys:1" in info
             only = (await c.cmd("info", "keyspace")).val.decode()
             assert "# Keyspace" in only and "# Server" not in only
         finally:
