@@ -401,7 +401,11 @@ class TpuMergeEngine:
             if n == 0:
                 continue
             table = _host_table(store, fam)
-            if fam == "el":
+            # the tombstone scan below only matters when the device could
+            # have advanced del_t — skipped (all-add catch-up) it is
+            # old_dt == del_t by construction
+            el_dt_changed = fam == "el" and ("el", "del_t") in host
+            if el_dt_changed:
                 old_dt = table.del_t[:n].copy()
             if fam == "env":
                 out = host[(fam, "stack")]
@@ -418,7 +422,7 @@ class TpuMergeEngine:
                 # downloaded state now equals the host columns: only columns
                 # dirtied AFTER this flush need the next download
                 res["written"] = set()
-            if fam == "el":
+            if el_dt_changed:
                 self._enqueue_elem_garbage(store, np.arange(n),
                                            table.add_t[:n], table.del_t[:n],
                                            old_dt)
@@ -1146,24 +1150,64 @@ class TpuMergeEngine:
                         np.isin(store.keys.enc[store.el.kid[s[0]]],
                                 S.VALUE_ENCS).any() for s in staged)
                     src = self._src_state("el", sp) if need_src else None
+                    written = {"add_t", "add_node"}
                     for rows_, a_, x_, d_, vals, _hv in staged:
-                        if src is not None:
+                        # transfer diet: node ids fit int32 (half the an
+                        # bytes; kernels promote against the int64 state),
+                        # and a mostly-zero del side ships SPARSELY as a
+                        # separate scatter-max over just the nonzero rows
+                        x_arr = np.asarray(x_)
+                        if len(x_arr) and 0 <= int(x_arr.min()) and \
+                                int(x_arr.max()) < (1 << 31):
+                            x_up = (x_arr.astype(np.int32), -1)
+                        else:
+                            x_up = (x_arr, K.NEUTRAL_T)
+                        d_arr = np.asarray(d_)
+                        nz = np.flatnonzero(d_arr)
+                        sparse_dt = len(nz) * 4 <= len(d_arr)
+                        if sparse_dt:
+                            if src is not None:
+                                ids = self._pool_add(vals)
+                                idx, da, dx, dsrc = self._upload_batch(
+                                    rows_, base, sp,
+                                    [(a_, K.NEUTRAL_T), x_up, (ids, -1)])
+                                at, an, src = B.bulk_elems_src_nodt(
+                                    at, an, src, idx, da, dx, dsrc)
+                            else:
+                                idx, da, dx = self._upload_batch(
+                                    rows_, base, sp,
+                                    [(a_, K.NEUTRAL_T), x_up])
+                                at, an, _win = B.bulk_elems_nodt(
+                                    at, an, idx, da, dx)
+                            if len(nz):
+                                rows_nz = np.asarray(rows_)[nz]
+                                np_d = K.next_pow2(len(nz))
+                                idxd = self._batch_idx(rows_nz, base, sp,
+                                                       np_d)
+                                dt = B.bulk_max1(
+                                    dt, idxd,
+                                    self._put_batch(_pad(d_arr[nz], np_d,
+                                                         0)))
+                                written.add("del_t")
+                        elif src is not None:
                             ids = self._pool_add(vals)
                             idx, da, dx, dd, dsrc = self._upload_batch(
                                 rows_, base, sp,
-                                [(a_, K.NEUTRAL_T), (x_, K.NEUTRAL_T),
-                                 (d_, 0), (ids, -1)])
+                                [(a_, K.NEUTRAL_T), x_up, (d_arr, 0),
+                                 (ids, -1)])
                             at, an, dt, src = B.bulk_elems_src(
                                 at, an, dt, src, idx, da, dx, dd, dsrc)
+                            written.add("del_t")
                         else:
                             idx, da, dx, dd = self._upload_batch(
                                 rows_, base, sp,
-                                [(a_, K.NEUTRAL_T), (x_, K.NEUTRAL_T),
-                                 (d_, 0)])
+                                [(a_, K.NEUTRAL_T), x_up, (d_arr, 0)])
                             at, an, dt, _win = B.bulk_elems(at, an, dt, idx,
                                                             da, dx, dd)
+                            written.add("del_t")
                     self._family_done("el", {"add_t": at, "add_node": an,
-                                             "del_t": dt}, n, sp, src=src)
+                                             "del_t": dt}, n, sp, src=src,
+                                      written=written)
                     return
             else:
                 sp = self._sp_size(size)

@@ -39,9 +39,17 @@ import jax.numpy as jnp  # noqa: E402
 
 from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
-__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
+__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_max1", "bulk_lww",
            "bulk_counters", "bulk_counters_vu", "bulk_elems",
-           "bulk_lww_src", "bulk_elems_src"]
+           "bulk_lww_src", "bulk_elems_src", "bulk_elems_src_nodt",
+           "bulk_elems_nodt"]
+
+# An element add-side without its (independent, sparse-shippable) del side
+# IS the plain LWW pair — same kernels, no duplicate _pair_win call sites:
+#   * bulk_elems_src_nodt(at, an, src, idx, bat, ban, bsrc)
+#   * bulk_elems_nodt(at, an, idx, bat, ban) -> (at, an, win-ignored)
+#   * bulk_max1(dt, idx, vals) — bulk_max's body is shape-agnostic
+# (aliases assigned after the definitions below)
 
 
 @partial(jax.jit, static_argnames=("n", "fill"))
@@ -56,6 +64,8 @@ def bulk_max(state, idx, cols):
     """state [Sp, C] ← elementwise max with one batch; idx [Np] int32,
     cols [Np, C].  Envelope merge (ct/mt/dt/expire are all max-merges)."""
     return state.at[idx].max(cols, mode="drop", unique_indices=True)
+
+
 
 
 def _pair_win(cv, ct, vi, ti, in_range):
@@ -170,3 +180,8 @@ def bulk_elems(at, an, dt, idx, bat, ban, bdt):
                         unique_indices=True)
     dt = dt.at[idx].max(bdt, mode="drop", unique_indices=True)
     return at, an, dt, win
+
+
+bulk_max1 = bulk_max
+bulk_elems_src_nodt = bulk_lww_src
+bulk_elems_nodt = bulk_lww

@@ -152,3 +152,34 @@ def test_hierarchical_mixed_group_combines():
     eng.flush(st)
     assert eng.folds >= 3         # one fold per aligned range cluster
     assert st.canonical() == _cpu_ref(mixed).canonical()
+
+
+def test_sparse_del_side_paths():
+    """All-add traffic never ships or re-downloads the del plane; a
+    tombstone-heavy batch takes the fused dense path — both exact."""
+    adds = []
+    for r in range(3):
+        n = Node(node_id=r + 1)
+        for i in range(60):
+            _cmd(n, b"sadd", b"k%d" % (i % 12), b"m%d-%d" % (r, i))
+        adds.append(batch_from_keyspace(n.ks))
+    eng = TpuMergeEngine(resident=True)
+    st = KeySpace()
+    eng.merge_many(st, adds)
+    assert "del_t" not in eng._res["el"]["written"]  # nothing shipped
+    eng.flush(st)
+    assert st.canonical() == _cpu_ref(adds).canonical()
+
+    # now a deletion-heavy batch (every member removed): dense del path
+    heavy = Node(node_id=9)
+    for i in range(40):
+        _cmd(heavy, b"sadd", b"d%d" % (i % 8), b"x%d" % i)
+    for i in range(40):
+        _cmd(heavy, b"srem", b"d%d" % (i % 8), b"x%d" % i)
+    hb = batch_from_keyspace(heavy.ks)
+    eng2 = TpuMergeEngine(resident=True)
+    st2 = KeySpace()
+    eng2.merge_many(st2, [hb, adds[0]])
+    assert "del_t" in eng2._res["el"]["written"]
+    eng2.flush(st2)
+    assert st2.canonical() == _cpu_ref([hb, adds[0]]).canonical()
