@@ -19,7 +19,18 @@ from ..resp.message import Arr, Bulk, Err, Int, Msg, Nil, Simple
 try:
     import readline  # noqa: F401  (history + line editing)
 except ImportError:
-    pass
+    readline = None
+
+_HISTORY_CAP = 1024  # reference bin/cli.rs:20-24 caps at 1024 entries
+
+
+def _trim_history() -> None:
+    """Bound the IN-MEMORY readline history (set_history_length only caps
+    write_history_file, which this CLI never calls)."""
+    if readline is None:
+        return
+    while readline.get_current_history_length() > _HISTORY_CAP:
+        readline.remove_history_item(0)
 
 
 def render(m: Msg, indent: int = 0) -> str:
@@ -55,6 +66,7 @@ async def repl(host: str, port: int) -> None:
         except (EOFError, KeyboardInterrupt):
             break
         line = line.strip()
+        _trim_history()
         if not line:
             continue
         if line.lower() in ("exit", "quit"):

@@ -43,7 +43,11 @@ class Config:
     replica_gossip_frequency: int = 15     # seconds between reconnect dials
     # new (TPU build)
     addr: str = ""                # advertised address, default ip:port
-    engine: str = "auto"          # "auto" | "tpu" | "cpu"
+    engine: str = "auto"          # "auto" | "tpu" | "tpu!" | "cpu"
+    #                               "tpu" falls back to XLA-on-CPU (with a
+    #                               warning + INFO engine_degraded) when no
+    #                               accelerator is healthy; "tpu!" fails
+    #                               fast at boot instead
     snapshot_path: str = ""       # load on boot + background dump target
     snapshot_interval: int = 0    # seconds between background dumps (0 = off)
     snapshot_chunk_keys: int = 1 << 16
@@ -53,8 +57,17 @@ class Config:
     log_max_bytes: int = 64 << 20  # rolling-log size cap per file
     log_backups: int = 4           # rolled files kept
     # a peer silent for longer than this stops pinning the GC tombstone
-    # horizon; on return it is forced through a full resync (replica/)
-    gc_peer_retention: int = 3600  # seconds
+    # horizon.  0 (default) = never exclude — the reference's behavior,
+    # where one dead peer pins tombstone collection mesh-wide forever
+    # (reference replica/replica.rs:87-89).  When enabled, an excluded
+    # peer whose tombstones were collected AND whose resume point fell
+    # off the repl_log is forced through a STATE-CLEARING full resync on
+    # return (link.py fullsync reset flag): its local keyspace and
+    # repl_log are wiped before the snapshot merge, so stale keys cannot
+    # resurrect mesh-wide — at the cost of discarding any writes the
+    # excluded peer made while partitioned.  While the repl_log still
+    # covers its resume point, partial replay stays lossless.
+    gc_peer_retention: int = 0  # seconds (0 = off)
 
 
 def load_config(argv: list[str] | None = None) -> Config:
@@ -69,7 +82,7 @@ def load_config(argv: list[str] | None = None) -> Config:
     ap.add_argument("--alias", dest="node_alias")
     ap.add_argument("--addr", help="advertised address (host:port)")
     ap.add_argument("--work-dir", dest="work_dir")
-    ap.add_argument("--engine", choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--engine", choices=["auto", "tpu", "tpu!", "cpu"])
     ap.add_argument("--snapshot", dest="snapshot_path")
     ap.add_argument("--snapshot-interval", type=int, dest="snapshot_interval")
     ap.add_argument("--log-level", dest="log_level")
@@ -97,8 +110,15 @@ def build_engine(kind: str):
     would wedge node boot under engine="auto".  Probe says healthy →
     init for real; probe fails → pin this process to the CPU platform
     (so nothing later in the server accidentally hangs) and fall back.
-    """
-    if kind in ("auto", "tpu"):
+
+    'tpu' falls back to the XLA-on-CPU engine when no accelerator is
+    healthy — the node keeps serving, orders of magnitude slower; the
+    degradation is surfaced in logs AND in INFO (`engine_degraded`, via
+    the engine's `degraded` attribute).  'tpu!' is the strict variant:
+    no healthy accelerator is a BOOT FAILURE (a driver outage or
+    misconfiguration should page, not limp)."""
+    strict = kind == "tpu!"
+    if kind in ("auto", "tpu", "tpu!"):
         from .utils.backend import force_cpu_platform, probe_backend
 
         probe = probe_backend()
@@ -108,26 +128,35 @@ def build_engine(kind: str):
                 return TpuMergeEngine()
             except Exception:
                 # device vanished between probe and real init
-                if kind == "tpu":
+                if kind in ("tpu", "tpu!"):
                     raise
                 force_cpu_platform()
+        elif strict:
+            raise RuntimeError(
+                "engine='tpu!' requires a healthy accelerator backend: "
+                + (probe.error or f"default backend is {probe.platform}"))
         elif kind == "tpu":
             # a node that cannot find its accelerator must still SERVE: the
             # XLA engine on the CPU backend runs the same batched kernels
             # (falling back keeps the operator's config portable; the
-            # warning makes the degradation visible in INFO/logs)
+            # warning + INFO engine_degraded make the degradation visible)
             import logging
+            reason = probe.error or f"default backend is {probe.platform}"
             logging.getLogger(__name__).warning(
                 "engine='tpu' requested but no healthy device backend (%s); "
-                "falling back to the XLA-on-CPU engine",
-                probe.error or f"default backend is {probe.platform}")
+                "falling back to the XLA-on-CPU engine", reason)
             force_cpu_platform()
             try:
                 from .engine.tpu import TpuMergeEngine
-                return TpuMergeEngine()
+                eng = TpuMergeEngine()
+                eng.degraded = f"tpu requested, running XLA-on-CPU: {reason}"
+                return eng
             except Exception:
                 pass  # no usable XLA at all: plain CPU engine below
         if not probe.ok:
             force_cpu_platform()
     from .engine.cpu import CpuMergeEngine
-    return CpuMergeEngine()
+    eng = CpuMergeEngine()
+    if kind == "tpu":
+        eng.degraded = "tpu requested, running the pure-CPU engine"
+    return eng

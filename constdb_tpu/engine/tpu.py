@@ -433,6 +433,16 @@ class TpuMergeEngine:
         self.needs_flush = False
         self.family_secs["flush"] += _time.perf_counter() - t0
 
+    def discard_resident(self) -> None:
+        """Forget ALL resident device state WITHOUT flushing — only valid
+        when the host store itself is being discarded (Node.
+        reset_for_full_resync); a fresh store's fam_ver could otherwise
+        collide with a stale mirror's recorded version."""
+        self._res.clear()
+        self._val_pool.clear()
+        self._pool_size = 0
+        self.needs_flush = False
+
     def _resolve_src(self, store: KeySpace, fam: str,
                      src_h: np.ndarray) -> None:
         """Assign deferred win VALUES: slots whose src plane points into the
@@ -477,9 +487,13 @@ class TpuMergeEngine:
             # of this same merge round — their mirrors are not stale.)
             # Dropping a stale mirror that still holds unflushed merged
             # columns would silently lose merge results — that is a broken
-            # flush-before-touch invariant somewhere upstream; fail loud.
-            assert not res.get("written"), \
-                f"{fam} mirror invalidated with unflushed merge data"
+            # flush-before-touch invariant somewhere upstream; fail loud
+            # (a real raise, not an assert: `python -O` must not strip the
+            # only guard between a dispatch-table bug and silent data loss)
+            if res.get("written"):
+                raise RuntimeError(
+                    f"{fam} mirror invalidated with unflushed merge data "
+                    "(flush-before-touch invariant broken upstream)")
             self.mirror_rebuilds[fam] += 1
             res = None
         cap = self._sp_size(n)

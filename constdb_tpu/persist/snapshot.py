@@ -129,6 +129,12 @@ def _write_bytes_list(out: bytearray, items: list) -> None:
 
 def _read_bytes_list(r: VarintReader, n: int) -> list:
     lens = np.frombuffer(r.take(4 * n), dtype="<i4")
+    # reject corruption at the section: one negative slot length would walk
+    # `pos` backwards below, silently mis-slicing every later value (only
+    # caught — maybe — by the end-of-stream checksum); the aggregate total
+    # check alone misses mixed positive/negative corruption
+    if n and bool((lens < 0).any()):
+        raise ValueError("negative bytes-column slot length")
     total = int(lens.sum()) - int(np.count_nonzero(lens)) if n else 0
     if total < 0:
         raise ValueError("negative bytes-column length")

@@ -70,6 +70,9 @@ class ReplicaLink:
         self._dial_task: Optional[asyncio.Task] = None
         self._serve_task: Optional[asyncio.Task] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        # node.reset_epoch at connection install; a mismatch marks this
+        # stream as pre-dating a local state wipe (see _pull_loop REPLACK)
+        self._epoch = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -132,6 +135,7 @@ class ReplicaLink:
 
     async def _dial_once(self) -> None:
         host, port = self.meta.addr.rsplit(":", 1)
+        epoch0 = self.node.reset_epoch  # watermark snapshot validity fence
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
             self._write(writer, encode_msg(Arr([
@@ -145,6 +149,13 @@ class ReplicaLink:
                                   timeout=self.app.handshake_timeout,
                                   count=self._count_in)
             peer_resume = self._check_sync_reply(msg)
+            if self.node.reset_epoch != epoch0:
+                # a local state wipe landed mid-handshake: the resume
+                # watermark we already sent is PRE-wipe, so the peer would
+                # stream nothing and its drained beacon would advance our
+                # zeroed watermark past ops the wipe discarded.  Abort;
+                # the dial loop retries with the post-wipe watermark.
+                raise CstError("local state wiped mid-handshake; redialing")
         except BaseException:
             writer.close()
             raise
@@ -176,8 +187,21 @@ class ReplicaLink:
         self.meta.dial_suspended = False  # the mesh re-admitted us
         self._install(reader, writer, parser, peer_resume)
 
+    def kick(self) -> None:
+        """Drop the live connection (if any) so the dial loop — ours or the
+        peer's — re-handshakes from the meta's CURRENT watermarks.  Used
+        after a local state wipe (Node.reset_for_full_resync): an existing
+        stream's positions describe state that no longer exists."""
+        t = self._serve_task
+        if t is not None and not t.done():
+            t.cancel()
+        w, self._writer = self._writer, None
+        if w is not None:
+            w.close()
+
     def _install(self, reader, writer, parser, peer_resume: int) -> None:
         self.meta.last_seen_ms = now_ms()
+        self._epoch = self.node.reset_epoch
         old_task, old_writer = self._serve_task, self._writer
         self._writer = writer
         self._serve_task = asyncio.create_task(
@@ -230,7 +254,13 @@ class ReplicaLink:
                         self._write(writer, encode_msg(Arr([Bulk(PARTSYNC)])))
                         meta.uuid_i_sent = resume
                     else:
-                        await self._send_snapshot(writer)
+                        # a peer excluded from the GC horizon (needs_full)
+                        # whose resume point also fell off the ring may hold
+                        # keys whose tombstones we already collected — a
+                        # plain snapshot merge cannot delete them, so it
+                        # must WIPE before merging (fullsync reset flag)
+                        await self._send_snapshot(writer,
+                                                  reset=meta.needs_full)
                     synced = True
                     meta.needs_full = False
 
@@ -265,7 +295,7 @@ class ReplicaLink:
         finally:
             consumer.close()
 
-    async def _send_snapshot(self, writer) -> None:
+    async def _send_snapshot(self, writer, reset: bool = False) -> None:
         """Fork-free full sync with bounded memory: acquire the node's
         SHARED on-disk dump (produced once, reused by every concurrently
         or subsequently syncing peer while the repl_log still covers its
@@ -278,7 +308,8 @@ class ReplicaLink:
         self.node.stats.extra["full_syncs_sent"] = \
             self.node.stats.extra.get("full_syncs_sent", 0) + 1
         self._write(writer, encode_msg(Arr([Bulk(FULLSYNC), Int(dump.size),
-                                            Int(dump.repl_last)])))
+                                            Int(dump.repl_last),
+                                            Int(1 if reset else 0)])))
         with open(dump.path, "rb") as f:
             while piece := f.read(_READ_CHUNK):
                 self._write(writer, piece)
@@ -306,14 +337,20 @@ class ReplicaLink:
                     self.node.events.trigger(EVENT_REPLICA_ACKED, uuid)
                 if len(items) > 3:
                     beacon = as_int(items[3])
-                    if beacon > self.meta.uuid_he_sent:
-                        # peer's stream is complete below its beacon
+                    if beacon > self.meta.uuid_he_sent and \
+                            self._epoch == self.node.reset_epoch:
+                        # peer's stream is complete below its beacon.  The
+                        # epoch check drops beacons from a stream installed
+                        # BEFORE a local state wipe: those would re-advance
+                        # the zeroed pull watermark past ops the wipe
+                        # discarded, silently skipping their re-delivery
                         self.meta.uuid_he_sent = beacon
                         self.node.hlc.observe(beacon)
             elif kind == FULLSYNC:
-                await self._receive_snapshot(reader, parser,
-                                             size=as_int(items[1]),
-                                             repl_last=as_int(items[2]))
+                await self._receive_snapshot(
+                    reader, parser, size=as_int(items[1]),
+                    repl_last=as_int(items[2]),
+                    reset=bool(as_int(items[3])) if len(items) > 3 else False)
             elif kind == PARTSYNC:
                 pass  # stream continues from our requested resume point
             else:
@@ -335,10 +372,16 @@ class ReplicaLink:
         meta.uuid_he_sent = uuid
 
     async def _receive_snapshot(self, reader, parser, size: int,
-                                repl_last: int) -> None:
+                                repl_last: int, reset: bool = False) -> None:
         """Download to a spill file, then stream chunks through the
         MergeEngine, yielding between chunks to keep the loop live
-        (reference pull.rs:35-85, at columnar scale)."""
+        (reference pull.rs:35-85, at columnar scale).
+
+        `reset`: the pusher excluded us from its GC horizon and our resume
+        point fell off its repl_log — tombstones we never saw are gone, so
+        a plain merge would let our stale keys resurrect mesh-wide.  Wipe
+        local state first (Node.reset_for_full_resync) and rejoin from the
+        snapshot like a fresh node."""
         path = os.path.join(self.app.work_dir,
                             f"snapshot.{self.meta.addr.replace(':', '_')}")
         with open(path, "wb") as f:
@@ -353,6 +396,14 @@ class ReplicaLink:
                 f.write(got)
                 remaining -= len(got)
         node = self.node
+        if reset:
+            log.warning("peer %s demands a state-clearing resync (we were "
+                        "excluded from its GC horizon past the repl_log "
+                        "window); wiping local state", self.meta.addr)
+            node.reset_for_full_resync(keep_link=self)
+            # THIS stream stays valid: the snapshot below + the gap-free
+            # frames that follow it re-establish our pull position
+            self._epoch = node.reset_epoch
         applied_rows = 0
         # Grouped apply cadence: accumulate up to `sync_merge_group` chunks
         # and merge them in ONE engine call (Node.merge_batches → engine

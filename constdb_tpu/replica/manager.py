@@ -51,13 +51,13 @@ class ReplicaMeta:
     # runtime liveness (not replicated): wall-ms of the last frame received
     # from this peer; 0 = never.  Drives the GC-horizon retention rule.
     last_seen_ms: int = field(default=0, compare=False)
-    # observability flag (not replicated): this peer was excluded from the
-    # GC horizon at least once; if it returns after its unseen tombstones
-    # were both collected AND evicted from the repl_log, those deletions
-    # can resurrect — the standard bounded-tombstone-retention tradeoff
-    # (size `gc_peer_retention` >= the repl_log coverage window, and FORGET
-    # permanently-dead peers).  While the log still covers its resume
-    # point, partial replay redelivers the delete OPS losslessly.
+    # flag (not replicated): this peer was excluded from the GC horizon
+    # at least once, so tombstones it never saw may have been physically
+    # collected.  While the repl_log still covers its resume point,
+    # partial replay redelivers the delete OPS losslessly; past that, the
+    # pusher forces a STATE-CLEARING full resync (link.py sends the
+    # fullsync reset flag, the peer wipes keyspace + repl_log before the
+    # merge) so the peer's stale keys cannot resurrect mesh-wide.
     needs_full: bool = field(default=False, compare=False)
 
     @property
@@ -76,9 +76,11 @@ class ReplicaManager:
         # a merge (transitive mesh join — reference pull.rs:136-153)
         self.on_new_peer: Optional[Callable[[ReplicaMeta], None]] = None
         # a peer silent beyond this stops pinning min_uuid (0 = never —
-        # the reference's behavior, where one dead peer pins GC forever,
-        # replica/replica.rs:87-89).  ServerApp wires the config value.
-        self.gc_peer_retention_ms: int = 3_600_000
+        # the default and the reference's behavior, where one dead peer
+        # pins GC forever, replica/replica.rs:87-89).  Opt-in via config;
+        # ServerApp wires the value.  An excluded peer is forced through
+        # a state-clearing full resync on return (link.py reset flag).
+        self.gc_peer_retention_ms: int = 0
 
     # ------------------------------------------------------------ membership
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import resource
+import sys
 import time
 
 import numpy as np
@@ -38,9 +39,33 @@ def _section_clients(node, out):
     out.append(("total_connections_received", node.stats.connections_accepted))
 
 
-def _section_memory(node, out):
+def _current_rss_bytes():
+    """CURRENT resident set size from /proc/self/status VmRSS (the
+    reference reports live allocator bytes, stats.rs:253-260 — a gauge
+    that can go DOWN; `ru_maxrss` is the high-water mark and never does).
+    Falls back to the peak on non-procfs platforms."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return _peak_rss_bytes()
+
+
+def _peak_rss_bytes():
+    # ru_maxrss is KB on Linux but BYTES on Darwin
     ru = resource.getrusage(resource.RUSAGE_SELF)
-    out.append(("used_memory_rss", ru.ru_maxrss * 1024))
+    return ru.ru_maxrss if sys.platform == "darwin" else ru.ru_maxrss * 1024
+
+
+def _section_memory(node, out):
+    rss = _current_rss_bytes()
+    out.append(("used_memory_rss", rss))
+    # ru_maxrss lags the live gauge by kernel sampling granularity; clamp
+    # so the reported peak is never below the reported current
+    out.append(("used_memory_peak", max(_peak_rss_bytes(), rss)))
     try:
         dev = node.engine._devices[0]
         ms = dev.memory_stats() or {}
@@ -84,6 +109,12 @@ def _section_stats(node, out):
         for name, cnt in sorted(rebuilds.items()):
             out.append((f"mirror_rebuilds_{name}", cnt))
     out.append(("engine", node.engine.name))
+    degraded = getattr(node.engine, "degraded", None)
+    if degraded:
+        # conf.build_engine fell back from a requested accelerator — make
+        # the orders-of-magnitude merge slowdown visible to operators, not
+        # just a boot-log line (advisor round-4 finding)
+        out.append(("engine_degraded", degraded))
     out.append(("gc_freed", st.gc_freed))
     for k, v in sorted(st.extra.items()):
         out.append((k, v))

@@ -44,7 +44,7 @@ class ServerApp:
                  sync_merge_budget: float = 0.1,
                  sync_initial_split: int = 4096,
                  tcp_backlog: int = 1024,
-                 gc_peer_retention: float = 3600.0):
+                 gc_peer_retention: float = 0.0):
         self.node = node
         node.app = self
         if node.replicas is None:

@@ -52,17 +52,28 @@ class Node:
         self.alias = alias
         self.addr = addr
         self.hlc = HLC() if clock is None else HLC(clock)
-        self.ks = KeySpace()
-        from .events import EVENT_DELETED
-        self.ks.on_key_delete = lambda: self.events.trigger(EVENT_DELETED)
+        self.ks = self._make_keyspace()
         self.repl_log = ReplLog(repl_log_cap)
         self.events = EventBus()
         self.engine = engine if engine is not None else CpuMergeEngine()
         self.stats = NodeStats()
         from ..replica.manager import ReplicaManager
         self.replicas = ReplicaManager()
+        # bumped by reset_for_full_resync; replica links stamp it at
+        # connection install and refuse stale-epoch REPLACK beacons (a
+        # beacon from a pre-wipe stream would re-advance a zeroed pull
+        # watermark past ops the wipe discarded)
+        self.reset_epoch = 0
         # the ServerApp driving this node's IO, when one exists
         self.app = None
+
+    def _make_keyspace(self) -> KeySpace:
+        """Fresh keyspace with the node's event wiring (shared by boot and
+        reset_for_full_resync so the hookup cannot diverge)."""
+        ks = KeySpace()
+        from .events import EVENT_DELETED
+        ks.on_key_delete = lambda: self.events.trigger(EVENT_DELETED)
+        return ks
 
     # ------------------------------------------------------------ execution
 
@@ -147,6 +158,51 @@ class Node:
         x = self.stats.extra
         x["group_merges"] = x.get("group_merges", 0) + 1
         x["group_merge_batches"] = x.get("group_merge_batches", 0) + len(batches)
+        self._dump_stale()
+
+    def reset_for_full_resync(self, keep_link=None) -> None:
+        """Wipe local CRDT state and rejoin as a fresh node (the receive
+        side of the fullsync `reset` flag — replica/link.py).  Used when a
+        pusher excluded us from its GC horizon past its repl_log window:
+        tombstones we never saw are physically gone mesh-wide, so keys we
+        still hold live would resurrect through any plain merge.  Clears
+        the keyspace, the repl_log (our own unsynced ops describe state
+        being discarded), and every pull watermark (what we applied from
+        other peers was part of the wiped store); membership survives so
+        the mesh re-forms around us.
+
+        Every OTHER live connection is kicked so its peer re-handshakes
+        from the zeroed watermark (resume 0 → full or from-zero partial
+        resync).  Merely zeroing is not enough: an idle surviving stream
+        re-sends nothing, and its REPLACK beacon would quietly re-advance
+        the zeroed watermark past ops the wipe discarded — the epoch bump
+        makes links drop such stale-stream beacons (replica/link.py).
+        `keep_link` (the link delivering the reset snapshot) stays up."""
+        engine = self.engine
+        if hasattr(engine, "discard_resident"):
+            engine.discard_resident()
+        cap = self.repl_log.cap
+        fence = max(self.repl_log.last_uuid, self.hlc.current)
+        self.ks = self._make_keyspace()
+        self.repl_log = ReplLog(cap)
+        # Fence the fresh (empty) log at the pre-wipe watermark: a peer
+        # resuming below it must get a FULL snapshot of the post-reset
+        # store — with last_uuid/evicted_up_to left at 0,
+        # can_resume_from(old_watermark) would be true and the push loop
+        # would serve a PARTSYNC of nothing, permanently omitting the
+        # resynced keyspace (same rule as the boot-restore path,
+        # server/io.py start_node).
+        self.repl_log.last_uuid = fence
+        self.repl_log.evicted_up_to = fence
+        self.reset_epoch += 1
+        if self.replicas is not None:
+            for m in self.replicas.peers.values():
+                m.uuid_he_sent = 0
+                m.uuid_he_acked = 0
+                link = m.link
+                if link is not None and link is not keep_link and \
+                        hasattr(link, "kick"):
+                    link.kick()
         self._dump_stale()
 
     def ensure_flushed(self) -> None:

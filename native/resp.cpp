@@ -232,7 +232,7 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
         {
             PyObject* items = PyList_New(cnt);
             if (!items) goto fail;
-            bool partial = false, fb = false;
+            bool partial = false, fb = false, is_fullsync = false;
             for (long long i = 0; i < cnt; i++) {
                 if (p >= len) {
                     partial = true;
@@ -271,6 +271,13 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
                         goto fail;
                     }
                     PyList_SET_ITEM(items, i, obj);
+                    // a FULLSYNC frame is followed by RAW (non-RESP)
+                    // snapshot bytes on the same stream; scanning past it
+                    // would consume them as frames (replica/link.py drains
+                    // them via take_raw) — stop the batch scan here
+                    if (i == 0 && ln == 8 &&
+                        strncasecmp(b + q, "fullsync", 8) == 0)
+                        is_fullsync = true;
                     p = q + ln + 2;
                 } else if (c == ':') {
                     long long v;
@@ -308,6 +315,7 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
             Py_DECREF(arr);
             if (rc != 0) goto fail;
             pos = p;
+            if (is_fullsync) break;  // raw snapshot bytes follow
         }
     }
 

@@ -195,12 +195,13 @@ def test_sync_survives_injected_snapshot_failure(tmp_path):
             original = ReplicaLink._receive_snapshot
             failures = {"n": 0}
 
-            async def flaky(self, reader, parser, size, repl_last):
+            async def flaky(self, reader, parser, size, repl_last, **kw):
                 if failures["n"] == 0:
                     failures["n"] += 1
                     # consume nothing: simulate the peer dying mid-transfer
                     raise ConnectionError("injected snapshot failure")
-                return await original(self, reader, parser, size, repl_last)
+                return await original(self, reader, parser, size, repl_last,
+                                      **kw)
 
             ReplicaLink._receive_snapshot = flaky
             try:
