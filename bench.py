@@ -127,6 +127,86 @@ def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
     return batches
 
 
+def subsample_workload(batches, n_keys: int, target: int = 100_000):
+    """Deterministic per-key filter of a workload: every `step`-th key,
+    with counter/element rows remapped.  Per-key merges are independent,
+    so a CPU replay of the FILTERED batches is an exact oracle for those
+    keys in the full device-merged store (bench verification)."""
+    step = max(1, n_keys // target)
+    keep = np.arange(0, n_keys, step)
+    sub_keys = [batches[0].keys[i] for i in keep]
+    out = []
+    for b in batches:
+        fb = ColumnarBatch()
+        fb.rows_unique_per_slot = b.rows_unique_per_slot
+        fb.keys = sub_keys
+        fb.key_enc = b.key_enc[keep]
+        fb.key_ct = b.key_ct[keep]
+        fb.key_mt = b.key_mt[keep]
+        fb.key_dt = b.key_dt[keep]
+        fb.key_expire = b.key_expire[keep]
+        fb.reg_val = [b.reg_val[i] for i in keep.tolist()]
+        fb.reg_t = b.reg_t[keep]
+        fb.reg_node = b.reg_node[keep]
+        cm = (b.cnt_ki % step) == 0
+        fb.cnt_ki = b.cnt_ki[cm] // step
+        for col in ("cnt_node", "cnt_val", "cnt_uuid", "cnt_base",
+                    "cnt_base_t"):
+            setattr(fb, col, getattr(b, col)[cm])
+        em = (b.el_ki % step) == 0
+        rows = np.nonzero(em)[0].tolist()
+        fb.el_ki = b.el_ki[em] // step
+        fb.el_member = [b.el_member[i] for i in rows]
+        fb.el_val = [b.el_val[i] for i in rows]
+        for col in ("el_add_t", "el_add_node", "el_del_t"):
+            setattr(fb, col, getattr(b, col)[em])
+        out.append(fb)
+    return out, sub_keys
+
+
+def verify_store(store, batches, n_keys: int, target: int = 100_000):
+    """Oracle check of the device-merged store: CPU-replay a deterministic
+    ~`target`-key subsample of the same workload and canonical()-compare.
+    Returns (ok, n_checked, n_diff)."""
+    sub, sub_keys = subsample_workload(batches, n_keys, target)
+    oracle = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in sub:
+        cpu.merge(oracle, b)
+    want = oracle.canonical()
+    got = store.canonical(keys=sub_keys)
+    if got == want:
+        return True, len(sub_keys), 0
+    diff = [k for k in want if got.get(k) != want[k]]
+    diff += [k for k in got if k not in want]
+    for k in diff[:5]:
+        print(f"[bench] VERIFY MISMATCH {k!r}:\n  device={got.get(k)!r}"
+              f"\n  oracle={want.get(k)!r}", file=sys.stderr)
+    return False, len(sub_keys), len(diff)
+
+
+def probe_link(jax, mb: int = 64, repeats: int = 3):
+    """Measured host<->device bandwidth (bytes/s up, down): device_put /
+    device_get of a `mb`-MB buffer, best of `repeats`.  On a
+    tunnel-attached chip this is the wall-clock ceiling for the
+    transfer-bound merge; on local PCIe/CPU backends it is ~memcpy."""
+    dev = jax.devices()[0]
+    buf = np.random.default_rng(0).integers(  # incompressible
+        0, 1 << 62, (mb << 20) // 8, dtype=np.int64)
+    jax.device_put(np.zeros(1024, dtype=np.int64), dev).block_until_ready()
+    up = down = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        x = jax.device_put(buf, dev)
+        x.block_until_ready()
+        up = max(up, buf.nbytes / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        back = np.asarray(x)
+        down = max(down, back.nbytes / (time.perf_counter() - t0))
+        del x, back
+    return up, down
+
+
 def chunk_batches(batches, chunk_keys: int):
     """Interleave replicas' snapshot chunks (the arrival order during a
     real multi-peer catch-up)."""
@@ -142,13 +222,16 @@ def chunk_batches(batches, chunk_keys: int):
 
 
 def time_engine(make_engine, chunks, repeats: int = 2,
-                group: int = 1) -> float:
+                group: int = 1):
     """Best wall-time over `repeats` streamed catch-ups into a fresh store
     (includes the final flush for resident engines).  `group` > 1 feeds
     that many consecutive chunks per engine call (merge_many) — with the
     interleaved arrival order, groups of n_replicas are slot-ALIGNED and
-    take the engine's fused dense-fold path (one scatter per group)."""
+    take the engine's fused dense-fold path (one scatter per group).
+    Returns (best_seconds, last_run_store) — the store feeds the oracle
+    verification."""
     best = float("inf")
+    store = None
     for _ in range(repeats):
         engine = make_engine()
         store = KeySpace()
@@ -162,7 +245,7 @@ def time_engine(make_engine, chunks, repeats: int = 2,
         if getattr(engine, "needs_flush", False):
             engine.flush(store)
         best = min(best, time.perf_counter() - t0)
-    return best
+    return best, store
 
 
 def main() -> None:
@@ -181,7 +264,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     cpu_chunks = chunk_batches(make_workload(n_cpu, n_rep, seed=7), chunk)
-    cpu_t = time_engine(CpuMergeEngine, cpu_chunks, repeats=1)
+    cpu_t, _ = time_engine(CpuMergeEngine, cpu_chunks, repeats=1)
     cpu_rate = n_cpu / cpu_t
     print(f"[bench] cpu engine: {cpu_t:.3f}s on {n_cpu} keys "
           f"= {cpu_rate:,.0f} keys/s (workload gen+run "
@@ -216,7 +299,8 @@ def main() -> None:
           f"devices={jax.devices()}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    chunks = chunk_batches(make_workload(n_keys, n_rep, seed=7), chunk)
+    batches = make_workload(n_keys, n_rep, seed=7)
+    chunks = chunk_batches(batches, chunk)
     print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s "
           f"({len(chunks)} chunks)", file=sys.stderr)
     # default to the grouped shape: the engine's hierarchical host combine
@@ -232,8 +316,9 @@ def main() -> None:
         eng_holder["e"] = TpuMergeEngine(resident=True, dense_fold=fold)
         return eng_holder["e"]
 
-    tpu_t = time_engine(make_eng, chunks,
-                        repeats=1 if n_keys >= 5_000_000 else 2, group=group)
+    tpu_t, dev_store = time_engine(
+        make_eng, chunks, repeats=1 if n_keys >= 5_000_000 else 2,
+        group=group)
     rate = n_keys / tpu_t
     eng = eng_holder["e"]
     print(f"[bench] device engine (resident, {jax.default_backend()}, "
@@ -257,15 +342,49 @@ def main() -> None:
         "folds": eng.folds,
         "backend": jax.default_backend(),
     }
+
+    # ------- on-hardware correctness: oracle-verify a ~100k-key subsample
+    verified = None
+    if os.environ.get("CONSTDB_BENCH_VERIFY", "1") != "0":
+        t0 = time.perf_counter()
+        verified, n_checked, n_diff = verify_store(dev_store, batches,
+                                                   n_keys)
+        print(f"[bench] verify: {'OK' if verified else 'MISMATCH'} on "
+              f"{n_checked} sampled keys ({n_diff} diffs, "
+              f"{time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+        out["verified"] = verified
+        out["verify_keys"] = n_checked
+
+    # ------- measured link ceiling: what fraction of the wall is transfer
+    bytes_h2d = getattr(eng, "bytes_h2d", 0)
+    bytes_d2h = getattr(eng, "bytes_d2h", 0)
+    up_bw, down_bw = probe_link(jax)
+    link_secs = bytes_h2d / up_bw + bytes_d2h / down_bw
+    out["bytes_h2d"] = bytes_h2d
+    out["bytes_d2h"] = bytes_d2h
+    out["link_bw_up_mbps"] = round(up_bw / 1e6, 1)
+    out["link_bw_down_mbps"] = round(down_bw / 1e6, 1)
+    out["link_secs"] = round(link_secs, 2)
+    # fraction of the wall explained by moving this run's bytes at the
+    # MEASURED link bandwidth; the reciprocal rate is the link-imposed
+    # ceiling for this byte footprint
+    out["pct_of_link_ceiling"] = round(link_secs / tpu_t, 3)
+    if link_secs > 0:
+        out["ceiling_keys_per_sec"] = round(n_keys / link_secs, 1)
+    print(f"[bench] link: up {up_bw / 1e6:,.0f} MB/s down "
+          f"{down_bw / 1e6:,.0f} MB/s; moved h2d "
+          f"{bytes_h2d / 1e6:,.0f} MB d2h {bytes_d2h / 1e6:,.0f} MB "
+          f"-> link floor {link_secs:.1f}s of {tpu_t:.1f}s wall "
+          f"({100 * link_secs / tpu_t:.0f}%)", file=sys.stderr)
+
     if jax.default_backend() == "tpu":
-        # the merge is transfer-bound; record the host<->device link so the
-        # wall time is interpretable (a tunnel-attached chip moves ~100MB/s
-        # with ~80ms/transfer latency vs multi-GB/s local PCIe)
         out["link_note"] = "tunnel-attached chip: wall time is host-link " \
             "bandwidth bound, not VPU bound"
     if note:
         out["note"] = note
     print(json.dumps(out))
+    if verified is False:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

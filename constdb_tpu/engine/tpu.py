@@ -35,6 +35,7 @@ tests/test_engine_equivalence.py and tests/test_resident_engine.py.
 from __future__ import annotations
 
 import logging
+from typing import Optional
 
 import numpy as np
 
@@ -174,12 +175,25 @@ class TpuMergeEngine:
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
                             "flush": 0.0}
         self._pallas_broken = False
+        # host<->device transfer accounting (bench.py turns these into a
+        # measured fraction of the link ceiling — the merge is
+        # transfer-bound on tunnel-attached devices)
+        self.bytes_h2d = 0
+        self.bytes_d2h = 0
         self.resident = resident
         self._res: dict[str, dict] = {}   # fam -> {cols: {name: dev arr}, n, cap}
         # deferred win-value resolution (resident mode): host value pool the
-        # device-resident `src` planes index into; resolved once at flush
-        self._val_pool: list[tuple[int, list]] = []
+        # device-resident `src` planes index into; resolved once at flush.
+        # Entries pin their batch column arrays until then, so merge_many
+        # auto-flushes once the pinned bytes pass `pool_flush_bytes` —
+        # a streamed catch-up with no interleaved reads stays O(cap), not
+        # O(total ingested bytes).
+        self._val_pool: list[tuple[int, Optional[list], dict]] = []
         self._pool_size = 0
+        self._pool_bytes = 0
+        import os as _os
+        self.pool_flush_bytes = int(_os.environ.get(
+            "CONSTDB_POOL_FLUSH_MB", "1536")) << 20
         self.needs_flush = False
         self._mesh = mesh
         if mesh is not None:
@@ -238,21 +252,44 @@ class TpuMergeEngine:
             return [cat_fn(folded, cat)]
         return folded
 
-    def _pool_add(self, vals) -> np.ndarray:
+    def _pool_add(self, vals, **cols) -> np.int32:
+        """Stage one batch's winner-carried payload in the host pool and
+        return its base pool id (the kernels derive per-row ids as
+        base + iota — ids never upload).  `vals` feeds win-value
+        resolution (None = every value is None — a winning valueless row
+        still CLEARS the slot's value, without materializing a list);
+        `cols` are the host column arrays reconstructed at flush (e.g.
+        add_t=..., add_node=...), held by reference until the next
+        flush (merge_many bounds the pinned bytes via auto-flush)."""
         base = self._pool_size
-        vals = list(vals)
-        self._val_pool.append((base, vals))
-        self._pool_size = base + len(vals)
-        return np.arange(base, base + len(vals), dtype=_I64)
+        n = -1
+        nbytes = 0
+        if vals is not None:
+            vals = list(vals)
+            n = len(vals)
+            nbytes += 8 * n
+        for a in cols.values():
+            n = len(a)
+            nbytes += int(getattr(a, "nbytes", 8 * n))
+        self._val_pool.append((base, vals, cols))
+        self._pool_size = base + n
+        self._pool_bytes += nbytes
+        if self._pool_size >= (1 << 31):  # int32 src plane ceiling
+            raise RuntimeError("win-source pool exceeded int32 range; "
+                               "flush() must run between larger ingests")
+        return np.int32(base)
 
     def _src_state(self, fam: str, sp: int):
-        """Device win-source plane for `fam`, grown to sp (fill -1)."""
+        """Device win-source plane for `fam`, grown to sp (fill -1).
+        int32 — pool ids fit, and the plane is downloaded every flush."""
+        jnp = self._jax.numpy
         res = self._res.get(fam) or {}
         src = res.get("src")
         if src is None:
-            return B.device_full(sp, -1)
+            return B.device_full(sp, -1, i32=True)
         if src.shape[0] < sp:
-            src = self._grow(src, sp - src.shape[0], -1)
+            src = jnp.concatenate(
+                [src, B.device_full(sp - src.shape[0], -1, i32=True)])
         return src
 
     # ----------------------------------------------------- device placement
@@ -266,14 +303,22 @@ class TpuMergeEngine:
         return sp
 
     def _put_state(self, host: np.ndarray):
+        self.bytes_h2d += host.nbytes
         if self._mesh is None:
             return self._jax.device_put(host)
         return self._jax.device_put(host, self._sh_state[host.ndim])
 
     def _put_batch(self, arr: np.ndarray):
+        self.bytes_h2d += arr.nbytes
         if self._mesh is None:
             return self._jax.device_put(arr)
         return self._jax.device_put(arr, self._sh_rep)
+
+    def _device_get(self, x):
+        out = self._jax.device_get(x)
+        seq = out if isinstance(out, (tuple, list)) else (out,)
+        self.bytes_d2h += sum(int(a.nbytes) for a in seq)
+        return out
 
     def _full(self, n: int, fill: int, cols: int = 0):
         """Neutral state materialized on device with the state sharding
@@ -358,6 +403,12 @@ class TpuMergeEngine:
         if not (self.resident and self.needs_flush) and \
                 any(len(b.cnt_ki) for b, _ in resolved):
             store.recompute_counter_sums()
+        # bound the win pool: a long streamed catch-up with no interleaved
+        # reads would otherwise pin every staged batch's columns in host
+        # RAM until the (read-triggered) flush
+        if self.resident and self.needs_flush and \
+                self._pool_bytes > self.pool_flush_bytes:
+            self.flush(store)
         return st
 
     # ---------------------------------------------------------------- flush
@@ -382,10 +433,15 @@ class TpuMergeEngine:
             names = ["stack"] if fam == "env" else \
                 [name for name, _ in _FAMILIES[fam]]
             written = res.get("written")
+            recon = res.get("recon") if res.get("src") is not None else None
             for name in names:
                 if written is not None and name not in written:
                     continue  # mirror column never scattered into: the
                     # host column it was built from is still exact
+                if recon and name in recon:
+                    continue  # winner-carried column: reconstructed on host
+                    # from the win pool via the (int32) src plane — the
+                    # int64 column itself never crosses the link
                 pending[(fam, name)] = cols[name][:n]
             if res.get("src") is not None:
                 pending[(fam, "src")] = res["src"][:n]
@@ -395,6 +451,7 @@ class TpuMergeEngine:
             except AttributeError:
                 pass
         host = {k: np.asarray(v) for k, v in pending.items()}
+        self.bytes_d2h += sum(int(v.nbytes) for v in host.values())
 
         for fam, res in self._res.items():
             n = res["n"]
@@ -416,7 +473,7 @@ class TpuMergeEngine:
                     if (fam, name) in host:
                         table.col(name)[:n] = host[(fam, name)]
             if (fam, "src") in host:
-                self._resolve_src(store, fam, host[(fam, "src")])
+                self._apply_src(store, fam, host[(fam, "src")], res)
                 res["src"] = None  # resolved; fresh tracking next round
             if res.get("written") is not None:
                 # downloaded state now equals the host columns: only columns
@@ -428,6 +485,7 @@ class TpuMergeEngine:
                                            old_dt)
         self._val_pool.clear()
         self._pool_size = 0
+        self._pool_bytes = 0
         if "cnt" in self._res and self._res["cnt"]["n"]:
             store.recompute_counter_sums()
         self.needs_flush = False
@@ -441,32 +499,58 @@ class TpuMergeEngine:
         self._res.clear()
         self._val_pool.clear()
         self._pool_size = 0
+        self._pool_bytes = 0
         self.needs_flush = False
 
-    def _resolve_src(self, store: KeySpace, fam: str,
-                     src_h: np.ndarray) -> None:
-        """Assign deferred win VALUES: slots whose src plane points into the
-        host value pool take that pool entry (set rows — valueless by
-        construction — are skipped wholesale)."""
+    def _apply_src(self, store: KeySpace, fam: str, src_h: np.ndarray,
+                   res: dict) -> None:
+        """Consume the downloaded src plane: (a) RECONSTRUCT the
+        winner-carried int64 columns from the host pool (bit-identical to
+        the device state by construction — the kernels set column and src
+        under the same win predicate), and (b) assign deferred win VALUES
+        (set rows — valueless by construction — are skipped wholesale)."""
         n = len(src_h)
+        rows_all = np.nonzero(src_h >= 0)[0]
+        if not len(rows_all):
+            return
+        pool = self._val_pool
+        gids_all = src_h[rows_all].astype(_I64)
+        bases = np.fromiter((b for b, _, _ in pool), dtype=_I64,
+                            count=len(pool))
+        segs_all = np.searchsorted(bases, gids_all, side="right") - 1
+        # (a) column reconstruction, vectorized one pool segment at a time
+        recon = res.get("recon")
+        if recon:
+            table = _host_table(store, fam)
+            order = np.argsort(segs_all, kind="stable")
+            uniq, starts = np.unique(segs_all[order], return_index=True)
+            ends = np.append(starts[1:], len(order))
+            for s, lo, hi in zip(uniq.tolist(), starts.tolist(),
+                                 ends.tolist()):
+                sel = order[lo:hi]
+                r_sel = rows_all[sel]
+                off = gids_all[sel] - pool[s][0]
+                cols = pool[s][2]
+                for host_col, pool_col in recon.items():
+                    table.col(host_col)[r_sel] = \
+                        np.asarray(cols[pool_col])[off]
+        # (b) win values
+        if fam == "cnt":
+            return  # counters carry no object values
         if fam == "reg":
-            mask = src_h >= 0
+            mask = np.ones(len(rows_all), dtype=bool)
             target = store.reg_val
         else:
-            mask = (src_h >= 0) & np.isin(
-                store.keys.enc[store.el.kid[:n]], S.VALUE_ENCS)
+            mask = np.isin(store.keys.enc[store.el.kid[:n]][rows_all],
+                           S.VALUE_ENCS)
             target = store.el_val
-        rows = np.nonzero(mask)[0]
-        if not len(rows):
-            return
-        gids = src_h[rows]
-        bases = np.fromiter((b for b, _ in self._val_pool), dtype=_I64,
-                            count=len(self._val_pool))
-        segs = np.searchsorted(bases, gids, side="right") - 1
-        pool = self._val_pool
-        for r, s, g in zip(rows.tolist(), segs.tolist(), gids.tolist()):
-            b, vals = pool[s]
-            target[r] = vals[g - b]
+        for r, s, g in zip(rows_all[mask].tolist(),
+                           segs_all[mask].tolist(),
+                           gids_all[mask].tolist()):
+            b, vals, _ = pool[s]
+            # vals None = an all-valueless batch: its winning rows CLEAR
+            # the slot value (CPU parity — local-loses replaces with None)
+            target[r] = vals[g - b] if vals is not None else None
 
     # ------------------------------------------------------ resident state
 
@@ -525,17 +609,22 @@ class TpuMergeEngine:
         return cols, cap
 
     def _family_done(self, fam: str, cols: dict, n: int, cap: int,
-                     src=None, written=None) -> None:
+                     src=None, written=None, recon=None) -> None:
         """Record post-merge device state.  `written` marks which columns
         the kernels actually scattered into since the mirror was created —
         flush downloads only those (an untouched mirror column equals the
-        host column it was uploaded from, padding included).  None = all."""
+        host column it was uploaded from, padding included).  None = all.
+        `recon` maps winner-carried device columns to their pool column
+        name — those skip the flush download entirely and reconstruct on
+        host from the win pool (valid only while `src` is tracked)."""
         prev = self._res.get(fam) or {}
         w = prev.get("written", set())
         w |= set(cols) if written is None else written
         self._res[fam] = {"cols": cols, "n": n, "cap": cap, "written": w,
                           "ver": prev.get("ver"),
-                          "src": src if src is not None else prev.get("src")}
+                          "src": src if src is not None else prev.get("src"),
+                          "recon": recon if recon is not None
+                          else prev.get("recon")}
         self.needs_flush = True
 
     def _drop_family(self, store: KeySpace, fam: str) -> None:
@@ -758,6 +847,22 @@ class TpuMergeEngine:
                          for i in range(4)]),
             lambda st, cat: (cat, [np.concatenate([s[1][i] for s in st])
                                    for i in range(4)]))
+        if self.resident and self._host_combine() and self._unique_ok:
+            # envelope merge is plain per-column max with no cross-family
+            # device dependency: fold it straight into the host columns
+            # (rows are unique per staged entry, so gather-max-scatter is
+            # collision-free) — the [N, 4] int64 plane then never crosses
+            # the link in either direction.  Bit-identical to the device
+            # path: both are int64 max.
+            self._drop_family(store, "env")  # sync any device mirror first
+            keys = store.keys
+            for pos, c in staged:
+                for i, (name, _) in enumerate(_FAMILIES["env"]):
+                    col = keys.col(name)
+                    cur = col[pos]
+                    np.maximum(cur, c[i], out=cur)
+                    col[pos] = cur
+            return
         total = sum(len(p) for p, _ in staged)
         n = store.keys.n
         base, size, all_new = self._bulk_region([p for p, _ in staged],
@@ -796,7 +901,7 @@ class TpuMergeEngine:
             if self.resident:
                 self._family_done("env", {"stack": state}, n, sp)
                 return
-            out = np.asarray(self._jax.device_get(state))[:size]
+            out = np.asarray(self._device_get(state))[:size]
             store.keys.ct[base:n] = out[:, 0]
             store.keys.mt[base:n] = out[:, 1]
             store.keys.dt[base:n] = out[:, 2]
@@ -819,7 +924,7 @@ class TpuMergeEngine:
             _pad(store.keys.dt[trows], n_slots, 0),
             _pad(store.keys.expire[trows], n_slots, 0),
             n_slots)
-        ct, mt, dt, exp = (a[: len(trows)] for a in self._jax.device_get(out))
+        ct, mt, dt, exp = (a[: len(trows)] for a in self._device_get(out))
         store.keys.ct[trows] = ct
         store.keys.mt[trows] = mt
         store.keys.dt[trows] = dt
@@ -869,19 +974,21 @@ class TpuMergeEngine:
                 nd = self._state_up(store.keys.rv_node, base, size, sp, 0,
                                     all_new)
             if self.resident and self._host_combine():
-                # deferred value resolution: no blocking win download — the
-                # winning row's pool id lands in the resident src plane and
-                # resolves once at flush (ops/bulk.py bulk_lww_src)
+                # deferred win resolution: no blocking win download — the
+                # winning row's pool id lands in the resident src plane
+                # (derived on device as base + iota, zero upload), and at
+                # flush BOTH the win values and the rv_t/rv_node columns
+                # reconstruct from the host pool (ops/bulk.py bulk_lww_src)
                 src = self._src_state("reg", sp)
                 for p, bt_, bn_, vals in staged:
-                    ids = self._pool_add(vals)
-                    idx, dbt, dbn, dsrc = self._upload_batch(
-                        p, base, sp, [(bt_, K.NEUTRAL_T), (bn_, K.NEUTRAL_T),
-                                      (ids, -1)])
-                    t, nd, src = B.bulk_lww_src(t, nd, src, idx, dbt, dbn,
-                                                dsrc)
+                    pb = self._pool_add(vals, rv_t=bt_, rv_node=bn_)
+                    idx, dbt, dbn = self._upload_batch(
+                        p, base, sp, [(bt_, K.NEUTRAL_T), (bn_, K.NEUTRAL_T)])
+                    t, nd, src = B.bulk_lww_src(t, nd, src, idx, dbt, dbn, pb)
                 self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp,
-                                  src=src)
+                                  src=src,
+                                  recon={"rv_t": "rv_t",
+                                         "rv_node": "rv_node"})
                 return
             fold = self._fold_backend() != "off" and self._aligned(staged)
             if fold:
@@ -934,7 +1041,7 @@ class TpuMergeEngine:
             _pad(store.keys.rv_node[trows], n_slots, 0),
             np.zeros(n_slots, dtype=_I64),
             n_slots)
-        t, node, _dt, win_row = (a[: len(trows)] for a in self._jax.device_get(out))
+        t, node, _dt, win_row = (a[: len(trows)] for a in self._device_get(out))
         store.keys.rv_t[trows] = t
         store.keys.rv_node[trows] = node
         reg_val = store.reg_val
@@ -998,7 +1105,36 @@ class TpuMergeEngine:
                 cb = self._state_up(store.cnt.base, base, size, sp, 0, all_new)
                 cbt = self._state_up(store.cnt.base_t, base, size, sp,
                                      K.NEUTRAL_T, all_new)
-            written = {"val", "uuid", "base", "base_t"}
+            if self.resident and self._host_combine():
+                # deferred win resolution (see _merge_registers): winners
+                # land in the src plane, and at flush the val/uuid pair —
+                # the two widest counter columns — reconstructs from the
+                # host pool instead of downloading.  The (rare) base pair
+                # keeps its own on-device winner and downloads when written.
+                src = self._src_state("cnt", sp)
+                written = {"val", "uuid"}
+                for r, v, u, bb, bt in staged:
+                    pb = self._pool_add(None, val=v, uuid=u)
+                    if (bt == K.NEUTRAL_T).all():
+                        # neutral base plane (no counter deletes anywhere in
+                        # the batch, the common case): skip uploading it
+                        idx, dv, du = self._upload_batch(
+                            r, base, sp, [(v, 0), (u, K.NEUTRAL_T)])
+                        val, uuid, src = B.bulk_counters_vu_src(
+                            val, uuid, src, idx, dv, du, pb)
+                    else:
+                        idx, dv, du, dbb, dbt = self._upload_batch(
+                            r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
+                                          (bt, K.NEUTRAL_T)])
+                        val, uuid, cb, cbt, src = B.bulk_counters_src(
+                            val, uuid, cb, cbt, src, idx, dv, du, dbb, dbt,
+                            pb)
+                        written |= {"base", "base_t"}
+                self._family_done("cnt", {"val": val, "uuid": uuid,
+                                          "base": cb, "base_t": cbt}, n, sp,
+                                  src=src, written=written,
+                                  recon={"val": "val", "uuid": "uuid"})
+                return
             if self._fold_backend() != "off" and self._aligned(staged):
                 # aligned counter rows (same (key, node) slots per batch —
                 # repeated syncs from one origin): fold both (value @ time)
@@ -1013,9 +1149,6 @@ class TpuMergeEngine:
                 val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
                                                      idx, fv, fu, fb, fbt)
             else:
-                # a batch whose base plane is neutral (no counter deletes —
-                # the common case) skips uploading and merging it entirely
-                written = {"val", "uuid"}
                 dev = []  # [(uploaded arrays, with_base)]
                 for r, v, u, bb, bt in staged:
                     if self.resident and (bt == K.NEUTRAL_T).all():
@@ -1030,15 +1163,12 @@ class TpuMergeEngine:
                         idx, v, u, bb, bt = up
                         val, uuid, cb, cbt = B.bulk_counters(
                             val, uuid, cb, cbt, idx, v, u, bb, bt)
-                        written |= {"base", "base_t"}
                     else:
                         idx, v, u = up
                         val, uuid = B.bulk_counters_vu(val, uuid, idx, v, u)
             if self.resident:
                 self._family_done("cnt", {"val": val, "uuid": uuid,
-                                          "base": cb, "base_t": cbt}, n, sp,
-                                  written=written if self._host_combine()
-                                  else None)
+                                          "base": cb, "base_t": cbt}, n, sp)
                 return
             store.cnt.val[base:n] = np.asarray(val)[:size]
             store.cnt.uuid[base:n] = np.asarray(uuid)[:size]
@@ -1061,7 +1191,7 @@ class TpuMergeEngine:
                 _pad(store.cnt.col(vcol)[trows], n_slots, 0),
                 _pad(store.cnt.col(tcol)[trows], n_slots, K.NEUTRAL_T),
                 n_slots)
-            new_val, new_t = (a[: len(trows)] for a in self._jax.device_get(out))
+            new_val, new_t = (a[: len(trows)] for a in self._device_get(out))
             store.cnt.col(vcol)[trows] = new_val
             store.cnt.col(tcol)[trows] = new_t
         if self.resident:
@@ -1156,14 +1286,12 @@ class TpuMergeEngine:
                 base, size = 0, n
                 old_dt = None  # garbage enqueue deferred to flush
                 if self._host_combine():
-                    # deferred value resolution (see _merge_registers): a
-                    # src plane is tracked only once dict VALUES are in play
-                    # — pure set traffic never pays the src download
-                    have_src = (self._res.get("el") or {}).get("src") is not None
-                    need_src = have_src or any(s[5] for s in staged) or any(
-                        np.isin(store.keys.enc[store.el.kid[s[0]]],
-                                S.VALUE_ENCS).any() for s in staged)
-                    src = self._src_state("el", sp) if need_src else None
+                    # deferred win resolution (see _merge_registers): the
+                    # src plane is ALWAYS tracked — at flush it costs one
+                    # int32 download and replaces the add_t + add_node
+                    # int64 downloads (4 bytes/slot vs 16) while also
+                    # resolving dict win values
+                    src = self._src_state("el", sp)
                     written = {"add_t", "add_node"}
                     for rows_, a_, x_, d_, vals, _hv in staged:
                         # transfer diet: node ids fit int32 (half the an
@@ -1176,23 +1304,16 @@ class TpuMergeEngine:
                             x_up = (x_arr.astype(np.int32), -1)
                         else:
                             x_up = (x_arr, K.NEUTRAL_T)
+                        pb = self._pool_add(vals if _hv else None,
+                                            add_t=a_, add_node=x_arr)
                         d_arr = np.asarray(d_)
                         nz = np.flatnonzero(d_arr)
                         sparse_dt = len(nz) * 4 <= len(d_arr)
                         if sparse_dt:
-                            if src is not None:
-                                ids = self._pool_add(vals)
-                                idx, da, dx, dsrc = self._upload_batch(
-                                    rows_, base, sp,
-                                    [(a_, K.NEUTRAL_T), x_up, (ids, -1)])
-                                at, an, src = B.bulk_elems_src_nodt(
-                                    at, an, src, idx, da, dx, dsrc)
-                            else:
-                                idx, da, dx = self._upload_batch(
-                                    rows_, base, sp,
-                                    [(a_, K.NEUTRAL_T), x_up])
-                                at, an, _win = B.bulk_elems_nodt(
-                                    at, an, idx, da, dx)
+                            idx, da, dx = self._upload_batch(
+                                rows_, base, sp, [(a_, K.NEUTRAL_T), x_up])
+                            at, an, src = B.bulk_elems_src_nodt(
+                                at, an, src, idx, da, dx, pb)
                             if len(nz):
                                 rows_nz = np.asarray(rows_)[nz]
                                 np_d = K.next_pow2(len(nz))
@@ -1203,25 +1324,18 @@ class TpuMergeEngine:
                                     self._put_batch(_pad(d_arr[nz], np_d,
                                                          0)))
                                 written.add("del_t")
-                        elif src is not None:
-                            ids = self._pool_add(vals)
-                            idx, da, dx, dd, dsrc = self._upload_batch(
-                                rows_, base, sp,
-                                [(a_, K.NEUTRAL_T), x_up, (d_arr, 0),
-                                 (ids, -1)])
-                            at, an, dt, src = B.bulk_elems_src(
-                                at, an, dt, src, idx, da, dx, dd, dsrc)
-                            written.add("del_t")
                         else:
                             idx, da, dx, dd = self._upload_batch(
                                 rows_, base, sp,
                                 [(a_, K.NEUTRAL_T), x_up, (d_arr, 0)])
-                            at, an, dt, _win = B.bulk_elems(at, an, dt, idx,
-                                                            da, dx, dd)
+                            at, an, dt, src = B.bulk_elems_src(
+                                at, an, dt, src, idx, da, dx, dd, pb)
                             written.add("del_t")
                     self._family_done("el", {"add_t": at, "add_node": an,
                                              "del_t": dt}, n, sp, src=src,
-                                      written=written)
+                                      written=written,
+                                      recon={"add_t": "add_t",
+                                             "add_node": "add_node"})
                     return
             else:
                 sp = self._sp_size(size)
@@ -1305,7 +1419,7 @@ class TpuMergeEngine:
             _pad(cur_dt, n_slots, 0),
             n_slots)
         kk = len(trows)
-        m_at, m_an, m_dt, win_row = (a[:kk] for a in self._jax.device_get(out))
+        m_at, m_an, m_dt, win_row = (a[:kk] for a in self._device_get(out))
         store.el.add_t[trows] = m_at
         store.el.add_node[trows] = m_an
         store.el.del_t[trows] = m_dt

@@ -40,23 +40,42 @@ import jax.numpy as jnp  # noqa: E402
 from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
 __all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_max1", "bulk_lww",
-           "bulk_counters", "bulk_counters_vu", "bulk_elems",
+           "bulk_counters", "bulk_counters_vu", "bulk_counters_vu_src",
+           "bulk_counters_src", "bulk_elems",
            "bulk_lww_src", "bulk_elems_src", "bulk_elems_src_nodt",
            "bulk_elems_nodt"]
 
 # An element add-side without its (independent, sparse-shippable) del side
 # IS the plain LWW pair — same kernels, no duplicate _pair_win call sites:
-#   * bulk_elems_src_nodt(at, an, src, idx, bat, ban, bsrc)
+#   * bulk_elems_src_nodt(at, an, src, idx, bat, ban, base)
 #   * bulk_elems_nodt(at, an, idx, bat, ban) -> (at, an, win-ignored)
 #   * bulk_max1(dt, idx, vals) — bulk_max's body is shape-agnostic
 # (aliases assigned after the definitions below)
+#
+# The *_src kernels track DEFERRED win resolution: instead of returning win
+# flags (whose download blocks the pipeline every call — fatal when the
+# device hangs off a high-latency link), the winning batch row's host
+# value-pool id scatters into a resident int32 `src` plane.  Ids are NOT
+# uploaded — pool entries are consecutive, so the kernel derives them as
+# `base + iota` (zero extra host→device bytes).  The engine downloads the
+# int32 `src` plane ONCE at flush and both resolves win values and
+# RECONSTRUCTS the winner-carried columns (el add_t/add_node, reg
+# rv_t/rv_node, cnt val/uuid) from host-side pools — those columns then
+# never cross the link at all (the round-4 flush was ~45% of wall time,
+# dominated by exactly these downloads).
 
 
-@partial(jax.jit, static_argnames=("n", "fill"))
-def device_full(n: int, fill: int):
+@partial(jax.jit, static_argnames=("n", "fill", "i32"))
+def device_full(n: int, fill: int, i32: bool = False):
     """Neutral state created ON device (avoids uploading zeros when every
-    touched slot is brand new)."""
-    return jnp.full((n,), fill, dtype=jnp.int64)
+    touched slot is brand new).  `i32` for the src plane — pool ids fit
+    int32, halving its flush download."""
+    return jnp.full((n,), fill, dtype=jnp.int32 if i32 else jnp.int64)
+
+
+def _iota_src(base, np_: int):
+    """Pool ids of one batch: consecutive from `base` (int32 on device)."""
+    return base + jax.lax.iota(jnp.int32, np_)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -130,26 +149,23 @@ def bulk_counters(val, uuid, base, base_t, idx, bv, bt, bb, bbt):
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
-def bulk_lww_src(t, n, src, idx, bt, bn, bsrc):
-    """bulk_lww with DEFERRED value resolution: instead of returning win
-    flags (whose download blocks the pipeline every call — fatal when the
-    device hangs off a high-latency link), the winning batch row's host
-    value-pool id scatters into the resident `src` plane.  The engine
-    downloads `src` ONCE at flush and resolves every winner in one pass."""
+def bulk_lww_src(t, n, src, idx, bt, bn, base):
+    """bulk_lww with deferred win resolution (see the *_src block comment
+    at the top of the file): winners scatter `base + iota` into `src`."""
     size = t.shape[0]
     ic = jnp.minimum(idx, size - 1)
     ct, cn, cs = t[ic], n[ic], src[ic]
     win = _pair_win(cn, ct, bn, bt, idx < size)
     t = t.at[idx].set(jnp.where(win, bt, ct), mode="drop", unique_indices=True)
     n = n.at[idx].set(jnp.where(win, bn, cn), mode="drop", unique_indices=True)
-    src = src.at[idx].set(jnp.where(win, bsrc, cs), mode="drop",
-                          unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
+                          mode="drop", unique_indices=True)
     return t, n, src
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-def bulk_elems_src(at, an, dt, src, idx, bat, ban, bdt, bsrc):
-    """bulk_elems with deferred value resolution (see bulk_lww_src)."""
+def bulk_elems_src(at, an, dt, src, idx, bat, ban, bdt, base):
+    """bulk_elems with deferred win resolution (see bulk_lww_src)."""
     size = at.shape[0]
     ic = jnp.minimum(idx, size - 1)
     ca, cn, cd, cs = at[ic], an[ic], dt[ic], src[ic]
@@ -159,9 +175,55 @@ def bulk_elems_src(at, an, dt, src, idx, bat, ban, bdt, bsrc):
     an = an.at[idx].set(jnp.where(win, ban, cn), mode="drop",
                         unique_indices=True)
     dt = dt.at[idx].max(bdt, mode="drop", unique_indices=True)
-    src = src.at[idx].set(jnp.where(win, bsrc, cs), mode="drop",
-                          unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
+                          mode="drop", unique_indices=True)
     return at, an, dt, src
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def bulk_counters_vu_src(val, uuid, src, idx, bv, bt, base):
+    """bulk_counters_vu with deferred win resolution: the merged val/uuid
+    pair is RECONSTRUCTED at flush from the host pool via `src`, so the two
+    widest counter columns never download."""
+    size = val.shape[0]
+    ic = jnp.minimum(idx, size - 1)
+    cv, ct, cs = val[ic], uuid[ic], src[ic]
+    win = _pair_win(cv, ct, bv, bt, idx < size)
+    val = val.at[idx].set(jnp.where(win, bv, cv), mode="drop",
+                          unique_indices=True)
+    uuid = uuid.at[idx].set(jnp.where(win, bt, ct), mode="drop",
+                            unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
+                          mode="drop", unique_indices=True)
+    return val, uuid, src
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def bulk_counters_src(val, uuid, base_c, base_t, src, idx, bv, bt, bb, bbt,
+                      base):
+    """bulk_counters with deferred win resolution on the val/uuid pair
+    (the base pair keeps its own winner on device and downloads when
+    written — counter deletes are rare)."""
+    size = val.shape[0]
+    ic = jnp.minimum(idx, size - 1)
+    in_range = idx < size
+
+    cv, ct, cs = val[ic], uuid[ic], src[ic]
+    win = _pair_win(cv, ct, bv, bt, in_range)
+    val = val.at[idx].set(jnp.where(win, bv, cv), mode="drop",
+                          unique_indices=True)
+    uuid = uuid.at[idx].set(jnp.where(win, bt, ct), mode="drop",
+                            unique_indices=True)
+    src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
+                          mode="drop", unique_indices=True)
+
+    cb, cbt = base_c[ic], base_t[ic]
+    win = _pair_win(cb, cbt, bb, bbt, in_range)
+    base_c = base_c.at[idx].set(jnp.where(win, bb, cb), mode="drop",
+                                unique_indices=True)
+    base_t = base_t.at[idx].set(jnp.where(win, bbt, cbt), mode="drop",
+                                unique_indices=True)
+    return val, uuid, base_c, base_t, src
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))

@@ -235,44 +235,57 @@ class ReplicaLink:
 
     async def _push_loop(self, writer, peer_resume: int) -> None:
         """Outbound half (reference push.rs): full-vs-partial, then stream
-        repl_log frames; REPLACK heartbeat."""
+        repl_log frames; REPLACK heartbeat.
+
+        The send position is a LOCAL cursor, never read back from the
+        shared meta.  During a reconnect/adopt overlap two push loops
+        briefly coexist on one meta; with a shared cursor the dying loop
+        keeps advancing it while writing to a dead socket, the new loop
+        then skips those entries as already-sent, and its drained beacon
+        advances the peer's pull watermark straight over the hole —
+        silently lost ops mesh-wide (found by the round-5 chaos suite).
+        A local cursor confines every advance to the connection it was
+        actually written to; meta.uuid_i_sent is only mirrored for
+        observability while this connection is still the live one."""
         node = self.node
         meta = self.meta
         consumer = node.events.new_consumer(EVENT_REPLICATED)
         try:
             synced = False  # peer_resume not yet honored
+            cursor = 0
             last_ack = 0.0
             while True:
-                if not synced or not node.repl_log.can_resume_from(
-                        meta.uuid_i_sent):
-                    resume = peer_resume if not synced else meta.uuid_i_sent
+                if not synced or not node.repl_log.can_resume_from(cursor):
+                    resume = peer_resume if not synced else cursor
                     if node.repl_log.can_resume_from(resume):
                         # partial replay is always the lossless choice when
                         # the log covers the resume point: delete OPS are
                         # still in the ring even after their tombstones
                         # were physically collected (manager.min_uuid)
                         self._write(writer, encode_msg(Arr([Bulk(PARTSYNC)])))
-                        meta.uuid_i_sent = resume
+                        cursor = resume
                     else:
                         # a peer excluded from the GC horizon (needs_full)
                         # whose resume point also fell off the ring may hold
                         # keys whose tombstones we already collected — a
                         # plain snapshot merge cannot delete them, so it
                         # must WIPE before merging (fullsync reset flag)
-                        await self._send_snapshot(writer,
-                                                  reset=meta.needs_full)
+                        cursor = await self._send_snapshot(
+                            writer, reset=meta.needs_full)
                     synced = True
                     meta.needs_full = False
 
                 sent = 0
-                while (e := node.repl_log.next_after(meta.uuid_i_sent)) is not None:
+                while (e := node.repl_log.next_after(cursor)) is not None:
                     self._write(writer, encode_msg(Arr([
                         Bulk(REPLICATE), Int(node.node_id), Int(e.prev_uuid),
                         Int(e.uuid), Bulk(e.name), *e.args])))
-                    meta.uuid_i_sent = e.uuid
+                    cursor = e.uuid
                     sent += 1
                     if sent % 64 == 0:
                         await writer.drain()  # backpressure + yield
+                if self._writer is writer:
+                    meta.uuid_i_sent = cursor  # observability (INFO)
 
                 now = asyncio.get_running_loop().time()
                 if (meta.uuid_he_sent > meta.uuid_he_acked
@@ -281,7 +294,7 @@ class ReplicaLink:
                     # node will EVER stream from now on exceeds its current
                     # HLC — peers may advance their pull watermark to it, so
                     # idle nodes don't pin the cluster GC horizon at 0
-                    drained = meta.uuid_i_sent >= node.repl_log.last_uuid
+                    drained = cursor >= node.repl_log.last_uuid
                     beacon = node.hlc.current if drained else 0
                     self._write(writer, encode_msg(Arr([
                         Bulk(REPLACK), Int(meta.uuid_he_sent), Int(now_ms()),
@@ -295,15 +308,15 @@ class ReplicaLink:
         finally:
             consumer.close()
 
-    async def _send_snapshot(self, writer, reset: bool = False) -> None:
+    async def _send_snapshot(self, writer, reset: bool = False) -> int:
         """Fork-free full sync with bounded memory: acquire the node's
         SHARED on-disk dump (produced once, reused by every concurrently
         or subsequently syncing peer while the repl_log still covers its
         watermark — reference server.rs:221-250 reuse + push.rs:34-71
         send_file, minus the fork) and stream the file to the socket in
-        fixed-size pieces.  After the snapshot, the push loop streams the
-        repl_log gap from the dump's watermark — which `can_resume_from`
-        guarantees is still present."""
+        fixed-size pieces.  Returns the dump's repl watermark — the push
+        loop's new send cursor (the repl_log gap above it streams next,
+        which `can_resume_from` guarantees is still present)."""
         dump = await self.app.shared_dump.acquire()
         self.node.stats.extra["full_syncs_sent"] = \
             self.node.stats.extra.get("full_syncs_sent", 0) + 1
@@ -314,7 +327,7 @@ class ReplicaLink:
             while piece := f.read(_READ_CHUNK):
                 self._write(writer, piece)
                 await writer.drain()
-        self.meta.uuid_i_sent = dump.repl_last
+        return dump.repl_last
 
     # ----------------------------------------------------------------- pull
 
@@ -450,15 +463,20 @@ class ReplicaLink:
             group.clear()
             await asyncio.sleep(0)
 
+        replica_rows: list = []
         with open(path, "rb") as f:
             for kind, payload in SnapshotLoader(f):
                 if kind == "node":
                     if payload.node_id and not self.meta.node_id:
                         self.meta.node_id = payload.node_id
                 elif kind == "replicas":
-                    # transitive mesh join (reference pull.rs:136-153)
-                    node.replicas.merge_records(
-                        payload, my_addr=self.app.advertised_addr)
+                    # held until the WHOLE snapshot is applied (below):
+                    # merge_records adopts the recorded pull watermarks,
+                    # which are only backed by state once every chunk has
+                    # merged — adopting mid-stream would let a crash or a
+                    # corrupt-chunk abort leave watermarks pointing past
+                    # ops the local keyspace never received
+                    replica_rows.extend(payload)
                 else:
                     if split_keys and payload.n_keys > split_keys:
                         for sub in batch_chunks(payload, split_keys):
@@ -470,6 +488,11 @@ class ReplicaLink:
                     if len(group) >= target:
                         await apply_group()
             await apply_group()
+        if replica_rows:
+            # transitive mesh join (reference pull.rs:136-153) + watermark
+            # adoption, now that the state backing them is fully merged
+            node.replicas.merge_records(replica_rows,
+                                        my_addr=self.app.advertised_addr)
         if repl_last > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = repl_last
         node.hlc.observe(repl_last)

@@ -130,7 +130,18 @@ class ReplicaManager:
     def merge_records(self, rows: Iterable[ReplicaRecord],
                       my_addr: str = "") -> list[ReplicaMeta]:
         """Merge a REPLICAS snapshot section (LWW per addr); returns peers
-        that became live-and-new (candidates for transitive MEET)."""
+        that became live-and-new (candidates for transitive MEET).
+
+        The recorded PULL WATERMARK (uuid_he_sent) is adopted (max-merge).
+        Every caller merges the snapshot's full keyspace state alongside
+        this section, so ops below the recorded watermark are already
+        reflected in what we just merged — resuming from it is lossless.
+        NOT adopting it is a convergence bug, not merely wasteful: a
+        cold-restarted node would dial with resume 0, and peers would
+        replay their whole ring — re-delivering ADDS whose tombstones the
+        whole mesh already GC-collected, resurrecting deleted members
+        with no surviving delete op anywhere to kill them again (found by
+        the round-5 chaos suite)."""
         fresh = []
         for r in rows:
             if r.addr == my_addr:
@@ -151,6 +162,8 @@ class ReplicaManager:
                 m.node_id = r.node_id
             if r.alias and not m.alias:
                 m.alias = r.alias
+            if r.uuid_he_sent > m.uuid_he_sent:
+                m.uuid_he_sent = r.uuid_he_sent
             if is_new and m.alive:
                 fresh.append(m)
         for m in fresh:

@@ -550,10 +550,19 @@ class KeySpace:
 
     # ------------------------------------------------------------ inspection
 
-    def canonical(self) -> dict:
-        """Full logical state (incl. tombstones) for convergence checks."""
+    def canonical(self, keys=None) -> dict:
+        """Full logical state (incl. tombstones) for convergence checks.
+        `keys`: restrict to these key bytes (absent keys are omitted — a
+        comparison against an oracle that HAS them then fails loudly);
+        used by bench.py to oracle-verify a subsample of a 10M-key store
+        without walking all of it."""
         out = {}
-        for kid, key in enumerate(self.key_bytes):
+        if keys is not None:
+            items = ((self.lookup(k), k) for k in keys)
+            items = ((kid, k) for kid, k in items if kid >= 0)
+        else:
+            items = enumerate(self.key_bytes)
+        for kid, key in items:
             enc = int(self.keys.enc[kid])
             ct, mt, dt = self.envelope(kid)
             if enc == S.ENC_COUNTER:

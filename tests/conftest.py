@@ -26,6 +26,14 @@ if not os.environ.get("CONSTDB_TEST_TPU"):
 
     jax.config.update("jax_platforms", "cpu")
 os.environ.setdefault("JAX_ENABLE_X64", "true")
+if os.environ.get("CONSTDB_TEST_TPU"):
+    # real-chip runs pay ~20-40s per kernel compile through the tunnel;
+    # the persistent cache makes suite reruns tractable (same knob
+    # bench.py sets)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/constdb_jax_cache")
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -104,9 +104,12 @@ def test_valueless_add_clears_stale_dict_value():
     assert st.canonical() == _cpu_ref([base, nb]).canonical()
 
 
-def test_pure_set_traffic_has_no_src_plane():
-    """Set-only groups never materialize the el src plane (no value bytes
-    to resolve → no extra download at flush)."""
+def test_src_plane_is_int32_and_replaces_column_downloads():
+    """The el src plane is always tracked (round-5 transfer diet): one
+    int32 download at flush replaces the add_t + add_node int64 downloads
+    — strictly cheaper even for pure set traffic (4 bytes/slot vs 16)."""
+    import numpy as np
+
     batches = []
     for r in range(3):
         n = Node(node_id=r + 1)
@@ -117,9 +120,53 @@ def test_pure_set_traffic_has_no_src_plane():
     st = KeySpace()
     eng.merge_many(st, batches)
     res = eng._res.get("el")
-    assert res is not None and res.get("src") is None
+    assert res is not None and res.get("src") is not None
+    assert np.asarray(res["src"]).dtype == np.int32
+    assert res.get("recon") == {"add_t": "add_t", "add_node": "add_node"}
     eng.flush(st)
     assert st.canonical() == _cpu_ref(batches).canonical()
+
+
+def test_reconstructed_columns_bit_identical_to_downloads():
+    """Round-5 transfer diet: flush reconstructs el add_t/add_node, reg
+    rv_t/rv_node and cnt val/uuid from the host win pool via the src
+    plane.  Control = the same merged device state with reconstruction
+    disabled (recon cleared → every written column downloads).  The two
+    keyspaces must match column-for-column, bit for bit."""
+    import bench
+    chunks = []
+    for b in bench.make_workload(3000, 4, seed=11):
+        chunks.extend(batch_chunks(b, 700))
+
+    def run(strip_recon: bool) -> KeySpace:
+        eng = TpuMergeEngine(resident=True)
+        st = KeySpace()
+        for i in range(0, len(chunks), 4):
+            eng.merge_many(st, chunks[i:i + 4])
+        assert any(r.get("src") is not None for r in eng._res.values())
+        if strip_recon:
+            for r in eng._res.values():
+                r["recon"] = None  # force the full-download flush path
+        eng.flush(st)
+        return st
+
+    recon, ctrl = run(False), run(True)
+    for name in ("ct", "mt", "dt", "expire", "rv_t", "rv_node"):
+        np.testing.assert_array_equal(recon.keys.col(name)[:recon.keys.n],
+                                      ctrl.keys.col(name)[:ctrl.keys.n],
+                                      err_msg=f"keys.{name}")
+    for name in ("val", "uuid", "base", "base_t"):
+        np.testing.assert_array_equal(recon.cnt.col(name)[:recon.cnt.n],
+                                      ctrl.cnt.col(name)[:ctrl.cnt.n],
+                                      err_msg=f"cnt.{name}")
+    for name in ("add_t", "add_node", "del_t"):
+        np.testing.assert_array_equal(recon.el.col(name)[:recon.el.n],
+                                      ctrl.el.col(name)[:ctrl.el.n],
+                                      err_msg=f"el.{name}")
+    assert recon.reg_val == ctrl.reg_val
+    assert recon.el_val == ctrl.el_val
+    assert recon.canonical() == ctrl.canonical() == \
+        _cpu_ref(chunks).canonical()
 
 
 def test_mixed_streaming_groups_match_cpu():

@@ -71,6 +71,52 @@ def test_matches_single_device(rep, seed):
     assert touched == np.sum(t1 > NEUTRAL_T)
 
 
+slow = pytest.mark.skipif(
+    not __import__("os").environ.get("CONSTDB_SLOW"),
+    reason="set CONSTDB_SLOW=1 for the 100k-key mesh soak")
+
+
+@needs_mesh
+@slow
+def test_kv_sharded_engine_at_scale():
+    """The PRODUCTION kv-sharded merge path (TpuMergeEngine(mesh=...)) at
+    real scale: ≥100k keys streamed as non-pow2 chunks, so per-shard state
+    spans many tiles, the pow2+multiple-of-kv padding rule exercises both
+    branches, and chunk boundaries straddle range-partition edges.  Must
+    stay canonical()-identical to the CPU engine (VERDICT r4 item 6 —
+    shard-boundary bugs hide at toy sizes where every slot fits one tile).
+    """
+    import bench
+    from constdb_tpu.engine.cpu import CpuMergeEngine
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+    from constdb_tpu.parallel import engine_mesh
+    from constdb_tpu.persist.snapshot import batch_chunks
+    from constdb_tpu.store.keyspace import KeySpace
+
+    n_keys, n_rep = 120_000, 4
+    batches = bench.make_workload(n_keys, n_rep, seed=23)
+    # 13_331 is deliberately non-pow2 and coprime with 8: every chunk ends
+    # inside a shard's slot range, never on a partition edge
+    chunks = bench.chunk_batches(batches, 13_331)
+
+    eng = TpuMergeEngine(resident=True, mesh=engine_mesh(8))
+    st = KeySpace()
+    group = 2 * n_rep
+    for i in range(0, len(chunks), group):
+        eng.merge_many(st, chunks[i:i + group])
+    eng.flush(st)
+
+    oracle = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in batches:
+        cpu.merge(oracle, b)
+    got, want = st.canonical(), oracle.canonical()
+    assert len(got) == n_keys
+    diff = [k for k in want if got.get(k) != want[k]]
+    assert not diff, f"{len(diff)} keys diverge, e.g. {diff[:3]}"
+    assert got == want
+
+
 @needs_mesh
 def test_row0_wins_ties_across_rep_shards():
     """The local-state row (global row 0) must win exact (t, node) ties even
