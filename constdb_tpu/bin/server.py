@@ -81,7 +81,8 @@ async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
         def write() -> None:
             tmp = path + ".tmp.%d" % os.getpid()
             with open(tmp, "wb") as f:
-                w = SnapshotWriter(f)
+                w = SnapshotWriter(
+                    f, compress_level=cfg.snapshot_compress_level)
                 w.write_node(meta)
                 w.write_replicas(records)
                 for chunk in batch_chunks(capture, cfg.snapshot_chunk_keys):
@@ -108,6 +109,7 @@ async def amain(cfg: Config) -> None:
         heartbeat=float(cfg.replica_heartbeat_frequency),
         reconnect_delay=float(cfg.replica_gossip_frequency) / 3.0,
         snapshot_chunk_keys=cfg.snapshot_chunk_keys,
+        snapshot_compress_level=cfg.snapshot_compress_level,
         snapshot_path=cfg.snapshot_path,
         tcp_backlog=cfg.tcp_backlog,
         gc_peer_retention=float(cfg.gc_peer_retention))
@@ -132,7 +134,8 @@ async def amain(cfg: Config) -> None:
                                addr=app.advertised_addr,
                                repl_last_uuid=node.repl_log.last_uuid),
                       node.replicas.records(),
-                      chunk_keys=cfg.snapshot_chunk_keys)
+                      chunk_keys=cfg.snapshot_chunk_keys,
+                      compress_level=cfg.snapshot_compress_level)
         log.info("final snapshot written to %s", cfg.snapshot_path)
     await app.close()
 

@@ -51,6 +51,12 @@ class Config:
     snapshot_path: str = ""       # load on boot + background dump target
     snapshot_interval: int = 0    # seconds between background dumps (0 = off)
     snapshot_chunk_keys: int = 1 << 16
+    snapshot_compress_level: int = 1  # zlib level for snapshot sections —
+    #                               on disk AND on the wire (full sync
+    #                               streams the same file; reference
+    #                               src/conn/writer.rs:92-112 streams raw).
+    #                               0 = store/send raw; 1 (default) = fast;
+    #                               up to 9 = smallest
     repl_log_cap: int = 1_024_000  # reference src/server.rs:81
     log_level: str = "info"
     pid_file: str = ""            # default: <work_dir>/constdb.pid (daemon)

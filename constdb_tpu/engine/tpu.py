@@ -267,7 +267,9 @@ class TpuMergeEngine:
         if vals is not None:
             vals = list(vals)
             n = len(vals)
-            nbytes += 8 * n
+            # count the real pinned payload, not just pointers: the
+            # auto-flush bound must trip on value-heavy ingests too
+            nbytes += 8 * n + sum(len(v) for v in vals if v is not None)
         for a in cols.values():
             n = len(a)
             nbytes += int(getattr(a, "nbytes", 8 * n))

@@ -78,10 +78,16 @@ class SharedDump:
         path = os.path.join(app.work_dir, f"fullsync.{node.node_id}.snapshot")
         chunk_keys = app.snapshot_chunk_keys
 
+        level = getattr(app, "snapshot_compress_level", 1)
+
         def write() -> int:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
-                w = SnapshotWriter(f)
+                # the full-sync stream sends this very file, so the column
+                # compression rides the wire end-to-end (conf
+                # snapshot_compress_level; contrast reference
+                # src/conn/writer.rs:92-112, which streams raw)
+                w = SnapshotWriter(f, compress_level=level)
                 w.write_node(meta)
                 w.write_replicas(records)
                 for chunk in batch_chunks(capture, chunk_keys):

@@ -25,6 +25,18 @@ _INT_REPLY = [b":%d\r\n" % i for i in range(1024)]
 
 
 def encode_into(out: bytearray, m: Msg) -> None:
+    """Append m's wire encoding to `out` — native fast path when the
+    extension is built (interned small-int replies, C-speed bulk arrays),
+    bit-identical pure-Python fallback otherwise (and for any shape the
+    C encoder declines: subclasses, big ints, non-bytes payloads)."""
+    enc = _enc()
+    if enc is not None and enc(out, m, Arr, Bulk, Int, Simple, Err, Nil,
+                               NoReply):
+        return
+    _py_encode_into(out, m)
+
+
+def _py_encode_into(out: bytearray, m: Msg) -> None:
     if isinstance(m, NoReply):
         return
     if isinstance(m, Nil):
@@ -288,6 +300,7 @@ class NativeRespParser(RespParser):
 
 
 _EXT_CACHE: list = []
+_ENC_CACHE: list = []
 
 
 def _ext():
@@ -297,6 +310,16 @@ def _ext():
         _EXT_CACHE.append(mod if mod is not None and
                           hasattr(mod, "resp_parse") else None)
     return _EXT_CACHE[0]
+
+
+def _enc():
+    """The native encoder entry point, or None.  Gated SEPARATELY from the
+    parser: a prebuilt cst_ext.so from before the encoder existed must
+    degrade to the pure-Python path, not AttributeError on every reply."""
+    if not _ENC_CACHE:
+        from ..utils.native_tables import load_ext
+        _ENC_CACHE.append(getattr(load_ext(), "resp_encode", None))
+    return _ENC_CACHE[0]
 
 
 def make_parser() -> RespParser:

@@ -357,8 +357,12 @@ def client_command(node, ctx, args):
 def _counter_step(node, ctx, args, delta: int) -> Msg:
     """INCR/DECR: bump the local slot's lifetime total and replicate the
     new ABSOLUTE total (idempotent LWW assignment on the wire — see
-    KeySpace.counter_change)."""
+    KeySpace.counter_change).  An optional amount argument scales the
+    step (Redis INCRBY/DECRBY folded in; the reference steps by exactly 1
+    — type_counter.rs:169-189)."""
     key = args.next_bytes()
+    if args.has_more:
+        delta *= args.next_int()
     kid, _ = node.ks.get_or_create(key, S.ENC_COUNTER, ctx.uuid)
     v, total = node.ks.counter_change(kid, ctx.nodeid, delta, ctx.uuid)
     node.ks.updated_at(kid, ctx.uuid)

@@ -38,6 +38,7 @@ class ServerApp:
                  heartbeat: float = 4.0, reconnect_delay: float = 5.0,
                  handshake_timeout: float = 10.0,
                  snapshot_chunk_keys: int = 1 << 16,
+                 snapshot_compress_level: int = 1,
                  gc_interval: float = 1.0,
                  snapshot_path: str = "",
                  sync_merge_group: int = 8,
@@ -58,6 +59,7 @@ class ServerApp:
         self.reconnect_delay = reconnect_delay
         self.handshake_timeout = handshake_timeout
         self.snapshot_chunk_keys = snapshot_chunk_keys
+        self.snapshot_compress_level = snapshot_compress_level
         self.gc_interval = gc_interval
         self.snapshot_path = snapshot_path
         # snapshot-apply cadence: chunks per engine call (ceiling), the

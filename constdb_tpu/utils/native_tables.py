@@ -30,8 +30,11 @@ _ext = None
 
 
 def load_ext():
-    """The CPython extension module, or None."""
+    """The CPython extension module, or None.  CONSTDB_NO_NATIVE=1 forces
+    the pure-Python tiers (A/B floor measurement — opbench.py)."""
     global _ext
+    if os.environ.get("CONSTDB_NO_NATIVE"):
+        return None
     if _ext is not None:
         return _ext or None
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

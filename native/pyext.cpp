@@ -287,6 +287,9 @@ PyMethodDef methods[] = {
     {"resp_parse", py_resp_parse, METH_VARARGS,
      "resp_parse(buf, pos, Arr, Bulk, Int, Simple, Err, nil[, max]) -> "
      "(msgs, new_pos, fallback)"},
+    {"resp_encode", py_resp_encode, METH_VARARGS,
+     "resp_encode(out, msg, Arr, Bulk, Int, Simple, Err, NilT, NoReplyT) "
+     "-> appended? (False = caller must use the pure-Python encoder)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

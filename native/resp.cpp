@@ -34,6 +34,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <string>
 
 namespace resp {
 
@@ -326,4 +327,145 @@ fail:
     Py_DECREF(out);
     PyBuffer_Release(&view);
     return nullptr;
+}
+
+// ---------------------------------------------------------------- encoder
+//
+// resp_encode(out_bytearray, msg, Arr, Bulk, Int, Simple, Err, NilT, NoReplyT)
+// appends msg's wire encoding to `out` and returns True, or returns False
+// when msg has ANY shape this fast path cannot encode cleanly (subclass,
+// non-bytes payload, >64-bit int, NoReply inside an Arr...) — the caller
+// then falls back to the pure-Python encoder, which either handles it or
+// raises its own error, keeping behavior identical.  Small non-negative
+// int replies are interned (parity: reference src/resp.rs:12-27 pre-builds
+// the common counter replies).
+
+namespace resp {
+
+constexpr int kInternedInts = 10000;
+
+inline const std::string* interned_int(long long v) {
+    static std::string table[kInternedInts];
+    static bool built = false;
+    if (!built) {
+        char buf[32];
+        for (int i = 0; i < kInternedInts; i++) {
+            int n = snprintf(buf, sizeof buf, ":%d\r\n", i);
+            table[i].assign(buf, static_cast<size_t>(n));
+        }
+        built = true;
+    }
+    return (v >= 0 && v < kInternedInts) ? &table[v] : nullptr;
+}
+
+struct EncTypes {
+    PyTypeObject *arr, *bulk, *i, *simple, *err, *nil, *noreply;
+};
+
+// returns 1 ok, 0 fallback-needed (no python error set), -1 python error
+inline int encode1(std::string& out, PyObject* m, const EncTypes& t,
+                   int depth, bool top) {
+    if (depth > 32) return 0;
+    PyTypeObject* ty = Py_TYPE(m);
+    if (ty == t.noreply) return top ? 1 : 0;  // inside Arr: pure path raises
+    if (ty == t.nil) {
+        out.append("$-1\r\n", 5);
+        return 1;
+    }
+    Names& nm = names();
+    if (ty == t.i) {
+        PyObject* val = PyObject_GetAttr(m, nm.val);
+        if (!val) return -1;
+        if (!PyLong_CheckExact(val)) {
+            Py_DECREF(val);
+            return 0;
+        }
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(val, &overflow);
+        Py_DECREF(val);
+        if (overflow || (v == -1 && PyErr_Occurred())) {
+            PyErr_Clear();
+            return 0;  // arbitrary-precision: pure path formats it
+        }
+        if (const std::string* s = interned_int(v)) {
+            out.append(*s);
+        } else {
+            char buf[32];
+            int n = snprintf(buf, sizeof buf, ":%lld\r\n", v);
+            out.append(buf, static_cast<size_t>(n));
+        }
+        return 1;
+    }
+    if (ty == t.bulk || ty == t.simple || ty == t.err) {
+        PyObject* val = PyObject_GetAttr(m, nm.val);
+        if (!val) return -1;
+        if (!PyBytes_CheckExact(val)) {
+            Py_DECREF(val);
+            return 0;
+        }
+        char* p;
+        Py_ssize_t n;
+        PyBytes_AsStringAndSize(val, &p, &n);
+        if (ty == t.bulk) {
+            char head[32];
+            int hn = snprintf(head, sizeof head, "$%lld\r\n",
+                              static_cast<long long>(n));
+            out.append(head, static_cast<size_t>(hn));
+            out.append(p, static_cast<size_t>(n));
+            out.append("\r\n", 2);
+        } else {
+            out.push_back(ty == t.simple ? '+' : '-');
+            out.append(p, static_cast<size_t>(n));
+            out.append("\r\n", 2);
+        }
+        Py_DECREF(val);
+        return 1;
+    }
+    if (ty == t.arr) {
+        PyObject* items = PyObject_GetAttr(m, nm.items);
+        if (!items) return -1;
+        if (!PyList_CheckExact(items)) {
+            Py_DECREF(items);
+            return 0;
+        }
+        Py_ssize_t n = PyList_GET_SIZE(items);
+        char head[32];
+        int hn = snprintf(head, sizeof head, "*%lld\r\n",
+                          static_cast<long long>(n));
+        out.append(head, static_cast<size_t>(hn));
+        for (Py_ssize_t j = 0; j < n; j++) {
+            int rc = encode1(out, PyList_GET_ITEM(items, j), t, depth + 1,
+                             false);
+            if (rc != 1) {
+                Py_DECREF(items);
+                return rc;
+            }
+        }
+        Py_DECREF(items);
+        return 1;
+    }
+    return 0;  // unknown / subclassed message type
+}
+
+}  // namespace resp
+
+static PyObject* py_resp_encode(PyObject*, PyObject* args) {
+    PyObject *out, *msg;
+    resp::EncTypes t;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOO", &out, &msg, &t.arr, &t.bulk,
+                          &t.i, &t.simple, &t.err, &t.nil, &t.noreply))
+        return nullptr;
+    if (!PyByteArray_CheckExact(out)) {
+        PyErr_SetString(PyExc_TypeError, "out must be a bytearray");
+        return nullptr;
+    }
+    std::string buf;
+    int rc = resp::encode1(buf, msg, t, 0, true);
+    if (rc < 0) return nullptr;
+    if (rc == 0) Py_RETURN_FALSE;
+    Py_ssize_t old = PyByteArray_GET_SIZE(out);
+    if (PyByteArray_Resize(out, old + static_cast<Py_ssize_t>(buf.size())))
+        return nullptr;
+    memcpy(PyByteArray_AS_STRING(out) + old, buf.data(), buf.size());
+    Py_RETURN_TRUE;
 }
