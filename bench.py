@@ -284,6 +284,10 @@ def main() -> None:
         print(f"[bench] WARNING: {note}", file=sys.stderr)
         force_cpu_platform()
 
+    # bench context: plenty of host RAM is provisioned, so let the win
+    # pool cover the whole run — one flush, minimum link round-trips
+    # (servers keep the conservative default; see engine pool_flush_bytes)
+    os.environ.setdefault("CONSTDB_POOL_FLUSH_MB", "8192")
     from constdb_tpu.engine.tpu import TpuMergeEngine
     import jax
     # persistent compile cache: state shapes recur across runs (pow2-padded),

@@ -191,6 +191,10 @@ class TpuMergeEngine:
         self._val_pool: list[tuple[int, Optional[list], dict]] = []
         self._pool_size = 0
         self._pool_bytes = 0
+        # el rows whose HOST del_t advanced since the last flush (the del
+        # plane never touches the device in the src path); flush turns
+        # newly-dead ones into GC queue entries after add_t reconstruction
+        self._el_del_touched: list[np.ndarray] = []
         import os as _os
         self.pool_flush_bytes = int(_os.environ.get(
             "CONSTDB_POOL_FLUSH_MB", "1536")) << 20
@@ -485,6 +489,20 @@ class TpuMergeEngine:
                 self._enqueue_elem_garbage(store, np.arange(n),
                                            table.add_t[:n], table.del_t[:n],
                                            old_dt)
+        if self._el_del_touched:
+            # host-maintained del side (el src path): with add_t now
+            # reconstructed, queue rows that ended up dead.  Spurious
+            # entries for rows a later add resurrected are fine — gc()
+            # re-checks liveness at collection time.
+            rows = np.unique(np.concatenate(self._el_del_touched))
+            self._el_del_touched.clear()
+            at = store.el.add_t[rows]
+            dtv = store.el.del_t[rows]
+            dead = np.nonzero(at < dtv)[0]
+            kb, kidc, mem = store.key_bytes, store.el.kid, store.el_member
+            for i in dead:
+                r = int(rows[i])
+                store._enqueue_garbage(int(dtv[i]), kb[int(kidc[r])], mem[r])
         self._val_pool.clear()
         self._pool_size = 0
         self._pool_bytes = 0
@@ -502,6 +520,7 @@ class TpuMergeEngine:
         self._val_pool.clear()
         self._pool_size = 0
         self._pool_bytes = 0
+        self._el_del_touched.clear()
         self.needs_flush = False
 
     def _apply_src(self, store: KeySpace, fam: str, src_h: np.ndarray,
@@ -731,6 +750,19 @@ class TpuMergeEngine:
         if all_new:
             return self._full(sp, fill)
         return self._put_state(_pad(col[base:base + size], sp, fill))
+
+    @staticmethod
+    def _i32_up(arr: np.ndarray, fill64: int):
+        """Opportunistic int32 upload spec: halves the bytes whenever the
+        column's values fit (node ids, small counter values); the kernels
+        promote against the int64 state, so results are bit-identical."""
+        arr = np.asarray(arr)
+        if len(arr) and -(1 << 31) <= int(arr.min()) and \
+                int(arr.max()) < (1 << 31):
+            # padded rows scatter nowhere (out-of-range idx), so any
+            # representable pad value works
+            return (arr.astype(np.int32), -1)
+        return (arr, fill64)
 
     # ---------------------------------------------------- aligned-batch fold
     # R batches staging the exact same slot rows (R replica snapshots of one
@@ -985,7 +1017,8 @@ class TpuMergeEngine:
                 for p, bt_, bn_, vals in staged:
                     pb = self._pool_add(vals, rv_t=bt_, rv_node=bn_)
                     idx, dbt, dbn = self._upload_batch(
-                        p, base, sp, [(bt_, K.NEUTRAL_T), (bn_, K.NEUTRAL_T)])
+                        p, base, sp, [(bt_, K.NEUTRAL_T),
+                                      self._i32_up(bn_, K.NEUTRAL_T)])
                     t, nd, src = B.bulk_lww_src(t, nd, src, idx, dbt, dbn, pb)
                 self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp,
                                   src=src,
@@ -1121,7 +1154,8 @@ class TpuMergeEngine:
                         # neutral base plane (no counter deletes anywhere in
                         # the batch, the common case): skip uploading it
                         idx, dv, du = self._upload_batch(
-                            r, base, sp, [(v, 0), (u, K.NEUTRAL_T)])
+                            r, base, sp, [self._i32_up(v, 0),
+                                          (u, K.NEUTRAL_T)])
                         val, uuid, src = B.bulk_counters_vu_src(
                             val, uuid, src, idx, dv, du, pb)
                     else:
@@ -1292,50 +1326,40 @@ class TpuMergeEngine:
                     # src plane is ALWAYS tracked — at flush it costs one
                     # int32 download and replaces the add_t + add_node
                     # int64 downloads (4 bytes/slot vs 16) while also
-                    # resolving dict win values
+                    # resolving dict win values.
+                    #
+                    # The DEL side never touches the device here: the add
+                    # kernels don't read del_t for win decisions, and
+                    # del-merge is a plain max — applied straight to the
+                    # host column (rows are unique per staged entry, so
+                    # gather-max-scatter is collision-free).  Zero del
+                    # bytes cross the link in either direction; newly-dead
+                    # rows are queued for GC at flush (after add_t
+                    # reconstruction) via _el_del_touched.
                     src = self._src_state("el", sp)
-                    written = {"add_t", "add_node"}
+                    host_dt = store.el.del_t
                     for rows_, a_, x_, d_, vals, _hv in staged:
-                        # transfer diet: node ids fit int32 (half the an
-                        # bytes; kernels promote against the int64 state),
-                        # and a mostly-zero del side ships SPARSELY as a
-                        # separate scatter-max over just the nonzero rows
                         x_arr = np.asarray(x_)
-                        if len(x_arr) and 0 <= int(x_arr.min()) and \
-                                int(x_arr.max()) < (1 << 31):
-                            x_up = (x_arr.astype(np.int32), -1)
-                        else:
-                            x_up = (x_arr, K.NEUTRAL_T)
+                        x_up = self._i32_up(x_arr, K.NEUTRAL_T)
                         pb = self._pool_add(vals if _hv else None,
                                             add_t=a_, add_node=x_arr)
+                        idx, da, dx = self._upload_batch(
+                            rows_, base, sp, [(a_, K.NEUTRAL_T), x_up])
+                        at, an, src = B.bulk_elems_src_nodt(
+                            at, an, src, idx, da, dx, pb)
                         d_arr = np.asarray(d_)
                         nz = np.flatnonzero(d_arr)
-                        sparse_dt = len(nz) * 4 <= len(d_arr)
-                        if sparse_dt:
-                            idx, da, dx = self._upload_batch(
-                                rows_, base, sp, [(a_, K.NEUTRAL_T), x_up])
-                            at, an, src = B.bulk_elems_src_nodt(
-                                at, an, src, idx, da, dx, pb)
-                            if len(nz):
-                                rows_nz = np.asarray(rows_)[nz]
-                                np_d = K.next_pow2(len(nz))
-                                idxd = self._batch_idx(rows_nz, base, sp,
-                                                       np_d)
-                                dt = B.bulk_max1(
-                                    dt, idxd,
-                                    self._put_batch(_pad(d_arr[nz], np_d,
-                                                         0)))
-                                written.add("del_t")
-                        else:
-                            idx, da, dx, dd = self._upload_batch(
-                                rows_, base, sp,
-                                [(a_, K.NEUTRAL_T), x_up, (d_arr, 0)])
-                            at, an, dt, src = B.bulk_elems_src(
-                                at, an, dt, src, idx, da, dx, dd, pb)
-                            written.add("del_t")
+                        if len(nz):
+                            sel = np.asarray(rows_)[nz]
+                            cur = host_dt[sel]
+                            dv = d_arr[nz]
+                            adv = dv > cur
+                            if adv.any():
+                                host_dt[sel[adv]] = dv[adv]
+                                self._el_del_touched.append(sel[adv])
                     self._family_done("el", {"add_t": at, "add_node": an,
                                              "del_t": dt}, n, sp, src=src,
-                                      written=written,
+                                      written={"add_t", "add_node"},
                                       recon={"add_t": "add_t",
                                              "add_node": "add_node"})
                     return

@@ -39,18 +39,18 @@ import jax.numpy as jnp  # noqa: E402
 
 from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
-__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_max1", "bulk_lww",
+__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
            "bulk_counters", "bulk_counters_vu", "bulk_counters_vu_src",
            "bulk_counters_src", "bulk_elems",
-           "bulk_lww_src", "bulk_elems_src", "bulk_elems_src_nodt",
-           "bulk_elems_nodt"]
+           "bulk_lww_src", "bulk_elems_src_nodt", "bulk_elems_nodt"]
 
-# An element add-side without its (independent, sparse-shippable) del side
-# IS the plain LWW pair — same kernels, no duplicate _pair_win call sites:
+# An element add-side without its del side IS the plain LWW pair — same
+# kernels, no duplicate _pair_win call sites:
 #   * bulk_elems_src_nodt(at, an, src, idx, bat, ban, base)
 #   * bulk_elems_nodt(at, an, idx, bat, ban) -> (at, an, win-ignored)
-#   * bulk_max1(dt, idx, vals) — bulk_max's body is shape-agnostic
-# (aliases assigned after the definitions below)
+# (aliases assigned after the definitions below).  The element DEL side
+# never touches the device in the resident src path: del-merge is a plain
+# max the engine applies straight to the host column (engine/tpu.py).
 #
 # The *_src kernels track DEFERRED win resolution: instead of returning win
 # flags (whose download blocks the pipeline every call — fatal when the
@@ -163,23 +163,6 @@ def bulk_lww_src(t, n, src, idx, bt, bn, base):
     return t, n, src
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-def bulk_elems_src(at, an, dt, src, idx, bat, ban, bdt, base):
-    """bulk_elems with deferred win resolution (see bulk_lww_src)."""
-    size = at.shape[0]
-    ic = jnp.minimum(idx, size - 1)
-    ca, cn, cd, cs = at[ic], an[ic], dt[ic], src[ic]
-    win = _pair_win(cn, ca, ban, bat, idx < size)
-    at = at.at[idx].set(jnp.where(win, bat, ca), mode="drop",
-                        unique_indices=True)
-    an = an.at[idx].set(jnp.where(win, ban, cn), mode="drop",
-                        unique_indices=True)
-    dt = dt.at[idx].max(bdt, mode="drop", unique_indices=True)
-    src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
-                          mode="drop", unique_indices=True)
-    return at, an, dt, src
-
-
 @partial(jax.jit, donate_argnums=(0, 1, 2))
 def bulk_counters_vu_src(val, uuid, src, idx, bv, bt, base):
     """bulk_counters_vu with deferred win resolution: the merged val/uuid
@@ -244,6 +227,5 @@ def bulk_elems(at, an, dt, idx, bat, ban, bdt):
     return at, an, dt, win
 
 
-bulk_max1 = bulk_max
 bulk_elems_src_nodt = bulk_lww_src
 bulk_elems_nodt = bulk_lww

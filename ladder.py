@@ -32,8 +32,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from bench import (_uuids, chunk_batches, probe_link, time_engine,  # noqa: E402
-                   verify_store)
+from bench import _uuids, chunk_batches, time_engine, verify_store  # noqa: E402
 from constdb_tpu.crdt import semantics as S  # noqa: E402
 from constdb_tpu.engine.base import ColumnarBatch  # noqa: E402
 from constdb_tpu.engine.cpu import CpuMergeEngine  # noqa: E402

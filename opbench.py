@@ -49,13 +49,28 @@ def _wait_port(port: int, timeout: float = 20.0) -> None:
     raise SystemExit(f"server on port {port} never came up")
 
 
+def _reset_native_caches() -> None:
+    """The native tiers cache their load decision process-wide; A/B passes
+    in one process must re-evaluate CONSTDB_NO_NATIVE — otherwise the
+    'pure' client pass keeps using the C parser/encoder primed by the
+    native pass and the published floor is contaminated."""
+    from constdb_tpu.resp import codec
+    from constdb_tpu.utils import native_tables
+    codec._EXT_CACHE.clear()
+    codec._ENC_CACHE.clear()
+    native_tables._ext = None
+
+
 def run(requests: int, runs: int, pipeline: int, conns: int,
         native: bool) -> dict[str, int]:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("CONSTDB_NO_NATIVE", None)
+    os.environ.pop("CONSTDB_NO_NATIVE", None)
     if not native:
         env["CONSTDB_NO_NATIVE"] = "1"
         os.environ["CONSTDB_NO_NATIVE"] = "1"
+    _reset_native_caches()  # the CLIENT side honors the flag too
     port = _free_port()
     srv = subprocess.Popen(
         [sys.executable, "-m", "constdb_tpu.bin.server", "--port", str(port),
@@ -118,7 +133,9 @@ encode in C, the floor is the command dispatch + asyncio socket plumbing
 on the single exec loop — the deliberate single-writer trade documented
 in SURVEY.md (the reference spends extra cores on parse threads,
 reference README.md:12, src/lib.rs:138-142; this build spends C).
-Re-check the profile claim with `python opbench.py --profile`.
+Re-check the profile claim with `python opbench.py --profile`.  Encoder
+wire bytes are differentially fuzzed against the pure encoder in
+tests/test_native_resp.py.
 
 Update this file whenever the op path changes materially.
 """

@@ -365,3 +365,18 @@ def test_gc_frees_acked_tombstones():
     freed = n.gc()  # standalone: horizon = own clock
     assert freed >= 1
     assert len(list(n.ks.elem_all(kid))) == 1
+
+
+def test_incr_decr_optional_amount():
+    """INCR/DECR take an optional amount (Redis INCRBY/DECRBY folded in;
+    the reference steps by exactly 1 — type_counter.rs:169-189).  The
+    wire stays the absolute cntset total either way, so replaying the
+    log on a peer converges."""
+    node = mknode()
+    assert run(node, "incr", "c") == Int(1)
+    assert run(node, "incr", "c", "41") == Int(42)
+    assert run(node, "decr", "c", "40") == Int(2)
+    assert run(node, "decr", "c") == Int(1)
+    peer = mknode(node_id=9, start_ms=5000)
+    replay(node, peer)
+    assert run(peer, "get", "c") == Int(1)

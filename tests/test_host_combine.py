@@ -201,9 +201,12 @@ def test_hierarchical_mixed_group_combines():
     assert st.canonical() == _cpu_ref(mixed).canonical()
 
 
-def test_sparse_del_side_paths():
-    """All-add traffic never ships or re-downloads the del plane; a
-    tombstone-heavy batch takes the fused dense path — both exact."""
+def test_del_plane_never_crosses_the_link():
+    """The element DEL side is host-maintained in the src path (round-5
+    diet): the add kernels never read del_t for wins and del-merge is a
+    plain max, so zero del bytes cross the link in either direction —
+    for all-add traffic AND for tombstone-heavy batches.  Newly-dead rows
+    still reach the GC queue (via the flush-time _el_del_touched sweep)."""
     adds = []
     for r in range(3):
         n = Node(node_id=r + 1)
@@ -217,7 +220,8 @@ def test_sparse_del_side_paths():
     eng.flush(st)
     assert st.canonical() == _cpu_ref(adds).canonical()
 
-    # now a deletion-heavy batch (every member removed): dense del path
+    # deletion-heavy batch: still no device del plane, still exact, and
+    # the tombstones are queued for GC exactly like the CPU engine's
     heavy = Node(node_id=9)
     for i in range(40):
         _cmd(heavy, b"sadd", b"d%d" % (i % 8), b"x%d" % i)
@@ -227,6 +231,8 @@ def test_sparse_del_side_paths():
     eng2 = TpuMergeEngine(resident=True)
     st2 = KeySpace()
     eng2.merge_many(st2, [hb, adds[0]])
-    assert "del_t" in eng2._res["el"]["written"]
+    assert "del_t" not in eng2._res["el"]["written"]
     eng2.flush(st2)
-    assert st2.canonical() == _cpu_ref([hb, adds[0]]).canonical()
+    ref = _cpu_ref([hb, adds[0]])
+    assert st2.canonical() == ref.canonical()
+    assert sorted(st2.garbage) == sorted(ref.garbage)
