@@ -142,6 +142,10 @@ class TpuMergeEngine:
     # bulk when staged rows cover >= 1/BULK_FRACTION of the slot region
     # (resident mode always prefers bulk: there is no state upload to avoid)
     BULK_FRACTION = 8
+    # contiguous-row batches at or above this length derive their idx
+    # vector on device (iota) instead of uploading it; below it the jit
+    # dispatch overhead outweighs the saved bytes (tests lower it to 1)
+    IDX_IOTA_MIN = 4096
 
     def __init__(self, resident: bool = False, mesh=None,
                  dense_fold: str = "auto") -> None:
@@ -195,6 +199,7 @@ class TpuMergeEngine:
         # plane never touches the device in the src path); flush turns
         # newly-dead ones into GC queue entries after add_t reconstruction
         self._el_del_touched: list[np.ndarray] = []
+        self._jit_cache: dict = {}  # keyed per-shape jitted builders
         import os as _os
         self.pool_flush_bytes = int(_os.environ.get(
             "CONSTDB_POOL_FLUSH_MB", "1536")) << 20
@@ -206,7 +211,6 @@ class TpuMergeEngine:
             self._sh_state = (None, NamedSharding(mesh, PartitionSpec("kv")),
                               NamedSharding(mesh, PartitionSpec("kv", None)))
             self._sh_rep = NamedSharding(mesh, PartitionSpec())
-            self._jit_cache: dict = {}
         else:
             self._kv_n = 1
 
@@ -491,18 +495,14 @@ class TpuMergeEngine:
                                            old_dt)
         if self._el_del_touched:
             # host-maintained del side (el src path): with add_t now
-            # reconstructed, queue rows that ended up dead.  Spurious
-            # entries for rows a later add resurrected are fine — gc()
-            # re-checks liveness at collection time.
+            # reconstructed, queue rows that ended up dead.  old_dt=-1:
+            # every touched row's del_t advanced by construction, so the
+            # shared helper's "newly dead" filter reduces to at < dt.
             rows = np.unique(np.concatenate(self._el_del_touched))
             self._el_del_touched.clear()
-            at = store.el.add_t[rows]
-            dtv = store.el.del_t[rows]
-            dead = np.nonzero(at < dtv)[0]
-            kb, kidc, mem = store.key_bytes, store.el.kid, store.el_member
-            for i in dead:
-                r = int(rows[i])
-                store._enqueue_garbage(int(dtv[i]), kb[int(kidc[r])], mem[r])
+            self._enqueue_elem_garbage(
+                store, rows, store.el.add_t[rows], store.el.del_t[rows],
+                np.full(len(rows), -1, dtype=_I64))
         self._val_pool.clear()
         self._pool_size = 0
         self._pool_bytes = 0
@@ -739,11 +739,41 @@ class TpuMergeEngine:
 
     def _batch_idx(self, rows: np.ndarray, base: int, sp: int, np_: int):
         n = len(rows)
+        if n >= self.IDX_IOTA_MIN:
+            # catch-up chunks create (and re-touch) slot rows in contiguous
+            # blocks; a contiguous idx is DERIVED on device from three
+            # scalars (iota) — the int32 index vector never crosses the
+            # link.  Padded positions land at >= sp (out of range) exactly
+            # like the host-built vector's, so scatters drop them.
+            r0 = int(rows[0])
+            if int(rows[n - 1]) == r0 + n - 1 and np.array_equal(
+                    rows, np.arange(r0, r0 + n, dtype=rows.dtype)):
+                return self._iota_idx(np_)(np.int32(r0 - base),
+                                           np.int32(n), np.int32(sp))
         idx = np.empty(np_, dtype=_I32)
         idx[:n] = rows - base
         if np_ > n:
             idx[n:] = sp + np.arange(np_ - n, dtype=_I32)
         return self._put_batch(idx)
+
+    def _iota_idx(self, np_: int):
+        """Jitted idx builder for one padded batch length (cached).  On a
+        mesh the idx replicates like every other batch array (out
+        sharding = self._sh_rep) so downstream kernels never mix device
+        commitments."""
+        key = ("iota_idx", np_)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            jnp = self._jax.numpy
+
+            def make(r0, n, sp_):
+                i = self._jax.lax.iota(jnp.int32, np_)
+                return jnp.where(i < n, r0 + i, sp_ + i)
+
+            fn = self._jax.jit(make, out_shardings=self._sh_rep) \
+                if self._mesh is not None else self._jax.jit(make)
+            self._jit_cache[key] = fn
+        return fn
 
     def _state_up(self, col: np.ndarray, base: int, size: int, sp: int,
                   fill: int, all_new: bool):

@@ -169,6 +169,29 @@ def test_reconstructed_columns_bit_identical_to_downloads():
         _cpu_ref(chunks).canonical()
 
 
+def test_device_iota_idx_matches_uploaded_idx():
+    """Contiguous-row batches derive their scatter index on device from
+    three scalars (engine IDX_IOTA_MIN); the merged store must be
+    bit-identical to the uploaded-index path AND to the CPU engine —
+    including non-contiguous batches that must keep uploading."""
+    import bench
+    chunks = []
+    for b in bench.make_workload(2500, 4, seed=31):
+        chunks.extend(batch_chunks(b, 600))
+
+    def run(iota_min: int) -> KeySpace:
+        eng = TpuMergeEngine(resident=True)
+        eng.IDX_IOTA_MIN = iota_min
+        st = KeySpace()
+        for i in range(0, len(chunks), 4):
+            eng.merge_many(st, chunks[i:i + 4])
+        eng.flush(st)
+        return st
+
+    a, b = run(1), run(1 << 60)
+    assert a.canonical() == b.canonical() == _cpu_ref(chunks).canonical()
+
+
 def test_mixed_streaming_groups_match_cpu():
     """Streaming grouped catch-up from several replicas (the bench shape,
     interleaved chunk arrival) stays exact across group boundaries."""

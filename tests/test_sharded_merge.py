@@ -118,6 +118,31 @@ def test_kv_sharded_engine_at_scale():
 
 
 @needs_mesh
+def test_kv_sharded_engine_device_iota_idx():
+    """The device-derived (iota) idx must carry the replicated mesh
+    sharding — mixing a default-device idx with kv-sharded state would
+    crash or silently degrade the bulk kernels.  Forced on (threshold 1)
+    at small scale, canonical()-checked against the CPU engine."""
+    import bench
+    from constdb_tpu.engine.cpu import CpuMergeEngine
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+    from constdb_tpu.parallel import engine_mesh
+    from constdb_tpu.store.keyspace import KeySpace
+
+    batches = bench.make_workload(3000, 4, seed=41)
+    eng = TpuMergeEngine(resident=True, mesh=engine_mesh(8))
+    eng.IDX_IOTA_MIN = 1
+    st = KeySpace()
+    eng.merge_many(st, batches)
+    eng.flush(st)
+    oracle = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in batches:
+        cpu.merge(oracle, b)
+    assert st.canonical() == oracle.canonical()
+
+
+@needs_mesh
 def test_row0_wins_ties_across_rep_shards():
     """The local-state row (global row 0) must win exact (t, node) ties even
     when the tying replica row lives on another rep shard."""
