@@ -56,8 +56,11 @@ def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
     arr = np.asarray(arr)
     if len(arr) == size:
         return arr
-    out = np.full((size,) + arr.shape[1:], fill, dtype=arr.dtype)
+    # empty + two slice writes touches each element once (np.full would
+    # write the fill over the whole buffer first)
+    out = np.empty((size,) + arr.shape[1:], dtype=arr.dtype)
     out[: len(arr)] = arr
+    out[len(arr):] = fill
     return out
 
 
@@ -997,12 +1000,12 @@ class TpuMergeEngine:
     # ------------------------------------------------------------ registers
 
     def _merge_registers(self, store: KeySpace, resolved) -> None:
+        from ..utils.native_tables import nonnull_mask
         staged = []  # (pos=kids, t, node, vals)
         for b, kid_of in resolved:
             if not b.n_keys:
                 continue
-            has = np.fromiter((v is not None for v in b.reg_val),
-                              dtype=bool, count=b.n_keys)
+            has = nonnull_mask(b.reg_val)
             idx = np.nonzero((kid_of >= 0) & (b.key_enc == S.ENC_BYTES) & has)[0]
             if len(idx):
                 staged.append((kid_of[idx], b.reg_t[idx], b.reg_node[idx],
@@ -1316,9 +1319,11 @@ class TpuMergeEngine:
                 store.el_member.extend(members[i] for i in pos.tolist())
                 store.el_val.extend([None] * n_new)
             vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
+            # list.count scans at C speed — the per-row generator was a
+            # top dispatch cost at the 10M scale
             staged.append((rows, b.el_add_t[keep], b.el_add_node[keep],
                            b.el_del_t[keep], vals,
-                           any(v is not None for v in vals)))
+                           len(vals) != vals.count(None)))
         if not staged:
             return
         def _fold_el(st):

@@ -29,6 +29,18 @@ _lib = None
 _ext = None
 
 
+def nonnull_mask(items: list):
+    """Bool ndarray marking entries that are not None — C-speed when the
+    extension is built (the per-row generator over multi-million-row
+    value columns is a top merge-dispatch cost), pure-Python otherwise."""
+    import numpy as np
+    ext = load_ext()
+    if ext is not None and hasattr(ext, "nonnull_mask"):
+        return np.frombuffer(ext.nonnull_mask(items), dtype=bool)
+    return np.fromiter((v is not None for v in items), dtype=bool,
+                       count=len(items))
+
+
 def load_ext():
     """The CPython extension module, or None.  CONSTDB_NO_NATIVE=1 forces
     the pure-Python tiers (A/B floor measurement — opbench.py)."""

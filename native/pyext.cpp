@@ -268,7 +268,31 @@ PyObject* py_i64_get_or_assign_batch(PyObject*, PyObject* args) {
     return PyLong_FromLongLong(next - start);
 }
 
+// Bool mask of non-None entries of a list, returned as raw bytes (the
+// Python side views it as a bool ndarray).  The per-row `v is not None`
+// generator over multi-million-row value columns is one of the largest
+// host costs in the merge dispatch (engine/tpu.py staging).
+static PyObject* py_nonnull_mask(PyObject*, PyObject* args) {
+    PyObject* lst;
+    if (!PyArg_ParseTuple(args, "O", &lst)) return nullptr;
+    if (!PyList_CheckExact(lst)) {
+        PyErr_SetString(PyExc_TypeError, "nonnull_mask expects a list");
+        return nullptr;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(lst);
+    // bytearray (not bytes): numpy views over it stay WRITABLE, matching
+    // the pure-Python fallback's mutability contract
+    PyObject* out = PyByteArray_FromStringAndSize(nullptr, n);
+    if (!out) return nullptr;
+    char* p = PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++)
+        p[i] = PyList_GET_ITEM(lst, i) != Py_None;
+    return out;
+}
+
 PyMethodDef methods[] = {
+    {"nonnull_mask", py_nonnull_mask, METH_VARARGS,
+     "nonnull_mask(list) -> bytearray bool mask of non-None entries"},
     {"strtab_new", py_strtab_new, METH_VARARGS, ""},
     {"strtab_len", py_strtab_len, METH_VARARGS, ""},
     {"strtab_get_or_insert", py_strtab_get_or_insert, METH_VARARGS, ""},

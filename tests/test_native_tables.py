@@ -104,3 +104,24 @@ def test_native_available():
     a hard failure instead of a skip."""
     assert nt.load_native() is not None
     assert nt.load_ext() is not None
+
+
+def test_nonnull_mask_tiers_agree_and_are_writable():
+    """nonnull_mask: native and pure tiers must return the same mask with
+    the same mutability contract (the ext path once returned a read-only
+    view — in-place callers would pass pure-tier tests then crash in
+    production)."""
+    import numpy as np
+
+    from constdb_tpu.utils.native_tables import load_ext, nonnull_mask
+
+    items = [None, b"", b"x", None, b"yy"] * 7 + [None]
+    got = nonnull_mask(items)
+    want = np.fromiter((v is not None for v in items), dtype=bool,
+                       count=len(items))
+    np.testing.assert_array_equal(got, want)
+    assert got.flags.writeable
+    got[0] = True  # must not raise on either tier
+    if load_ext() is None:
+        import pytest
+        pytest.skip("native .so not built; pure tier verified")
