@@ -66,6 +66,17 @@ class ColumnarBatch:
     # False so the engine's dense path (last-write-per-slot placement) is
     # skipped in favor of the duplicate-safe scatter reduction.
     rows_unique_per_slot: bool = False
+    # identity tokens (not serialized): chunks sliced from batches that
+    # SHARE their key/element plane objects — replica snapshots of one
+    # keyspace — carry equal tokens, letting the engine resolve each
+    # distinct shape once instead of once per replica (batch_chunks sets
+    # them; engine/tpu.py merge_many / _merge_elem_rows memoize on them).
+    # Equal tokens guarantee equal content: they embed the ids of the
+    # parent objects plus the slice bounds, and `shape_refs` pins those
+    # parents alive so the ids cannot be recycled while a chunk exists.
+    key_shape: object = None
+    el_shape: object = None
+    shape_refs: object = field(default=None, repr=False)
 
     @property
     def n_keys(self) -> int:

@@ -280,7 +280,9 @@ class TpuMergeEngine:
             n = len(vals)
             # count the real pinned payload, not just pointers: the
             # auto-flush bound must trip on value-heavy ingests too
-            nbytes += 8 * n + sum(len(v) for v in vals if v is not None)
+            # (filter(None) drops None at C speed; empty bytes are falsy
+            # too, but len(b"") contributes 0 anyway)
+            nbytes += 8 * n + sum(map(len, filter(None, vals)))
         for a in cols.values():
             n = len(a)
             nbytes += int(getattr(a, "nbytes", 8 * n))
@@ -389,15 +391,19 @@ class TpuMergeEngine:
         # _resident_state (KeySpace.fam_ver): an op write to one CRDT
         # plane no longer drops every other plane's device mirror
         self._n0_keys = store.keys.n
-        # replica snapshots of one keyspace often share the key-list object;
-        # resolve each distinct list once (ids are stable within this merge)
-        memo: dict[int, np.ndarray] = {}
+        # replica snapshots of one keyspace share the key-list object (or,
+        # when chunked, a key_shape identity token — batch_chunks); resolve
+        # each distinct list/shape once (ids are stable within this merge,
+        # and shape tokens pin their parents via shape_refs)
+        memo: dict = {}
         resolved = []
         for b in batches:
-            kid_of = memo.get(id(b.keys))
+            mk = b.key_shape if b.key_shape is not None \
+                else ("id", id(b.keys), id(b.key_enc))
+            kid_of = memo.get(mk)
             if kid_of is None:
                 kid_of = self._resolve_keys(store, b, st)
-                memo[id(b.keys)] = kid_of
+                memo[mk] = kid_of
             resolved.append((b, kid_of))
         import time as _time
         for fam, call in (("env", lambda: self._merge_envelopes(store, resolved)),
@@ -542,13 +548,13 @@ class TpuMergeEngine:
         bases = np.fromiter((b for b, _, _ in pool), dtype=_I64,
                             count=len(pool))
         segs_all = np.searchsorted(bases, gids_all, side="right") - 1
+        order = np.argsort(segs_all, kind="stable")
+        uniq, starts = np.unique(segs_all[order], return_index=True)
+        ends = np.append(starts[1:], len(order))
         # (a) column reconstruction, vectorized one pool segment at a time
         recon = res.get("recon")
         if recon:
             table = _host_table(store, fam)
-            order = np.argsort(segs_all, kind="stable")
-            uniq, starts = np.unique(segs_all[order], return_index=True)
-            ends = np.append(starts[1:], len(order))
             for s, lo, hi in zip(uniq.tolist(), starts.tolist(),
                                  ends.tolist()):
                 sel = order[lo:hi]
@@ -558,23 +564,41 @@ class TpuMergeEngine:
                 for host_col, pool_col in recon.items():
                     table.col(host_col)[r_sel] = \
                         np.asarray(cols[pool_col])[off]
-        # (b) win values
+        # (b) win values — per SEGMENT, not per row: catch-up slots are
+        # created in contiguous blocks, so most segments assign via one
+        # C-speed list-slice write (the per-row loop with a pool lookup
+        # each iteration dominated value-heavy flushes)
         if fam == "cnt":
             return  # counters carry no object values
         if fam == "reg":
-            mask = np.ones(len(rows_all), dtype=bool)
+            vmask = np.ones(len(rows_all), dtype=bool)
             target = store.reg_val
         else:
-            mask = np.isin(store.keys.enc[store.el.kid[:n]][rows_all],
-                           S.VALUE_ENCS)
+            vmask = np.isin(store.keys.enc[store.el.kid[:n]][rows_all],
+                            S.VALUE_ENCS)
             target = store.el_val
-        for r, s, g in zip(rows_all[mask].tolist(),
-                           segs_all[mask].tolist(),
-                           gids_all[mask].tolist()):
+        for s, lo, hi in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
+            sel = order[lo:hi]
+            m = vmask[sel]
+            if not m.any():
+                continue
+            sel = sel[m]
+            r_sel = rows_all[sel]
             b, vals, _ = pool[s]
-            # vals None = an all-valueless batch: its winning rows CLEAR
-            # the slot value (CPU parity — local-loses replaces with None)
-            target[r] = vals[g - b] if vals is not None else None
+            if vals is None:
+                # all-valueless batch: winning rows CLEAR the slot value
+                # (CPU parity — local-loses replaces with None)
+                picked = [None] * len(r_sel)
+            else:
+                picked = [vals[g - b] for g in (gids_all[sel]).tolist()]
+            r0 = int(r_sel[0])
+            if int(r_sel[-1]) == r0 + len(r_sel) - 1 and np.array_equal(
+                    r_sel, np.arange(r0, r0 + len(r_sel),
+                                     dtype=r_sel.dtype)):
+                target[r0:r0 + len(r_sel)] = picked
+            else:
+                for r, v in zip(r_sel.tolist(), picked):
+                    target[r] = v
 
     # ------------------------------------------------------ resident state
 
@@ -1292,32 +1316,55 @@ class TpuMergeEngine:
                          st: MergeStats) -> None:
         n0 = store.el.n
         staged = []  # (rows, at, an, dt, vals, has_vals)
+        # replica snapshots of one keyspace share el_ki/el_member list
+        # OBJECTS (and, via the caller's key memo, the kid_of array), so
+        # their (kid, member) combos resolve to the same rows — resolve
+        # each distinct shape once instead of once per replica (the
+        # interning + slot resolution was the top dispatch cost for
+        # field-heavy workloads)
+        row_memo: dict = {}
         for b, kid_of in resolved:
             if not len(b.el_ki):
                 continue
-            kid_arr = kid_of[b.el_ki]
-            keep = np.nonzero(kid_arr >= 0)[0]
-            if not len(keep):
-                continue
-            st.elem_rows += len(keep)
-            all_kept = len(keep) == len(b.el_ki)
-            members = b.el_member if all_kept else [b.el_member[r] for r in keep]
-            # two native batch calls: intern members, then resolve/create
-            # (kid, member) combo slots — no per-row Python
-            mids, _ = store.member_index.get_or_insert_batch(members)
-            combos = (kid_arr[keep] << KeySpace.MEMBER_BITS) | mids
-            rn0 = store.el.n
-            rows, n_new = store.el_index.get_or_assign_batch(combos,
-                                                             next_val=rn0)
-            if n_new:
-                created = np.nonzero(rows >= rn0)[0]
-                uniq_rows, first = np.unique(rows[created], return_index=True)
-                pos = created[first]
-                got = store.el.append_block(n_new, kid=kid_arr[keep][pos],
-                                            add_t=0, add_node=0, del_t=0)
-                assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
-                store.el_member.extend(members[i] for i in pos.tolist())
-                store.el_val.extend([None] * n_new)
+            mk = (b.el_shape if b.el_shape is not None
+                  else ("id", id(b.el_ki), id(b.el_member)), id(kid_of))
+            cached = row_memo.get(mk)
+            if cached is not None:
+                rows, keep, all_kept = cached
+                if rows is None:
+                    continue  # nothing kept for this shape
+                st.elem_rows += len(keep)
+            else:
+                kid_arr = kid_of[b.el_ki]
+                keep = np.nonzero(kid_arr >= 0)[0]
+                if not len(keep):
+                    row_memo[mk] = (None, None, False)
+                    continue
+                st.elem_rows += len(keep)
+                all_kept = len(keep) == len(b.el_ki)
+                members = b.el_member if all_kept \
+                    else [b.el_member[r] for r in keep]
+                # two native batch calls: intern members, then
+                # resolve/create (kid, member) combo slots — no per-row
+                # Python
+                mids, _ = store.member_index.get_or_insert_batch(members)
+                combos = (kid_arr[keep] << KeySpace.MEMBER_BITS) | mids
+                rn0 = store.el.n
+                rows, n_new = store.el_index.get_or_assign_batch(
+                    combos, next_val=rn0)
+                if n_new:
+                    created = np.nonzero(rows >= rn0)[0]
+                    uniq_rows, first = np.unique(rows[created],
+                                                 return_index=True)
+                    pos = created[first]
+                    got = store.el.append_block(
+                        n_new, kid=kid_arr[keep][pos],
+                        add_t=0, add_node=0, del_t=0)
+                    assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
+                    store.el_member.extend(
+                        map(members.__getitem__, pos.tolist()))
+                    store.el_val.extend([None] * n_new)
+                row_memo[mk] = (rows, keep, all_kept)
             vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
             # list.count scans at C speed — the per-row generator was a
             # top dispatch cost at the 10M scale

@@ -302,6 +302,13 @@ def batch_chunks(batch: ColumnarBatch,
         hi = min(n, lo + chunk_keys)
         c = ColumnarBatch()
         c.rows_unique_per_slot = batch.rows_unique_per_slot
+        # identity tokens: replica chunks sliced from SHARED plane objects
+        # compare equal, so the engine resolves each shape once (the
+        # parent objects stay alive through the chunk's plane views)
+        c.key_shape = (id(batch.keys), id(batch.key_enc), lo, hi)
+        c.el_shape = (id(batch.el_ki), id(batch.el_member), lo, hi)
+        c.shape_refs = (batch.keys, batch.key_enc, batch.el_ki,
+                        batch.el_member)
         c.keys = batch.keys[lo:hi]
         c.key_enc = batch.key_enc[lo:hi]
         c.key_ct = batch.key_ct[lo:hi]

@@ -35,7 +35,10 @@ def nonnull_mask(items: list):
     value columns is a top merge-dispatch cost), pure-Python otherwise."""
     import numpy as np
     ext = load_ext()
-    if ext is not None and hasattr(ext, "nonnull_mask"):
+    # exact-list gate mirrors the C side's PyList_CheckExact: other
+    # sized iterables must take the same (pure) path on BOTH tiers
+    if type(items) is list and ext is not None and \
+            hasattr(ext, "nonnull_mask"):
         return np.frombuffer(ext.nonnull_mask(items), dtype=bool)
     return np.fromiter((v is not None for v in items), dtype=bool,
                        count=len(items))

@@ -40,11 +40,13 @@ from constdb_tpu.engine.cpu import CpuMergeEngine  # noqa: E402
 _I64 = np.int64
 
 
-def _key_plane(b: ColumnarBatch, keys, enc_val, rng):
+def _key_plane(b: ColumnarBatch, keys, enc, rng):
+    """`keys`/`enc` are SHARED across the replica batches — snapshots of
+    one keyspace really do carry identical key planes, and sharing the
+    objects lets the engine's shape memo resolve them once."""
     n = len(keys)
     b.rows_unique_per_slot = True
     b.keys = keys
-    enc = np.full(n, enc_val, dtype=np.int8)
     b.key_enc = enc
     b.key_ct = _uuids(rng, n)
     b.key_mt = b.key_ct.copy()
@@ -61,10 +63,11 @@ def gen_pncounter(n_keys, n_rep, seed=11):
     the post-INCR snapshot state of a 100k-key PN-counter keyspace."""
     rng = np.random.default_rng(seed)
     keys = [b"cnt%08d" % i for i in range(n_keys)]
+    enc = np.full(n_keys, S.ENC_COUNTER, dtype=np.int8)
     out = []
     for r in range(n_rep):
         b = ColumnarBatch()
-        _key_plane(b, keys, S.ENC_COUNTER, rng)
+        _key_plane(b, keys, enc, rng)
         b.cnt_ki = np.arange(n_keys, dtype=_I64)
         b.cnt_node = np.full(n_keys, r + 1, dtype=_I64)
         b.cnt_val = rng.integers(-10_000, 10_000, n_keys).astype(_I64)
@@ -80,11 +83,12 @@ def gen_lwwreg(n_keys, n_rep, seed=12):
     every slot resolves through the lexicographic (t, node) LWW."""
     rng = np.random.default_rng(seed)
     keys = [b"reg%08d" % i for i in range(n_keys)]
+    enc = np.full(n_keys, S.ENC_BYTES, dtype=np.int8)
     pool = [b"val-%05d" % i for i in range(2048)]
     out = []
     for r in range(n_rep):
         b = ColumnarBatch()
-        _key_plane(b, keys, S.ENC_BYTES, rng)
+        _key_plane(b, keys, enc, rng)
         idx = rng.integers(0, len(pool), n_keys)
         b.reg_val = [pool[i] for i in idx]
         b.reg_t = _uuids(rng, n_keys)
@@ -106,10 +110,11 @@ def gen_orset(n_keys, n_rep, seed=13, members_per_set=4):
     ki, midx = ki[first], midx[first]
     members = [member_pool[i] for i in midx]
     vals = [None] * len(ki)
+    enc = np.full(n_keys, S.ENC_SET, dtype=np.int8)
     out = []
     for r in range(n_rep):
         b = ColumnarBatch()
-        _key_plane(b, keys, S.ENC_SET, rng)
+        _key_plane(b, keys, enc, rng)
         b.el_ki = ki
         b.el_member = members
         b.el_val = vals
@@ -130,10 +135,11 @@ def gen_lwwhash(n_keys, n_rep, seed=14, fields=32):
     val_pool = [b"hv-%05d" % i for i in range(4096)]
     ki = np.repeat(np.arange(n_keys, dtype=_I64), fields)
     members = field_names * n_keys
+    enc = np.full(n_keys, S.ENC_DICT, dtype=np.int8)
     out = []
     for r in range(n_rep):
         b = ColumnarBatch()
-        _key_plane(b, keys, S.ENC_DICT, rng)
+        _key_plane(b, keys, enc, rng)
         b.el_ki = ki
         b.el_member = members
         vidx = rng.integers(0, len(val_pool), len(ki))
