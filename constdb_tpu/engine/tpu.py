@@ -935,10 +935,14 @@ class TpuMergeEngine:
         staged = []  # (pos, [ct, mt, dt, exp])
         for b, kid_of in resolved:
             valid = np.nonzero(kid_of >= 0)[0]
-            if len(valid):
-                staged.append((kid_of[valid],
-                               [b.key_ct[valid], b.key_mt[valid],
-                                b.key_dt[valid], b.key_expire[valid]]))
+            if not len(valid):
+                continue
+            # slice(None) when nothing was conflict-skipped (the common
+            # case): indexing with it returns VIEWS, not copies
+            sel = slice(None) if len(valid) == len(kid_of) else valid
+            staged.append((kid_of[sel],
+                           [b.key_ct[sel], b.key_mt[sel],
+                            b.key_dt[sel], b.key_expire[sel]]))
         if not staged:
             return
         staged = self._combine_groups(
@@ -1164,15 +1168,17 @@ class TpuMergeEngine:
             if not len(keep):
                 continue
             st.counter_rows += len(keep)
+            # slice(None) when every row was kept: views, not copies
+            sel = slice(None) if len(keep) == len(kid_arr) else keep
             # vectorized combo keys: node ids -> dense ranks via the (tiny)
             # per-batch unique node set, then (kid << RANK_BITS) | rank
-            uniq_nodes, inv = np.unique(b.cnt_node[keep], return_inverse=True)
+            uniq_nodes, inv = np.unique(b.cnt_node[sel], return_inverse=True)
             ranks = np.fromiter((store.rank_of(int(x)) for x in uniq_nodes),
                                 dtype=_I64, count=len(uniq_nodes))
-            combos = (kid_arr[keep] << _RANK_BITS) | ranks[inv]
+            combos = (kid_arr[sel] << _RANK_BITS) | ranks[inv]
             rows = self._resolve_cnt_rows(store, combos)
-            staged.append((rows, b.cnt_val[keep], b.cnt_uuid[keep],
-                           b.cnt_base[keep], b.cnt_base_t[keep]))
+            staged.append((rows, b.cnt_val[sel], b.cnt_uuid[sel],
+                           b.cnt_base[sel], b.cnt_base_t[sel]))
         if not staged:
             return
         def _fold_cnt(st):
@@ -1377,9 +1383,11 @@ class TpuMergeEngine:
                 row_memo[mk] = (rows, keep, all_kept)
             vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
             # list.count scans at C speed — the per-row generator was a
-            # top dispatch cost at the 10M scale
-            staged.append((rows, b.el_add_t[keep], b.el_add_node[keep],
-                           b.el_del_t[keep], vals,
+            # top dispatch cost at the 10M scale.  slice(None) when every
+            # row was kept: views, not copies.
+            esel = slice(None) if all_kept else keep
+            staged.append((rows, b.el_add_t[esel], b.el_add_node[esel],
+                           b.el_del_t[esel], vals,
                            len(vals) != vals.count(None)))
         if not staged:
             return
