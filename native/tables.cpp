@@ -63,8 +63,8 @@ struct StrTable {
         mask = cap - 1;
     }
 
-    void grow() {
-        size_t cap = slots.size() * 2;
+    void grow_to(size_t cap) {
+        if (cap <= slots.size()) return;
         std::vector<int64_t> ns(cap, 0);
         std::vector<uint64_t> nh(cap, 0);
         size_t nm = cap - 1;
@@ -78,6 +78,19 @@ struct StrTable {
         slots.swap(ns);
         hashes.swap(nh);
         mask = nm;
+    }
+
+    void grow() { grow_to(slots.size() * 2); }
+
+    // presize for `extra` further inserts: one rehash up front instead of
+    // several mid-batch doublings.  Gated to the bulk-ingest shape
+    // (extra dominates count AND the worst case would trip growth) so a
+    // duplicate-heavy re-merge or a small batch into a big healthy table
+    // cannot force a rehash or permanently overallocate.
+    void reserve_extra(size_t extra) {
+        if (extra <= count) return;
+        if ((count + extra) * 10 < slots.size() * 7) return;
+        grow_to(next_pow2((count + extra) * 2));
     }
 
     inline bool eq(int64_t id, const uint8_t* p, int64_t len) const {
@@ -134,6 +147,7 @@ int64_t cst_strtab_lookup(StrTable* t, const uint8_t* p, int64_t len) {
 int64_t cst_strtab_get_or_insert_batch(StrTable* t, const uint8_t* blob,
                                        const int64_t* offs, int64_t n,
                                        int64_t* out_ids) {
+    t->reserve_extra((size_t)n);
     int64_t before = (int64_t)t->count;
     for (int64_t i = 0; i < n; i++)
         out_ids[i] = t->get_or_insert(blob + offs[i], offs[i + 1] - offs[i]);
@@ -193,6 +207,15 @@ struct I64Table {
     inline void maybe_grow() {
         if (used * 10 >= keys.size() * 7)
             rehash(count * 10 >= keys.size() * 4 ? keys.size() * 2 : keys.size());
+    }
+
+    // presize for `extra` further inserts: one up-front rehash instead of
+    // several mid-batch doublings.  Same bulk-ingest gate as StrTable:
+    // never triggered by small batches or duplicate-heavy re-merges.
+    void reserve_extra(size_t extra) {
+        if (extra <= count) return;
+        if ((count + extra) * 10 < keys.size() * 7) return;
+        rehash(next_pow2((count + extra) * 2));
     }
 
     int64_t get(int64_t k, int64_t dflt) const {
@@ -259,6 +282,7 @@ void cst_i64_lookup_batch(I64Table* t, const int64_t* ks, int64_t n,
 
 void cst_i64_put_batch(I64Table* t, const int64_t* ks, const int64_t* vs,
                        int64_t n) {
+    t->reserve_extra((size_t)n);
     for (int64_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
 }
 
@@ -266,6 +290,7 @@ void cst_i64_put_batch(I64Table* t, const int64_t* ks, const int64_t* vs,
 // order); returns the count of newly assigned keys.
 int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
                                     int64_t next, int64_t* out) {
+    t->reserve_extra((size_t)n);
     int64_t start = next;
     for (int64_t i = 0; i < n; i++) {
         int64_t v = t->get(ks[i], INT64_MIN);
