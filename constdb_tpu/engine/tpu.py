@@ -49,7 +49,6 @@ log = logging.getLogger(__name__)
 
 _I64 = np.int64
 _I32 = np.int32
-_RANK_BITS = KeySpace.NODE_RANK_BITS
 
 
 def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
@@ -1170,13 +1169,8 @@ class TpuMergeEngine:
             st.counter_rows += len(keep)
             # slice(None) when every row was kept: views, not copies
             sel = slice(None) if len(keep) == len(kid_arr) else keep
-            # vectorized combo keys: node ids -> dense ranks via the (tiny)
-            # per-batch unique node set, then (kid << RANK_BITS) | rank
-            uniq_nodes, inv = np.unique(b.cnt_node[sel], return_inverse=True)
-            ranks = np.fromiter((store.rank_of(int(x)) for x in uniq_nodes),
-                                dtype=_I64, count=len(uniq_nodes))
-            combos = (kid_arr[sel] << _RANK_BITS) | ranks[inv]
-            rows = self._resolve_cnt_rows(store, combos)
+            rows = self._resolve_cnt_rows(store, kid_arr[sel],
+                                          b.cnt_node[sel])
             staged.append((rows, b.cnt_val[sel], b.cnt_uuid[sel],
                            b.cnt_base[sel], b.cnt_base_t[sel]))
         if not staged:
@@ -1309,22 +1303,36 @@ class TpuMergeEngine:
             store.recompute_counter_sums()
         # else: sums re-derived in one pass by merge_many
 
-    def _resolve_cnt_rows(self, store: KeySpace, combos: np.ndarray) -> np.ndarray:
-        """(kid, node) combo keys -> store cnt rows, bulk-creating missing
-        slots as neutral (val=0, t=NEUTRAL_T)."""
-        n0 = store.cnt.n
-        rows, n_new = store.cnt_index.get_or_assign_batch(combos, next_val=n0)
-        if n_new:
-            created = np.nonzero(rows >= n0)[0]
-            uniq_rows, first = np.unique(rows[created], return_index=True)
-            cc = combos[created[first]]
-            nodes = np.asarray(store.node_ids, dtype=_I64)[
-                cc & ((1 << _RANK_BITS) - 1)]
-            got = store.cnt.append_block(
-                n_new, kid=cc >> _RANK_BITS, node=nodes, val=0,
-                uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
-            assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
-        return rows
+    def _resolve_cnt_rows(self, store: KeySpace, kids: np.ndarray,
+                          nodes: np.ndarray) -> np.ndarray:
+        """(kid, node) pairs -> store cnt rows via the per-rank direct
+        index (KeySpace.cnt_rank_rows_arr): one vectorized gather per
+        distinct origin node — replica batches carry one or few — with
+        missing slots bulk-created as neutral (val=0, t=NEUTRAL_T)."""
+        uniq_nodes, inv = np.unique(nodes, return_inverse=True)
+        out = np.empty(len(kids), dtype=_I64)
+        one = len(uniq_nodes) == 1
+        for i, node in enumerate(uniq_nodes.tolist()):
+            sel = slice(None) if one else np.nonzero(inv == i)[0]
+            k = kids[sel]
+            # size each rank's array only to the kids IT touches — a node
+            # owning a few slots must not pay an O(keys.n) array
+            arr = store.cnt_rank_rows_arr(store.rank_of(int(node)),
+                                          int(k.max()) + 1)
+            got = arr[k].astype(_I64)
+            miss = got < 0
+            if miss.any():
+                # a raw op-stream batch may repeat a (kid, node): one row
+                # per unique missing kid
+                mk = k[miss]
+                uk = np.unique(mk)
+                new_rows = store.cnt.append_block(
+                    len(uk), kid=uk, node=int(node), val=0,
+                    uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
+                arr[uk] = new_rows.astype(np.int32)
+                got[miss] = arr[mk]
+            out[sel] = got
+        return out
 
     # ------------------------------------------------------------- elements
 

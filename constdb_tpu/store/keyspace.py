@@ -15,7 +15,9 @@ Tables:
           sum cache.  `key_index` (StrTable) maps key bytes -> row, and row
           ids ARE interner ids (both assign in insertion order).
   cnt   — one row per (key, node) counter slot: val, uuid, base, base_t.
-          `cnt_index` (I64Dict) maps (kid << NODE_RANK_BITS | rank) -> row.
+          `cnt_rank_rows` maps node rank -> direct (kid -> row) int32
+          array, so slot resolution is a vectorized gather, not a hash
+          probe per row.
   el    — one row per set-member / dict-field: add_t, add_node, del_t;
           member/value bytes in side lists.  `member_index` (StrTable)
           interns member bytes; `el_index` (I64Dict) maps
@@ -87,7 +89,11 @@ class KeySpace:
         self.fam_ver: dict[str, int] = dict.fromkeys(FAMILIES, 0)
 
         self.cnt = _CntCols()
-        self.cnt_index = I64Dict(4096)
+        # per-rank direct (kid -> cnt row) index arrays: counter slot
+        # resolution is a vectorized gather (engine) or one array read
+        # (op path) instead of a hash probe per row.  int32 rows, -1 =
+        # absent; grown lazily per rank actually seen.
+        self.cnt_rank_rows: dict[int, np.ndarray] = {}
         # per-kid row lists are derived lazily from the columns (bulk merges
         # append millions of rows; only point reads need the lists)
         self.cnt_rows_by_kid: dict[int, list[int]] = {}
@@ -235,14 +241,28 @@ class KeySpace:
             self.node_ids.append(node)
         return r
 
+    def cnt_rank_rows_arr(self, rank: int, need: int) -> np.ndarray:
+        """The rank's (kid -> cnt row) array, grown (fill -1) to cover at
+        least `need` kids.  Rows are int32 (a keyspace cannot exceed 2^31
+        counter slots before exhausting memory ~100x over)."""
+        arr = self.cnt_rank_rows.get(rank)
+        if arr is None or len(arr) < need:
+            cap = 1 << max(need - 1, 1023).bit_length()
+            new = np.full(cap, -1, dtype=np.int32)
+            if arr is not None:
+                new[: len(arr)] = arr
+            self.cnt_rank_rows[rank] = new
+            arr = new
+        return arr
+
     def _cnt_row(self, kid: int, node: int) -> int:
         """Existing or fresh (both pairs unwritten) slot row."""
-        combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
-        row = self.cnt_index.get(combo, -1)
+        arr = self.cnt_rank_rows_arr(self.rank_of(node), kid + 1)
+        row = int(arr[kid])
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=0, uuid=self.NEUTRAL_T,
                                   base=0, base_t=self.NEUTRAL_T)
-            self.cnt_index.put(combo, row)
+            arr[kid] = row
         return row
 
     def _sync_cnt_lists(self) -> None:
@@ -604,7 +624,9 @@ class KeySpace:
         reference src/lib.rs:63-78 leans on jemalloc the same way)."""
         return {
             "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
-                              + self.el.nbytes()),
+                              + self.el.nbytes()
+                              + sum(a.nbytes
+                                    for a in self.cnt_rank_rows.values())),
             "keys": self.keys.n,
             "counter_slots": self.cnt.n,
             "element_rows": self.el.n,

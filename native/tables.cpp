@@ -63,8 +63,7 @@ struct StrTable {
         mask = cap - 1;
     }
 
-    void grow_to(size_t cap) {
-        if (cap <= slots.size()) return;
+    void rebuild(size_t cap) {
         std::vector<int64_t> ns(cap, 0);
         std::vector<uint64_t> nh(cap, 0);
         size_t nm = cap - 1;
@@ -80,6 +79,10 @@ struct StrTable {
         mask = nm;
     }
 
+    void grow_to(size_t cap) {
+        if (cap > slots.size()) rebuild(cap);
+    }
+
     void grow() { grow_to(slots.size() * 2); }
 
     // presize for `extra` further inserts: one rehash up front instead of
@@ -91,6 +94,17 @@ struct StrTable {
         if (extra <= count) return;
         if ((count + extra) * 10 < slots.size() * 7) return;
         grow_to(next_pow2((count + extra) * 2));
+    }
+
+    // after a batch: a reserve sized for batch-INTERNAL duplicates that
+    // never materialized leaves the table nearly empty — rehash the few
+    // live entries down (ids are stable; only the slot vectors shrink;
+    // the 0.2 shrink vs 0.5 post-reserve load gives hysteresis)
+    void maybe_shrink() {
+        size_t want = next_pow2(count * 4 + 16);
+        if (slots.size() > 4096 && count * 10 < slots.size() * 2 &&
+            want < slots.size())
+            rebuild(want);
     }
 
     inline bool eq(int64_t id, const uint8_t* p, int64_t len) const {
@@ -151,6 +165,7 @@ int64_t cst_strtab_get_or_insert_batch(StrTable* t, const uint8_t* blob,
     int64_t before = (int64_t)t->count;
     for (int64_t i = 0; i < n; i++)
         out_ids[i] = t->get_or_insert(blob + offs[i], offs[i + 1] - offs[i]);
+    t->maybe_shrink();
     return (int64_t)t->count - before;
 }
 
@@ -216,6 +231,15 @@ struct I64Table {
         if (extra <= count) return;
         if ((count + extra) * 10 < keys.size() * 7) return;
         rehash(next_pow2((count + extra) * 2));
+    }
+
+    // post-batch: undo a reserve that batch-internal duplicates left
+    // nearly empty (see StrTable::maybe_shrink)
+    void maybe_shrink() {
+        size_t want = next_pow2(count * 4 + 16);
+        if (keys.size() > 4096 && count * 10 < keys.size() * 2 &&
+            want < keys.size())
+            rehash(want);
     }
 
     int64_t get(int64_t k, int64_t dflt) const {
@@ -284,6 +308,7 @@ void cst_i64_put_batch(I64Table* t, const int64_t* ks, const int64_t* vs,
                        int64_t n) {
     t->reserve_extra((size_t)n);
     for (int64_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
+    t->maybe_shrink();
 }
 
 // missing keys get sequential values starting at `next` (first-occurrence
@@ -292,6 +317,7 @@ int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
                                     int64_t next, int64_t* out) {
     t->reserve_extra((size_t)n);
     int64_t start = next;
+    // (maybe_shrink below undoes an over-eager reserve)
     for (int64_t i = 0; i < n; i++) {
         int64_t v = t->get(ks[i], INT64_MIN);
         if (v == INT64_MIN) {
@@ -300,6 +326,7 @@ int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
         }
         out[i] = v;
     }
+    t->maybe_shrink();
     return next - start;
 }
 
