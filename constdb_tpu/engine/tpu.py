@@ -1315,19 +1315,20 @@ class TpuMergeEngine:
         for i, node in enumerate(uniq_nodes.tolist()):
             sel = slice(None) if one else np.nonzero(inv == i)[0]
             k = kids[sel]
-            # size each rank's array only to the kids IT touches — a node
-            # owning a few slots must not pay an O(keys.n) array
-            arr = store.cnt_rank_rows_arr(store.rank_of(int(node)),
-                                          int(k.max()) + 1)
-            got = arr[k].astype(_I64)
+            # the window covers only the kid RANGE this rank touches — a
+            # node owning a few slots must not pay an O(keys.n) array
+            base, arr = store.cnt_rank_rows_arr(
+                store.rank_of(int(node)), int(k.min()), int(k.max()) + 1)
+            kb = k - base if base else k
+            got = arr[kb].astype(_I64)
             miss = got < 0
             if miss.any():
                 # a raw op-stream batch may repeat a (kid, node): one row
                 # per unique missing kid
-                mk = k[miss]
+                mk = kb[miss]
                 uk = np.unique(mk)
                 new_rows = store.cnt.append_block(
-                    len(uk), kid=uk, node=int(node), val=0,
+                    len(uk), kid=uk + base, node=int(node), val=0,
                     uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
                 arr[uk] = new_rows.astype(np.int32)
                 got[miss] = arr[mk]

@@ -89,11 +89,13 @@ class KeySpace:
         self.fam_ver: dict[str, int] = dict.fromkeys(FAMILIES, 0)
 
         self.cnt = _CntCols()
-        # per-rank direct (kid -> cnt row) index arrays: counter slot
+        # per-rank direct (kid -> cnt row) index windows: counter slot
         # resolution is a vectorized gather (engine) or one array read
-        # (op path) instead of a hash probe per row.  int32 rows, -1 =
-        # absent; grown lazily per rank actually seen.
-        self.cnt_rank_rows: dict[int, np.ndarray] = {}
+        # (op path) instead of a hash probe per row.  Each rank holds
+        # (base, int32 array) covering only the kid RANGE it has touched
+        # (-1 = absent), so a node owning a handful of high-kid slots
+        # costs KBs, not O(keys.n).
+        self.cnt_rank_rows: dict[int, tuple[int, np.ndarray]] = {}
         # per-kid row lists are derived lazily from the columns (bulk merges
         # append millions of rows; only point reads need the lists)
         self.cnt_rows_by_kid: dict[int, list[int]] = {}
@@ -241,28 +243,37 @@ class KeySpace:
             self.node_ids.append(node)
         return r
 
-    def cnt_rank_rows_arr(self, rank: int, need: int) -> np.ndarray:
-        """The rank's (kid -> cnt row) array, grown (fill -1) to cover at
-        least `need` kids.  Rows are int32 (a keyspace cannot exceed 2^31
-        counter slots before exhausting memory ~100x over)."""
-        arr = self.cnt_rank_rows.get(rank)
-        if arr is None or len(arr) < need:
-            cap = 1 << max(need - 1, 1023).bit_length()
-            new = np.full(cap, -1, dtype=np.int32)
-            if arr is not None:
-                new[: len(arr)] = arr
-            self.cnt_rank_rows[rank] = new
-            arr = new
-        return arr
+    def cnt_rank_rows_arr(self, rank: int, lo: int,
+                          hi: int) -> tuple[int, np.ndarray]:
+        """The rank's (base, kid -> cnt row) window, grown (fill -1) to
+        cover kids [lo, hi).  Rows are int32 (a keyspace cannot exceed
+        2^31 counter slots before exhausting memory ~100x over)."""
+        ent = self.cnt_rank_rows.get(rank)
+        if ent is not None:
+            base, arr = ent
+            if lo >= base and hi <= base + len(arr):
+                return ent
+        nb = lo & ~1023
+        if ent is not None:
+            nb = min(nb, base)
+            top = max(base + len(arr), hi)
+        else:
+            top = hi
+        cap = 1 << max(top - nb - 1, 1023).bit_length()
+        new = np.full(cap, -1, dtype=np.int32)
+        if ent is not None:
+            new[base - nb: base - nb + len(arr)] = arr
+        self.cnt_rank_rows[rank] = (nb, new)
+        return nb, new
 
     def _cnt_row(self, kid: int, node: int) -> int:
         """Existing or fresh (both pairs unwritten) slot row."""
-        arr = self.cnt_rank_rows_arr(self.rank_of(node), kid + 1)
-        row = int(arr[kid])
+        base, arr = self.cnt_rank_rows_arr(self.rank_of(node), kid, kid + 1)
+        row = int(arr[kid - base])
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=0, uuid=self.NEUTRAL_T,
                                   base=0, base_t=self.NEUTRAL_T)
-            arr[kid] = row
+            arr[kid - base] = row
         return row
 
     def _sync_cnt_lists(self) -> None:
@@ -625,8 +636,8 @@ class KeySpace:
         return {
             "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
                               + self.el.nbytes()
-                              + sum(a.nbytes
-                                    for a in self.cnt_rank_rows.values())),
+                              + sum(a.nbytes for _, a
+                                    in self.cnt_rank_rows.values())),
             "keys": self.keys.n,
             "counter_slots": self.cnt.n,
             "element_rows": self.el.n,

@@ -97,6 +97,7 @@ PyObject* py_strtab_get_or_insert_batch(PyObject*, PyObject* args) {
     Py_buffer ob;
     if (!out_buffer(out, &ob, n)) { Py_DECREF(seq); return nullptr; }
     int64_t* dst = (int64_t*)ob.buf;
+    t->batch_begin((size_t)n);
     int64_t before = (int64_t)t->count;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject* item = PySequence_Fast_GET_ITEM(seq, i);
@@ -109,9 +110,11 @@ PyObject* py_strtab_get_or_insert_batch(PyObject*, PyObject* args) {
         }
         dst[i] = t->get_or_insert((const uint8_t*)p, (int64_t)len);
     }
+    int64_t fresh = (int64_t)t->count - before;
+    t->batch_end((size_t)n, (size_t)fresh);
     PyBuffer_Release(&ob);
     Py_DECREF(seq);
-    return PyLong_FromLongLong((int64_t)t->count - before);
+    return PyLong_FromLongLong(fresh);
 }
 
 PyObject* py_strtab_lookup_batch(PyObject*, PyObject* args) {
@@ -235,7 +238,10 @@ PyObject* py_i64_put_batch(PyObject*, PyObject* args) {
     Py_ssize_t n = kb.len / (Py_ssize_t)sizeof(int64_t);
     const int64_t* ks = (const int64_t*)kb.buf;
     const int64_t* vs = (const int64_t*)vb.buf;
+    t->batch_begin((size_t)n);
+    size_t pb_before = t->count;
     for (Py_ssize_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
+    t->batch_end((size_t)n, t->count - pb_before);
     PyBuffer_Release(&vb);
     PyBuffer_Release(&kb);
     Py_RETURN_NONE;
@@ -254,6 +260,7 @@ PyObject* py_i64_get_or_assign_batch(PyObject*, PyObject* args) {
     if (!out_buffer(out, &ob, n)) { PyBuffer_Release(&kb); return nullptr; }
     const int64_t* ks = (const int64_t*)kb.buf;
     int64_t* dst = (int64_t*)ob.buf;
+    t->batch_begin((size_t)n);
     int64_t start = next;
     for (Py_ssize_t i = 0; i < n; i++) {
         int64_t v = t->get(ks[i], INT64_MIN);
@@ -263,6 +270,7 @@ PyObject* py_i64_get_or_assign_batch(PyObject*, PyObject* args) {
         }
         dst[i] = v;
     }
+    t->batch_end((size_t)n, (size_t)(next - start));
     PyBuffer_Release(&ob);
     PyBuffer_Release(&kb);
     return PyLong_FromLongLong(next - start);

@@ -55,6 +55,7 @@ struct StrTable {
     std::vector<int64_t> lens;      // per-id length
     size_t mask = 0;
     size_t count = 0;
+    double new_ratio = 1.0;  // EMA of observed new-per-row in batches
 
     explicit StrTable(size_t cap_hint) {
         size_t cap = next_pow2(cap_hint * 2);
@@ -105,6 +106,22 @@ struct StrTable {
         if (slots.size() > 4096 && count * 10 < slots.size() * 2 &&
             want < slots.size())
             rebuild(want);
+    }
+
+    // shared batch protocol for BOTH binding tiers (ctypes and the
+    // CPython extension): presize by the learned new-row ratio, then
+    // after the loop shrink an over-eager reserve and update the EMA
+    void batch_begin(size_t n) {
+        reserve_extra((size_t)((double)n * new_ratio) + 16);
+    }
+    void batch_end(size_t n, size_t fresh) {
+        maybe_shrink();
+        if (n > 256) {
+            double r = (double)fresh / (double)n;
+            new_ratio = 0.5 * new_ratio + 0.5 * r;
+            if (new_ratio < 0.02) new_ratio = 0.02;
+            if (new_ratio > 1.0) new_ratio = 1.0;
+        }
     }
 
     inline bool eq(int64_t id, const uint8_t* p, int64_t len) const {
@@ -161,12 +178,13 @@ int64_t cst_strtab_lookup(StrTable* t, const uint8_t* p, int64_t len) {
 int64_t cst_strtab_get_or_insert_batch(StrTable* t, const uint8_t* blob,
                                        const int64_t* offs, int64_t n,
                                        int64_t* out_ids) {
-    t->reserve_extra((size_t)n);
+    t->batch_begin((size_t)n);
     int64_t before = (int64_t)t->count;
     for (int64_t i = 0; i < n; i++)
         out_ids[i] = t->get_or_insert(blob + offs[i], offs[i + 1] - offs[i]);
-    t->maybe_shrink();
-    return (int64_t)t->count - before;
+    int64_t fresh = (int64_t)t->count - before;
+    t->batch_end((size_t)n, (size_t)fresh);
+    return fresh;
 }
 
 void cst_strtab_lookup_batch(StrTable* t, const uint8_t* blob,
@@ -195,6 +213,7 @@ struct I64Table {
     size_t mask = 0;
     size_t count = 0;   // live entries
     size_t used = 0;    // live + tombstones
+    double new_ratio = 1.0;  // EMA of observed new-per-row in batches
 
     explicit I64Table(size_t cap_hint) {
         size_t cap = next_pow2(cap_hint * 2);
@@ -240,6 +259,22 @@ struct I64Table {
         if (keys.size() > 4096 && count * 10 < keys.size() * 2 &&
             want < keys.size())
             rehash(want);
+    }
+
+    // shared batch protocol for BOTH binding tiers (ctypes and the
+    // CPython extension): presize by the learned new-row ratio, then
+    // after the loop shrink an over-eager reserve and update the EMA
+    void batch_begin(size_t n) {
+        reserve_extra((size_t)((double)n * new_ratio) + 16);
+    }
+    void batch_end(size_t n, size_t fresh) {
+        maybe_shrink();
+        if (n > 256) {
+            double r = (double)fresh / (double)n;
+            new_ratio = 0.5 * new_ratio + 0.5 * r;
+            if (new_ratio < 0.02) new_ratio = 0.02;
+            if (new_ratio > 1.0) new_ratio = 1.0;
+        }
     }
 
     int64_t get(int64_t k, int64_t dflt) const {
@@ -306,18 +341,18 @@ void cst_i64_lookup_batch(I64Table* t, const int64_t* ks, int64_t n,
 
 void cst_i64_put_batch(I64Table* t, const int64_t* ks, const int64_t* vs,
                        int64_t n) {
-    t->reserve_extra((size_t)n);
+    t->batch_begin((size_t)n);
+    size_t before = t->count;
     for (int64_t i = 0; i < n; i++) t->put(ks[i], vs[i]);
-    t->maybe_shrink();
+    t->batch_end((size_t)n, t->count - before);
 }
 
 // missing keys get sequential values starting at `next` (first-occurrence
 // order); returns the count of newly assigned keys.
 int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
                                     int64_t next, int64_t* out) {
-    t->reserve_extra((size_t)n);
+    t->batch_begin((size_t)n);
     int64_t start = next;
-    // (maybe_shrink below undoes an over-eager reserve)
     for (int64_t i = 0; i < n; i++) {
         int64_t v = t->get(ks[i], INT64_MIN);
         if (v == INT64_MIN) {
@@ -326,7 +361,7 @@ int64_t cst_i64_get_or_assign_batch(I64Table* t, const int64_t* ks, int64_t n,
         }
         out[i] = v;
     }
-    t->maybe_shrink();
+    t->batch_end((size_t)n, (size_t)(next - start));
     return next - start;
 }
 
