@@ -6,6 +6,7 @@ Counter stale-uuid, order-dependent register ties; SURVEY.md §"Known
 reference defects") are exactly what these properties catch.
 """
 
+import os
 import random
 
 import pytest
@@ -13,6 +14,7 @@ import pytest
 from constdb_tpu.crdt import (ENC_BYTES, ENC_COUNTER, ENC_DICT, ENC_LIST,
                               ENC_MV, ENC_SET)
 from constdb_tpu.engine import CpuMergeEngine, batch_from_keyspace
+from constdb_tpu.engine.tpu import TpuMergeEngine
 from constdb_tpu.store import KeySpace
 
 KEYS = [b"cnt:%d" % i for i in range(4)] + [b"reg:%d" % i for i in range(4)] + \
@@ -144,3 +146,43 @@ def test_type_conflict_skipped(engine):
     st = engine.merge(a, batch_from_keyspace(b))
     assert st.type_conflicts == 1
     assert a.counter_sum(a.lookup(b"k")) == 1  # local survives
+
+
+@pytest.mark.skipif(not os.environ.get("CONSTDB_SLOW"),
+                    reason="set CONSTDB_SLOW=1 for the extended fuzz")
+def test_extended_differential_fuzz():
+    """Extended randomized differential soak (CONSTDB_SLOW): many seeds x
+    randomized chunking x randomized group sizes through the RESIDENT
+    engine, each run canonical()-checked against the CPU engine.  The
+    narrow suites pin specific paths; this sweeps their combinations."""
+    import bench
+    from constdb_tpu.persist.snapshot import batch_chunks
+
+    for seed in range(40):
+        rng = random.Random(seed)
+        n_keys = rng.choice([67, 257, 1024, 3001])
+        n_rep = rng.choice([2, 3, 5, 8])
+        chunk = rng.choice([0, 61, 129, 500])
+        group = rng.choice([1, 3, n_rep, 4 * n_rep])
+        batches = bench.make_workload(n_keys, n_rep, seed=seed + 1)
+        if chunk:
+            chunks = []
+            for b in batches:
+                chunks.extend(batch_chunks(b, chunk))
+        else:
+            chunks = batches
+        eng = TpuMergeEngine(resident=True)
+        if rng.random() < 0.3:
+            eng.IDX_IOTA_MIN = 1
+        if rng.random() < 0.3:
+            eng.pool_flush_bytes = 1 << 14
+        st = KeySpace()
+        for i in range(0, len(chunks), group):
+            eng.merge_many(st, chunks[i:i + group])
+        eng.flush(st)
+        ref = KeySpace()
+        cpu = CpuMergeEngine()
+        for b in batches:
+            cpu.merge(ref, b)
+        assert st.canonical() == ref.canonical(), \
+            (seed, n_keys, n_rep, chunk, group)
