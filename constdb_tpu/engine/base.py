@@ -77,6 +77,11 @@ class ColumnarBatch:
     key_shape: object = None
     el_shape: object = None
     shape_refs: object = field(default=None, repr=False)
+    # hint: False = PROVABLY no element values (chunks inherit their
+    # parent's one-time scan — any subset of an all-None list is all
+    # None).  True/None = values may exist; consumers re-scan their own
+    # (smaller) list with has_values().
+    el_has_vals: object = None
 
     @property
     def n_keys(self) -> int:
@@ -85,6 +90,13 @@ class ColumnarBatch:
     @property
     def n_rows(self) -> int:
         return len(self.keys) + len(self.cnt_ki) + len(self.el_ki)
+
+
+def has_values(vals: list) -> bool:
+    """Single home for the has-element-values predicate (list.count scans
+    at C speed; empty bytes count as values, only None is absent — the
+    same distinction _pool_add's byte accounting makes)."""
+    return len(vals) != vals.count(None)
 
 
 @dataclass

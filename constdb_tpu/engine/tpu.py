@@ -43,7 +43,7 @@ from ..crdt import semantics as S
 from ..ops import bulk as B
 from ..ops import segment as K
 from ..store.keyspace import FAMILIES, KeySpace
-from .base import ColumnarBatch, MergeStats
+from .base import ColumnarBatch, MergeStats, has_values
 
 log = logging.getLogger(__name__)
 
@@ -1391,13 +1391,15 @@ class TpuMergeEngine:
                     store.el_val.extend([None] * n_new)
                 row_memo[mk] = (rows, keep, all_kept)
             vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
-            # list.count scans at C speed — the per-row generator was a
-            # top dispatch cost at the 10M scale.  slice(None) when every
-            # row was kept: views, not copies.
+            # has-values: an inherited False hint is exact (any subset of
+            # an all-None list is all None) and skips the scan; anything
+            # else re-scans locally so a lone dict value in the parent
+            # cannot push every all-None sibling chunk down the value
+            # path.  slice(None) when every row was kept: views.
+            hv = b.el_has_vals is not False and has_values(vals)
             esel = slice(None) if all_kept else keep
             staged.append((rows, b.el_add_t[esel], b.el_add_node[esel],
-                           b.el_del_t[esel], vals,
-                           len(vals) != vals.count(None)))
+                           b.el_del_t[esel], vals, hv))
         if not staged:
             return
         def _fold_el(st):

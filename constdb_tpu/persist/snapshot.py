@@ -297,6 +297,12 @@ def batch_chunks(batch: ColumnarBatch,
     el_presorted = bool(len(el_arr) == 0 or (np.diff(el_arr) >= 0).all())
     el_order = None if el_presorted else np.argsort(el_arr, kind="stable")
     el_sorted = el_arr if el_presorted else el_arr[el_order]
+    # one values scan for the whole batch; chunks inherit the hint (the
+    # engine otherwise rescans per chunk per replica)
+    el_hv = batch.el_has_vals
+    if el_hv is None:
+        from ..engine.base import has_values
+        el_hv = has_values(batch.el_val)
 
     for lo in range(0, n, chunk_keys):
         hi = min(n, lo + chunk_keys)
@@ -309,6 +315,7 @@ def batch_chunks(batch: ColumnarBatch,
         c.el_shape = (id(batch.el_ki), id(batch.el_member), lo, hi)
         c.shape_refs = (batch.keys, batch.key_enc, batch.el_ki,
                         batch.el_member)
+        c.el_has_vals = el_hv
         c.keys = batch.keys[lo:hi]
         c.key_enc = batch.key_enc[lo:hi]
         c.key_ct = batch.key_ct[lo:hi]
