@@ -47,7 +47,8 @@ from typing import IO, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..engine.base import ColumnarBatch, batch_from_keyspace
+from ..engine.base import (ColumnarBatch, batch_from_keyspace,
+                           has_values)
 from ..errors import InvalidSnapshot, InvalidSnapshotChecksum
 from ..utils.checksum import StreamChecksum
 from ..utils.varint import VarintReader, write_uvarint
@@ -301,7 +302,6 @@ def batch_chunks(batch: ColumnarBatch,
     # engine otherwise rescans per chunk per replica)
     el_hv = batch.el_has_vals
     if el_hv is None:
-        from ..engine.base import has_values
         el_hv = has_values(batch.el_val)
 
     for lo in range(0, n, chunk_keys):
