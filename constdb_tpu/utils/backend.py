@@ -37,8 +37,26 @@ class BackendProbe:
     error: str = ""
 
 
-def probe_backend(timeout: float = 90.0) -> BackendProbe:
-    """Report the default backend's platform/device count, never hanging."""
+_PROBE_MEMO: list = []
+
+
+def probe_backend(timeout: float = 90.0,
+                  cached: bool = True) -> BackendProbe:
+    """Report the default backend's platform/device count, never hanging.
+    The (per-process) result is memoized by default: entry points that
+    probe more than once on one boot (e.g. __graft_entry__ entry() +
+    dryrun_multichip) pay a single subprocess init — and a wedged device
+    a single timeout — not one per call."""
+    if cached and _PROBE_MEMO:
+        return _PROBE_MEMO[0]
+    probe = _probe_backend_uncached(timeout)
+    if cached:
+        _PROBE_MEMO.clear()
+        _PROBE_MEMO.append(probe)
+    return probe
+
+
+def _probe_backend_uncached(timeout: float) -> BackendProbe:
     try:
         p = subprocess.run([sys.executable, "-c", _PROBE_SRC],
                            capture_output=True, timeout=timeout, text=True)
