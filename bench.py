@@ -42,6 +42,43 @@ _I64 = np.int64
 MS0 = 1_700_000_000_000  # fixed epoch so uuids look like real HLC values
 
 
+def ensure_native(timeout: float = 600.0) -> None:
+    """Build the native extension (native/ C++ tables + RESP codec) when
+    its artifacts are missing.  The toolchain is baked into the image and
+    the build is one `make` call; without it every interning/index batch
+    call falls back to pure-Python tiers — the single largest host
+    dispatch cost measured in the BENCH_r05 profile.  CONSTDB_AUTO_NATIVE=0
+    skips; failures degrade to the pure tiers, never abort the bench."""
+    if os.environ.get("CONSTDB_AUTO_NATIVE", "1") == "0":
+        return
+    if os.environ.get("CONSTDB_NO_NATIVE"):
+        return  # pure-tier floor measurement: building would be wasted
+    from constdb_tpu.utils import native_tables as NT
+
+    if NT.load_ext() is not None:
+        return
+    mkdir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+    if not os.path.exists(os.path.join(mkdir, "Makefile")):
+        return
+    import subprocess
+
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(["make", "-C", mkdir], capture_output=True,
+                           timeout=timeout, text=True)
+    except Exception as e:
+        print(f"[bench] native build skipped: {e}", file=sys.stderr)
+        return
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        print(f"[bench] native build failed rc={r.returncode}: "
+              f"{tail[-1] if tail else ''}", file=sys.stderr)
+        return
+    ok = NT.reload_tiers()
+    print(f"[bench] native extension built in "
+          f"{time.perf_counter() - t0:.1f}s (loaded={ok})", file=sys.stderr)
+
+
 def _uuids(rng, n, span_ms=600_000):
     # float-scaled draws: ~5x faster than bounded-integer rejection
     # sampling at the 10M scale (this is workload GENERATION — outside the
@@ -127,6 +164,14 @@ def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
     return batches
 
 
+def subsample_keys(keys, n_keys: int, target: int = 100_000) -> list:
+    """Key bytes of the verification subsample — the ONE home for the
+    every-`step`-th-key formula (subsample_workload derives from it, and
+    the bench parent uses it while the oracle replay runs in a worker)."""
+    step = max(1, n_keys // target)
+    return [keys[i] for i in range(0, n_keys, step)]
+
+
 def subsample_workload(batches, n_keys: int, target: int = 100_000):
     """Deterministic per-key filter of a workload: every `step`-th key,
     with counter/element rows remapped.  Per-key merges are independent,
@@ -134,7 +179,7 @@ def subsample_workload(batches, n_keys: int, target: int = 100_000):
     keys in the full device-merged store (bench verification)."""
     step = max(1, n_keys // target)
     keep = np.arange(0, n_keys, step)
-    sub_keys = [batches[0].keys[i] for i in keep]
+    sub_keys = subsample_keys(batches[0].keys, n_keys, target)
     out = []
     for b in batches:
         fb = ColumnarBatch()
@@ -164,25 +209,73 @@ def subsample_workload(batches, n_keys: int, target: int = 100_000):
     return out, sub_keys
 
 
-def verify_store(store, batches, n_keys: int, target: int = 100_000):
-    """Oracle check of the device-merged store: CPU-replay a deterministic
-    ~`target`-key subsample of the same workload and canonical()-compare.
-    Returns (ok, n_checked, n_diff)."""
-    sub, sub_keys = subsample_workload(batches, n_keys, target)
+def oracle_canonical(batches, n_keys: int, target: int = 100_000) -> dict:
+    """CPU-replay a deterministic ~`target`-key subsample of the workload
+    and return its canonical state (the verification oracle)."""
+    sub, _sub_keys = subsample_workload(batches, n_keys, target)
     oracle = KeySpace()
     cpu = CpuMergeEngine()
     for b in sub:
         cpu.merge(oracle, b)
-    want = oracle.canonical()
-    got = store.canonical(keys=sub_keys)
+    return oracle.canonical()
+
+
+def compare_canonical(got: dict, want: dict) -> int:
+    """Diff count between device and oracle canonical states (prints the
+    first few mismatches)."""
     if got == want:
-        return True, len(sub_keys), 0
+        return 0
     diff = [k for k in want if got.get(k) != want[k]]
     diff += [k for k in got if k not in want]
     for k in diff[:5]:
         print(f"[bench] VERIFY MISMATCH {k!r}:\n  device={got.get(k)!r}"
               f"\n  oracle={want.get(k)!r}", file=sys.stderr)
-    return False, len(sub_keys), len(diff)
+    return len(diff)
+
+
+def verify_store(store, batches, n_keys: int, target: int = 100_000):
+    """Oracle check of the device-merged store: CPU-replay a deterministic
+    ~`target`-key subsample of the same workload and canonical()-compare.
+    Returns (ok, n_checked, n_diff)."""
+    sub_keys = subsample_keys(batches[0].keys, n_keys, target)
+    want = oracle_canonical(batches, n_keys, target)
+    n_diff = compare_canonical(store.canonical(keys=sub_keys), want)
+    return n_diff == 0, len(sub_keys), n_diff
+
+
+def _oracle_worker(conn, batches, n_keys: int, target: int) -> None:
+    """Forked verify worker: sleeps on the pipe until the parent's "go"
+    (sent after the timed merges, so the replay never competes with the
+    measured run), then replays the subsample on the CPU engine and ships
+    the oracle canonical state back."""
+    try:
+        conn.recv()  # block until the timed runs complete
+        conn.send(oracle_canonical(batches, n_keys, target))
+    except BaseException as e:  # surfaced (and re-raised) by the parent
+        conn.send(e)
+    finally:
+        conn.close()
+
+
+def start_oracle(batches, n_keys: int, target: int = 100_000):
+    """Fork the oracle replay worker (copy-on-write: the workload is NOT
+    re-pickled).  MUST be called before any in-process jax init — forking
+    a JAX-threaded process can deadlock the child — which is why main()
+    generates the workload and forks ahead of the backend import; the
+    worker idles until go() anyway.  -> (process, conn), or None if fork
+    is unavailable (the caller then falls back to the serial verify)."""
+    import multiprocessing as mp
+
+    try:
+        ctx = mp.get_context("fork")
+    except ValueError:  # pragma: no cover - non-POSIX
+        return None
+    parent, child = ctx.Pipe()
+    p = ctx.Process(target=_oracle_worker,
+                    args=(child, batches, n_keys, target), daemon=True)
+    p.start()
+    child.close()
+    return p, parent
 
 
 def probe_link(jax, mb: int = 64, repeats: int = 3):
@@ -262,6 +355,11 @@ def main() -> None:
           f"{chunk}-key chunks (cpu baseline on {n_cpu} keys)",
           file=sys.stderr)
 
+    # native tables first: BOTH engines (and the oracle) resolve keys
+    # through them, and the pure-Python fallback tiers dominated the
+    # round-5 host dispatch profile
+    ensure_native()
+
     t0 = time.perf_counter()
     cpu_chunks = chunk_batches(make_workload(n_cpu, n_rep, seed=7), chunk)
     cpu_t, _ = time_engine(CpuMergeEngine, cpu_chunks, repeats=1)
@@ -269,6 +367,16 @@ def main() -> None:
     print(f"[bench] cpu engine: {cpu_t:.3f}s on {n_cpu} keys "
           f"= {cpu_rate:,.0f} keys/s (workload gen+run "
           f"{time.perf_counter() - t0:.1f}s)", file=sys.stderr)
+
+    # Workload gen BEFORE any in-process jax init: the verify oracle forks
+    # HERE (forking a JAX-threaded process is unsafe) and then idles until
+    # the timed runs complete.
+    t0 = time.perf_counter()
+    batches = make_workload(n_keys, n_rep, seed=7)
+    print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+    verify_on = os.environ.get("CONSTDB_BENCH_VERIFY", "1") != "0"
+    oracle = start_oracle(batches, n_keys) if verify_on else None
 
     # Probe the device backend OUT-OF-PROCESS before touching jax here: a
     # wedged tunnel-attached device hangs in-process init forever (round-1
@@ -303,9 +411,8 @@ def main() -> None:
           f"devices={jax.devices()}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    batches = make_workload(n_keys, n_rep, seed=7)
     chunks = chunk_batches(batches, chunk)
-    print(f"[bench] workload gen: {time.perf_counter() - t0:.1f}s "
+    print(f"[bench] chunking: {time.perf_counter() - t0:.1f}s "
           f"({len(chunks)} chunks)", file=sys.stderr)
     # default to the grouped shape: the engine's hierarchical host combine
     # folds each aligned replica-cluster and concatenates the disjoint
@@ -326,6 +433,19 @@ def main() -> None:
     tpu_t, dev_store = time_engine(make_eng, chunks, repeats=2, group=group)
     rate = n_keys / tpu_t
     eng = eng_holder["e"]
+    # wake the (pre-forked, idle) oracle worker NOW: its CPU replay
+    # overlaps the merge epilogue (link probe + device-store canonical
+    # extraction) instead of running serially after everything else
+    oracle_err = None
+    if oracle is not None:
+        try:
+            oracle[1].send("go")
+        except OSError as e:  # worker died (e.g. OOM) during the runs —
+            # the measured numbers must still reach the JSON line
+            oracle_err = str(e) or type(e).__name__
+            print(f"[bench] WARNING: verify worker died before go ({e}); "
+                  f"verification unavailable", file=sys.stderr)
+    t_verify0 = time.perf_counter()
     print(f"[bench] device engine (resident, {jax.default_backend()}, "
           f"group={group}, folds={eng.folds}): "
           f"{tpu_t:.3f}s on {n_keys} keys = {rate:,.0f} keys/s",
@@ -333,8 +453,15 @@ def main() -> None:
     fam = getattr(eng, "family_secs", {})
     if fam:
         breakdown = " ".join(f"{k}={v:.3f}s" for k, v in sorted(fam.items()))
-        print(f"[bench] stage breakdown (last run, dispatch times; flush "
-              f"includes blocking downloads): {breakdown}", file=sys.stderr)
+        print(f"[bench] stage breakdown (last run, critical-path host "
+              f"times; flush includes blocking downloads): {breakdown}",
+              file=sys.stderr)
+    stg = getattr(eng, "stage_secs", {})
+    if stg and getattr(eng, "pipeline", False):
+        overlapped = " ".join(f"{k}={v:.3f}s" for k, v in sorted(stg.items()))
+        print(f"[bench] staging (background worker, overlaps device "
+              f"compute — NOT additive with the breakdown above): "
+              f"{overlapped}", file=sys.stderr)
 
     out = {
         "metric": "snapshot_merge_keys_per_sec",
@@ -346,19 +473,10 @@ def main() -> None:
         "wall_s": round(tpu_t, 2),
         "folds": eng.folds,
         "backend": jax.default_backend(),
+        "host_secs": {k: round(v, 3) for k, v in sorted(fam.items())},
+        "stage_secs": {k: round(v, 3) for k, v in sorted(stg.items())},
+        "pipeline": getattr(eng, "pipeline", False),
     }
-
-    # ------- on-hardware correctness: oracle-verify a ~100k-key subsample
-    verified = None
-    if os.environ.get("CONSTDB_BENCH_VERIFY", "1") != "0":
-        t0 = time.perf_counter()
-        verified, n_checked, n_diff = verify_store(dev_store, batches,
-                                                   n_keys)
-        print(f"[bench] verify: {'OK' if verified else 'MISMATCH'} on "
-              f"{n_checked} sampled keys ({n_diff} diffs, "
-              f"{time.perf_counter() - t0:.1f}s)", file=sys.stderr)
-        out["verified"] = verified
-        out["verify_keys"] = n_checked
 
     # ------- measured link ceiling: what fraction of the wall is transfer
     bytes_h2d = getattr(eng, "bytes_h2d", 0)
@@ -381,6 +499,48 @@ def main() -> None:
           f"{bytes_h2d / 1e6:,.0f} MB d2h {bytes_d2h / 1e6:,.0f} MB "
           f"-> link floor {link_secs:.1f}s of {tpu_t:.1f}s wall "
           f"({100 * link_secs / tpu_t:.0f}%)", file=sys.stderr)
+
+    # ------- on-hardware correctness: oracle-verify a ~100k-key subsample.
+    # The oracle replay has been running in the forked worker since right
+    # after the timed merge; the parent extracts the device store's
+    # canonical slice in parallel and only then joins.
+    verified = None
+    if verify_on:
+        sub_keys = subsample_keys(batches[0].keys, n_keys)
+        got = dev_store.canonical(keys=sub_keys)
+        n_diff = None
+        if oracle_err is not None:
+            out["verify_error"] = oracle_err
+        elif oracle is not None:
+            p, rx = oracle
+            try:
+                want = rx.recv()
+            except (EOFError, OSError) as e:
+                # a killed worker (e.g. OOM) must not cost the whole run's
+                # JSON line — record verification as unavailable instead
+                want = e
+            finally:
+                p.join()
+            if isinstance(want, BaseException):
+                # same protection for an error the worker itself hit and
+                # shipped back (e.g. MemoryError mid-replay)
+                print(f"[bench] WARNING: verify worker failed "
+                      f"({type(want).__name__}: {want}); verification "
+                      f"unavailable", file=sys.stderr)
+                out["verify_error"] = \
+                    f"{type(want).__name__}: {want}" .strip(": ")
+            else:
+                n_diff = compare_canonical(got, want)
+        else:  # pragma: no cover - fork unavailable
+            n_diff = compare_canonical(got, oracle_canonical(batches, n_keys))
+        verified = None if n_diff is None else n_diff == 0
+        if verified is not None:
+            print(f"[bench] verify: {'OK' if verified else 'MISMATCH'} on "
+                  f"{len(sub_keys)} sampled keys ({n_diff} diffs, "
+                  f"{time.perf_counter() - t_verify0:.1f}s overlapped with "
+                  f"the epilogue)", file=sys.stderr)
+        out["verified"] = verified
+        out["verify_keys"] = len(sub_keys)
 
     if jax.default_backend() == "tpu":
         out["link_note"] = "tunnel-attached chip: wall time is host-link " \

@@ -15,8 +15,53 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import tomllib
 from dataclasses import dataclass
+
+try:
+    import tomllib  # Python >= 3.11
+except ImportError:  # pragma: no cover - exercised on 3.10 images
+    tomllib = None
+
+
+def _mini_toml_load(f) -> dict:
+    """Fallback for images without tomllib (Python 3.10): parse the flat
+    scalar subset Config actually uses — `key = value` lines with quoted
+    strings, ints, floats, booleans, and # comments.  Tables/arrays are
+    out of scope for node configs and raise."""
+    data: dict = {}
+    for lineno, raw in enumerate(f.read().decode().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            raise ValueError(
+                f"config line {lineno}: TOML tables need Python >= 3.11 "
+                f"(tomllib); node configs are flat key = value")
+        key, sep, val = line.partition("=")
+        if not sep:
+            raise ValueError(f"config line {lineno}: expected key = value")
+        key = key.strip()
+        val = val.strip()
+        if val[:1] in ('"', "'"):
+            # quoted string: close at the matching quote; anything after
+            # may only be whitespace or a comment (matches tomllib)
+            q = val[0]
+            end = val.find(q, 1)
+            rest = val[end + 1:].strip() if end > 0 else "#!bad"
+            if end <= 0 or (rest and not rest.startswith("#")):
+                raise ValueError(f"config line {lineno}: malformed string")
+            data[key] = val[1:end]
+            continue
+        if "#" in val:
+            val = val.split("#", 1)[0].strip()
+        if val in ("true", "false"):
+            data[key] = val == "true"
+        else:
+            try:
+                data[key] = int(val)
+            except ValueError:
+                data[key] = float(val)
+    return data
 
 
 @dataclass
@@ -97,7 +142,8 @@ def load_config(argv: list[str] | None = None) -> Config:
     cfg = Config()
     if ns.config:
         with open(ns.config, "rb") as f:
-            data = tomllib.load(f)
+            data = tomllib.load(f) if tomllib is not None \
+                else _mini_toml_load(f)
         for field in dataclasses.fields(Config):
             if field.name in data:
                 setattr(cfg, field.name, data[field.name])

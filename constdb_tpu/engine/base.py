@@ -117,9 +117,24 @@ class MergeStats:
 
 
 class MergeEngine(Protocol):
+    """The streaming merge surface callers (bench, replica link) rely on.
+
+    `merge_many` folds a GROUP of batches in one pass per CRDT family —
+    the pipelined engine overlaps host staging with device compute inside
+    it.  Engines holding deferred device state set `needs_flush` and write
+    it back on `flush` (host-only engines keep both trivial), so a caller
+    can drive any engine with the same
+    merge_many → … → flush cadence instead of hasattr probing."""
+
     name: str
+    needs_flush: bool
 
     def merge(self, store: KeySpace, batch: ColumnarBatch) -> MergeStats: ...
+
+    def merge_many(self, store: KeySpace,
+                   batches: list) -> MergeStats: ...
+
+    def flush(self, store: KeySpace) -> None: ...
 
 
 def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True) -> ColumnarBatch:

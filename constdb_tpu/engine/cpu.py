@@ -18,6 +18,19 @@ log = logging.getLogger(__name__)
 
 class CpuMergeEngine:
     name = "cpu"
+    # host-only engine: nothing ever defers, so the streaming surface
+    # (engine/base.py MergeEngine) is trivial
+    needs_flush = False
+
+    def merge_many(self, store: KeySpace,
+                   batches: list) -> MergeStats:
+        st = MergeStats()
+        for b in batches:
+            st += self.merge(store, b)
+        return st
+
+    def flush(self, store: KeySpace) -> None:
+        return None
 
     def merge(self, store: KeySpace, batch: ColumnarBatch) -> MergeStats:
         st = MergeStats()

@@ -137,10 +137,12 @@ def _lex_fold(t_list, v_list):
 
 
 def _sel_obj(lists, wb: np.ndarray) -> np.ndarray:
-    """Pick lists[wb[j]][j] for every j, vectorized via an object matrix."""
+    """Pick lists[wb[j]][j] for every j, vectorized via an object matrix.
+    A None entry in `lists` stands for an all-None value column (valueless
+    batches skip materializing [None] * n lists entirely)."""
     obj = np.empty((len(lists), len(wb)), dtype=object)
     for i, v in enumerate(lists):
-        obj[i, :] = v
+        obj[i, :] = v  # numpy broadcasts a bare None across the row
     return obj[wb, np.arange(len(wb))]
 
 
@@ -157,9 +159,15 @@ class TpuMergeEngine:
     # vector on device (iota) instead of uploading it; below it the jit
     # dispatch overhead outweighs the saved bytes (tests lower it to 1)
     IDX_IOTA_MIN = 4096
+    # win-source pool ids live in an int32 device plane; merge_many flushes
+    # before staging a round that could cross this (tests lower it)
+    POOL_ID_CEILING = 1 << 31
+    # staging order = dispatch order = the on-store plane contract
+    FAM_ORDER = ("env", "reg", "cnt", "el")
 
     def __init__(self, resident: bool = False, mesh=None,
-                 dense_fold: str = "auto") -> None:
+                 dense_fold: str = "auto",
+                 pipeline: Optional[bool] = None) -> None:
         """`mesh`: an optional jax.sharding.Mesh with a "kv" axis.  When
         given, per-slot device state range-partitions over that axis
         (NamedSharding P("kv")) while batch rows replicate — GSPMD then
@@ -175,7 +183,24 @@ class TpuMergeEngine:
         R times.  "auto" = fused Pallas kernels (ops/pallas_dense.py) on
         TPU backends, XLA dense kernels (ops/dense.py) elsewhere; "pallas"
         / "pallas-interpret" / "xla" force a backend; "off" disables
-        folding.  Both backends are differential-tested bit-identical."""
+        folding.  Both backends are differential-tested bit-identical.
+
+        `pipeline`: double-buffered merge dispatch.  Each CRDT family's
+        work splits into STAGE (pure host prep: columnarization, slot
+        resolution, group combine — touches ONLY that family's host
+        plane) and DISPATCH (device uploads/kernels + pool bookkeeping,
+        main thread, family order).  With the pipeline on, a background
+        pool stages the families concurrently while the main thread
+        dispatches each plan as it lands and the device crunches earlier
+        kernels — host staging overlaps device compute instead of
+        serializing behind it.  Results are byte-identical to the serial
+        path: the safety invariant is PER-PLANE INDEPENDENCE, not
+        ordering — every plane's appends happen inside exactly one stage,
+        in batch order, and no stage reads another family's store plane
+        (a stage that needs one must move that read into merge_many's
+        serial prologue or its own dispatch).  None = on unless
+        CONSTDB_PIPELINE=0.  The serial path stays selectable for
+        debugging (pipeline=False / CONSTDB_PIPELINE=0)."""
         import jax  # ensure a backend exists before we advertise ourselves
 
         self._jax = jax
@@ -185,10 +210,21 @@ class TpuMergeEngine:
         # stale-mirror rebuilds per family (observability: mixed op/merge
         # traffic must keep these O(writes-to-that-plane), never O(ops))
         self.mirror_rebuilds = dict.fromkeys(FAMILIES, 0)
-        # cumulative host-side seconds per family (DISPATCH time — device
-        # work is async; the flush entry includes the blocking downloads)
+        # cumulative host-side seconds per family on the CRITICAL PATH
+        # (stage-wait + dispatch; device work is async).  The flush entry
+        # includes the blocking downloads.  With the pipeline on,
+        # `stage_secs` separately records each family's background staging
+        # time — staging overlapped with device compute shows up there
+        # while family_secs shrinks to the un-overlapped remainder.
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
                             "flush": 0.0}
+        self.stage_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0}
+        import os as _os
+        if pipeline is None:
+            pipeline = _os.environ.get("CONSTDB_PIPELINE", "1") != "0"
+        self.pipeline = bool(pipeline)
+        self._stage_ex = None          # lazy single-worker staging executor
+        self._stage_pending = None     # in-flight stage futures (flush joins)
         self._pallas_broken = False
         # host<->device transfer accounting (bench.py turns these into a
         # measured fraction of the link ceiling — the merge is
@@ -211,7 +247,6 @@ class TpuMergeEngine:
         # newly-dead ones into GC queue entries after add_t reconstruction
         self._el_del_touched: list[np.ndarray] = []
         self._jit_cache: dict = {}  # keyed per-shape jitted builders
-        import os as _os
         self.pool_flush_bytes = int(_os.environ.get(
             "CONSTDB_POOL_FLUSH_MB", "1536")) << 20
         self.needs_flush = False
@@ -238,9 +273,12 @@ class TpuMergeEngine:
         several key ranges from several replicas folds per range); then,
         if the folded survivors are pairwise disjoint, they concatenate
         into one transfer via `cat_fn`.  Overlapping-unaligned leftovers
-        stay as-is (sequential kernels)."""
+        stay as-is (sequential kernels).  -> (combined, n_folds) — the
+        fold COUNT is returned, not applied to self.folds: this runs on
+        the staging worker, and the dispatching main thread applies it
+        (no racing `+=` on shared counters)."""
         if not self._host_combine() or len(staged) < 2:
-            return staged
+            return staged, 0
         clusters: list[list] = []
         by_sig: dict = {}
         for s in staged:
@@ -249,7 +287,11 @@ class TpuMergeEngine:
                    int(r[-1]) if len(r) else -1)
             placed = False
             for cl in by_sig.get(sig, ()):
-                if np.array_equal(cl[0][0], r):
+                r0 = cl[0][0]
+                # identity first: replica batches stage the very same row
+                # array object (memoized key/element resolution), so most
+                # clusters match without an O(n) compare
+                if r0 is r or np.array_equal(r0, r):
                     cl.append(s)
                     placed = True
                     break
@@ -258,18 +300,19 @@ class TpuMergeEngine:
                 clusters.append(cl)
                 by_sig.setdefault(sig, []).append(cl)
         folded = []
+        n_folds = 0
         for cl in clusters:
             if len(cl) > 1:
-                self.folds += 1
+                n_folds += 1
                 folded.append(fold_fn(cl))
             else:
                 folded.append(cl[0])
         if len(folded) == 1:
-            return folded
+            return folded, n_folds
         cat = _rows_disjoint_cat(folded)
         if cat is not None:
-            return [cat_fn(folded, cat)]
-        return folded
+            return [cat_fn(folded, cat)], n_folds
+        return folded, n_folds
 
     def _pool_add(self, vals, **cols) -> np.int32:
         """Stage one batch's winner-carried payload in the host pool and
@@ -279,7 +322,11 @@ class TpuMergeEngine:
         still CLEARS the slot's value, without materializing a list);
         `cols` are the host column arrays reconstructed at flush (e.g.
         add_t=..., add_node=...), held by reference until the next
-        flush (merge_many bounds the pinned bytes via auto-flush)."""
+        flush (merge_many bounds the pinned bytes via auto-flush).
+
+        The int32 src-plane ceiling is checked BEFORE any pool state
+        mutates; merge_many pre-flushes rounds that could cross it, so
+        tripping this means one single round stages > 2^31 rows."""
         base = self._pool_size
         n = -1
         nbytes = 0
@@ -294,12 +341,14 @@ class TpuMergeEngine:
         for a in cols.values():
             n = len(a)
             nbytes += int(getattr(a, "nbytes", 8 * n))
+        if base + n >= self.POOL_ID_CEILING:  # int32 src plane ceiling
+            raise RuntimeError(
+                "win-source pool would exceed int32 range within a single "
+                "merge round; split the ingest into smaller merge_many "
+                "calls so flush() can run between them")
         self._val_pool.append((base, vals, cols))
         self._pool_size = base + n
         self._pool_bytes += nbytes
-        if self._pool_size >= (1 << 31):  # int32 src plane ceiling
-            raise RuntimeError("win-source pool exceeded int32 range; "
-                               "flush() must run between larger ingests")
         return np.int32(base)
 
     def _src_state(self, fam: str, sp: int):
@@ -390,7 +439,8 @@ class TpuMergeEngine:
     def merge_many(self, store: KeySpace, batches: list[ColumnarBatch]) -> MergeStats:
         """Fold any number of columnar batches into the store.  Reductions
         are associative + commutative, so all batches merge in one device
-        pass per CRDT family."""
+        pass per CRDT family — and the same properties license the
+        pipelined stage/dispatch overlap (see __init__)."""
         st = MergeStats()
         # the bulk path scatters each slot once per batch, which is only a
         # merge if slots are unique within every batch
@@ -399,6 +449,16 @@ class TpuMergeEngine:
         # _resident_state (KeySpace.fam_ver): an op write to one CRDT
         # plane no longer drops every other plane's device mirror
         self._n0_keys = store.keys.n
+        # pool-id headroom (int32 src plane): flush completed rounds BEFORE
+        # staging one that could cross the ceiling — the round boundary is
+        # the only safe flush point (mid-round, in-flight family state is
+        # not yet in self._res and its pool ids would be dropped)
+        if self.resident and self._pool_size and \
+                self._pool_size + sum(b.n_rows for b in batches) >= \
+                self.POOL_ID_CEILING:
+            log.info("win-source pool near int32 ceiling; flushing before "
+                     "this merge round")
+            self.flush(store)
         # replica snapshots of one keyspace share the key-list object (or,
         # when chunked, a key_shape identity token — batch_chunks); resolve
         # each distinct list/shape once (ids are stable within this merge,
@@ -414,13 +474,41 @@ class TpuMergeEngine:
                 memo[mk] = kid_of
             resolved.append((b, kid_of))
         import time as _time
-        for fam, call in (("env", lambda: self._merge_envelopes(store, resolved)),
-                          ("reg", lambda: self._merge_registers(store, resolved)),
-                          ("cnt", lambda: self._merge_counter_rows(store, resolved, st)),
-                          ("el", lambda: self._merge_elem_rows(store, resolved, st))):
-            t0 = _time.perf_counter()
-            call()
-            self.family_secs[fam] += _time.perf_counter() - t0
+        stage = {"env": self._stage_envelopes, "reg": self._stage_registers,
+                 "cnt": self._stage_counter_rows, "el": self._stage_elem_rows}
+        dispatch = {"env": self._dispatch_envelopes,
+                    "reg": self._dispatch_registers,
+                    "cnt": self._dispatch_counter_rows,
+                    "el": self._dispatch_elem_rows}
+        if self.pipeline:
+            # double-buffered: the staging pool runs the family stages
+            # (possibly concurrently — each touches only its own host
+            # plane) while the main thread dispatches each plan in family
+            # order as it lands.  The only cross-plane seam is flush,
+            # which joins the in-flight stages first.
+            ex = self._staging_executor()
+            futs = {f: ex.submit(self._timed_stage, f, stage[f],
+                                 store, resolved, st)
+                    for f in self.FAM_ORDER}
+            self._stage_pending = futs
+            try:
+                for fam in self.FAM_ORDER:
+                    t0 = _time.perf_counter()
+                    plan = futs[fam].result()
+                    dispatch[fam](store, plan, st)
+                    self.family_secs[fam] += _time.perf_counter() - t0
+            finally:
+                # a dispatch error must not leave stages mutating the
+                # store behind the caller's back
+                import concurrent.futures as _cf
+                _cf.wait(list(futs.values()))
+                self._stage_pending = None
+        else:
+            for fam in self.FAM_ORDER:
+                t0 = _time.perf_counter()
+                plan = self._timed_stage(fam, stage[fam], store, resolved, st)
+                dispatch[fam](store, plan, st)
+                self.family_secs[fam] += _time.perf_counter() - t0
         for b, _ in resolved:
             for i, key in enumerate(b.del_keys):
                 store.record_key_delete(key, int(b.del_t[i]))
@@ -438,20 +526,82 @@ class TpuMergeEngine:
             self.flush(store)
         return st
 
+    # ------------------------------------------------------ stage pipeline
+
+    def _staging_executor(self):
+        """Staging pool.  Family stages are mutually independent (each
+        touches only its own host plane — see the per-stage docstrings),
+        so they stage CONCURRENTLY, not just ahead of dispatch; results
+        stay byte-identical because each plane's appends happen inside
+        exactly one stage, in batch order.  Sized to the spare cores
+        (CONSTDB_STAGE_WORKERS overrides)."""
+        if self._stage_ex is None:
+            import os as _os
+            from concurrent.futures import ThreadPoolExecutor
+            n = int(_os.environ.get(
+                "CONSTDB_STAGE_WORKERS",
+                str(max(1, min(len(self.FAM_ORDER),
+                               (_os.cpu_count() or 2) - 1)))))
+            self._stage_ex = ThreadPoolExecutor(
+                max_workers=max(n, 1), thread_name_prefix="constdb-stage")
+        return self._stage_ex
+
+    def close(self) -> None:
+        """Release the staging pool's threads (idempotent; the pool is
+        recreated lazily if the engine merges again).  Engines are
+        long-lived in production, but short-lived ones — bench repeats,
+        full-resync rebuilds — should not each strand a thread pool
+        until interpreter exit."""
+        ex = self._stage_ex
+        if ex is not None:
+            self._stage_ex = None
+            ex.shutdown(wait=False)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _timed_stage(self, fam: str, fn, store, resolved, st):
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return fn(store, resolved, st)
+        finally:
+            self.stage_secs[fam] += _time.perf_counter() - t0
+
+    def _join_staging(self) -> None:
+        """Wait for in-flight family stages before any cross-plane mutation
+        (flush rebuilds/writes tables a stage may be appending to).  Errors
+        are NOT swallowed here — the merge loop re-raises them from
+        future.result()."""
+        futs = self._stage_pending
+        if futs:
+            import concurrent.futures as _cf
+            _cf.wait(list(futs.values()))
+
     # ---------------------------------------------------------------- flush
 
     def flush(self, store: KeySpace) -> None:
         """Write resident device state back into the host keyspace (resident
         mode only; a no-op otherwise).  Also re-derives counter sums and
-        enqueues element tombstones whose del_t advanced on device."""
+        enqueues element tombstones whose del_t advanced on device.
+
+        Download protocol: EVERY family's downloads dispatch up front
+        (device-side [:n] slice so padding never crosses the link;
+        copy_to_host_async overlaps transfers), then families are consumed
+        one at a time — family f's host-side application (column writes,
+        src resolution, tombstone scans) runs while the remaining
+        families' transfers are still in flight, and each consumed device
+        slice is dropped immediately so its buffer frees without waiting
+        for the whole flush."""
         if not self.needs_flush:
             return
+        self._join_staging()
         import time as _time
         t0 = _time.perf_counter()
-        # dispatch every download first (device-side [:n] slice so padding
-        # never crosses the link; copy_to_host_async overlaps transfers),
-        # then consume — one latency wait instead of one per column
-        pending: dict[tuple, object] = {}
+        pending: dict[str, dict] = {}
         for fam, res in self._res.items():
             n = res["n"]
             if n == 0:
@@ -461,6 +611,7 @@ class TpuMergeEngine:
                 [name for name, _ in _FAMILIES[fam]]
             written = res.get("written")
             recon = res.get("recon") if res.get("src") is not None else None
+            fp: dict = {}
             for name in names:
                 if written is not None and name not in written:
                     continue  # mirror column never scattered into: the
@@ -469,38 +620,43 @@ class TpuMergeEngine:
                     continue  # winner-carried column: reconstructed on host
                     # from the win pool via the (int32) src plane — the
                     # int64 column itself never crosses the link
-                pending[(fam, name)] = cols[name][:n]
+                fp[name] = cols[name][:n]
             if res.get("src") is not None:
-                pending[(fam, "src")] = res["src"][:n]
-        for arr in pending.values():
-            try:
-                arr.copy_to_host_async()
-            except AttributeError:
-                pass
-        host = {k: np.asarray(v) for k, v in pending.items()}
-        self.bytes_d2h += sum(int(v.nbytes) for v in host.values())
+                fp["src"] = res["src"][:n]
+            if fp:
+                pending[fam] = fp
+        for fp in pending.values():
+            for arr in fp.values():
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
 
-        for fam, res in self._res.items():
+        for fam, fp in pending.items():
+            res = self._res[fam]
             n = res["n"]
-            if n == 0:
-                continue
+            host = {}
+            for name in list(fp):
+                h = np.asarray(fp.pop(name))  # blocks on THIS slice only
+                self.bytes_d2h += int(h.nbytes)
+                host[name] = h
             table = _host_table(store, fam)
             # the tombstone scan below only matters when the device could
             # have advanced del_t — skipped (all-add catch-up) it is
             # old_dt == del_t by construction
-            el_dt_changed = fam == "el" and ("el", "del_t") in host
+            el_dt_changed = fam == "el" and "del_t" in host
             if el_dt_changed:
                 old_dt = table.del_t[:n].copy()
             if fam == "env":
-                out = host[(fam, "stack")]
+                out = host["stack"]
                 for i, (name, _) in enumerate(_FAMILIES["env"]):
                     table.col(name)[:n] = out[:, i]
             else:
                 for name, _ in _FAMILIES[fam]:
-                    if (fam, name) in host:
-                        table.col(name)[:n] = host[(fam, name)]
-            if (fam, "src") in host:
-                self._apply_src(store, fam, host[(fam, "src")], res)
+                    if name in host:
+                        table.col(name)[:n] = host[name]
+            if "src" in host:
+                self._apply_src(store, fam, host["src"], res)
                 res["src"] = None  # resolved; fresh tracking next round
             if res.get("written") is not None:
                 # downloaded state now equals the host columns: only columns
@@ -553,12 +709,20 @@ class TpuMergeEngine:
             return
         pool = self._val_pool
         gids_all = src_h[rows_all].astype(_I64)
-        bases = np.fromiter((b for b, _, _ in pool), dtype=_I64,
-                            count=len(pool))
-        segs_all = np.searchsorted(bases, gids_all, side="right") - 1
-        order = np.argsort(segs_all, kind="stable")
-        uniq, starts = np.unique(segs_all[order], return_index=True)
-        ends = np.append(starts[1:], len(order))
+        if len(pool) == 1:
+            # single staged segment (fully combined round): skip the
+            # segment sort entirely
+            order = np.arange(len(gids_all))
+            uniq = np.zeros(1, dtype=_I64)
+            starts = np.zeros(1, dtype=_I64)
+            ends = np.array([len(order)])
+        else:
+            bases = np.fromiter((b for b, _, _ in pool), dtype=_I64,
+                                count=len(pool))
+            segs_all = np.searchsorted(bases, gids_all, side="right") - 1
+            order = np.argsort(segs_all, kind="stable")
+            uniq, starts = np.unique(segs_all[order], return_index=True)
+            ends = np.append(starts[1:], len(order))
         # (a) column reconstruction, vectorized one pool segment at a time
         recon = res.get("recon")
         if recon:
@@ -598,7 +762,8 @@ class TpuMergeEngine:
                 # (CPU parity — local-loses replaces with None)
                 picked = [None] * len(r_sel)
             else:
-                picked = [vals[g - b] for g in (gids_all[sel]).tolist()]
+                picked = list(map(vals.__getitem__,
+                                  (gids_all[sel] - b).tolist()))
             r0 = int(r_sel[0])
             # r_sel is strictly ascending and unique by construction
             # (np.nonzero order preserved through the stable argsort), so
@@ -716,7 +881,7 @@ class TpuMergeEngine:
                 enc=batch.key_enc[pos], ct=batch.key_ct[pos], mt=0,
                 dt=batch.key_dt[pos], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
             assert rows[0] == uniq_ids[0] and rows[-1] == uniq_ids[-1]
-            store.key_bytes.extend(batch.keys[i] for i in pos.tolist())
+            store.key_bytes.extend(map(batch.keys.__getitem__, pos.tolist()))
             store.reg_val.extend([None] * n_new)
             st.keys_created += n_new
             if self.resident:
@@ -773,19 +938,45 @@ class TpuMergeEngine:
         return [self._batch_idx(rows, base, sp, np_)] + \
             [self._put_batch(_pad(c, np_, fill)) for c, fill in cols]
 
+    def _iota_r0(self, rows: np.ndarray, base: int):
+        """Device-relative start (np.int32) when `rows` is one long
+        contiguous run — the catch-up shape — else None.  The ONE home
+        for the contiguity predicate + IDX_IOTA_MIN threshold; the fused
+        src kernels and _batch_idx's derived-iota path both use it."""
+        n = len(rows)
+        if n < self.IDX_IOTA_MIN:
+            return None
+        r0 = int(rows[0])
+        if int(rows[n - 1]) - r0 + 1 != n or not (np.diff(rows) == 1).all():
+            return None
+        return np.int32(r0 - base)
+
+    def _bulk_src_call(self, fn, fn_iota, states, rows, base: int, sp: int,
+                       cols, pb):
+        """One src-tracking scatter dispatch: contiguous rows take the
+        FUSED variant (idx derived inside the kernel from two scalars —
+        one dispatch, no intermediate idx buffer); anything else uploads
+        or derives an idx vector and calls the classic kernel."""
+        n = len(rows)
+        np_ = K.next_pow2(max(n, 1))
+        dev = [self._put_batch(_pad(c, np_, fill)) for c, fill in cols]
+        if self._mesh is None:  # fused iota kernels are single-device
+            r0 = self._iota_r0(rows, base)
+            if r0 is not None:
+                return fn_iota(*states, r0, np.int32(n), *dev, pb, np_=np_)
+        idx = self._batch_idx(rows, base, sp, np_)
+        return fn(*states, idx, *dev, pb)
+
     def _batch_idx(self, rows: np.ndarray, base: int, sp: int, np_: int):
         n = len(rows)
-        if n >= self.IDX_IOTA_MIN:
-            # catch-up chunks create (and re-touch) slot rows in contiguous
-            # blocks; a contiguous idx is DERIVED on device from three
-            # scalars (iota) — the int32 index vector never crosses the
-            # link.  Padded positions land at >= sp (out of range) exactly
-            # like the host-built vector's, so scatters drop them.
-            r0 = int(rows[0])
-            if int(rows[n - 1]) == r0 + n - 1 and np.array_equal(
-                    rows, np.arange(r0, r0 + n, dtype=rows.dtype)):
-                return self._iota_idx(np_)(np.int32(r0 - base),
-                                           np.int32(n), np.int32(sp))
+        # catch-up chunks create (and re-touch) slot rows in contiguous
+        # blocks; a contiguous idx is DERIVED on device from three
+        # scalars (iota) — the int32 index vector never crosses the
+        # link.  Padded positions land at >= sp (out of range) exactly
+        # like the host-built vector's, so scatters drop them.
+        r0 = self._iota_r0(rows, base)
+        if r0 is not None:
+            return self._iota_idx(np_)(r0, np.int32(n), np.int32(sp))
         idx = np.empty(np_, dtype=_I32)
         idx[:n] = rows - base
         if np_ > n:
@@ -930,27 +1121,39 @@ class TpuMergeEngine:
 
     # ------------------------------------------------------------ envelopes
 
-    def _merge_envelopes(self, store: KeySpace, resolved) -> None:
+    def _stage_envelopes(self, store: KeySpace, resolved, st):
+        """STAGE (host-only): columnarize + group-combine the envelope
+        plane.  Runs on the staging worker under the pipeline."""
         staged = []  # (pos, [ct, mt, dt, exp])
         for b, kid_of in resolved:
             valid = np.nonzero(kid_of >= 0)[0]
             if not len(valid):
                 continue
-            # slice(None) when nothing was conflict-skipped (the common
-            # case): indexing with it returns VIEWS, not copies
-            sel = slice(None) if len(valid) == len(kid_of) else valid
-            staged.append((kid_of[sel],
-                           [b.key_ct[sel], b.key_mt[sel],
-                            b.key_dt[sel], b.key_expire[sel]]))
+            if len(valid) == len(kid_of):
+                # full batch: stage the shared arrays themselves so the
+                # combiner can cluster replicas by object identity
+                staged.append((kid_of, [b.key_ct, b.key_mt,
+                                        b.key_dt, b.key_expire]))
+            else:
+                staged.append((kid_of[valid],
+                               [b.key_ct[valid], b.key_mt[valid],
+                                b.key_dt[valid], b.key_expire[valid]]))
         if not staged:
-            return
-        staged = self._combine_groups(
+            return None
+        staged, folds = self._combine_groups(
             staged,
-            lambda st: (st[0][0],
-                        [np.maximum.reduce([s[1][i] for s in st])
-                         for i in range(4)]),
-            lambda st, cat: (cat, [np.concatenate([s[1][i] for s in st])
-                                   for i in range(4)]))
+            lambda st_: (st_[0][0],
+                         [np.maximum.reduce([s[1][i] for s in st_])
+                          for i in range(4)]),
+            lambda st_, cat: (cat, [np.concatenate([s[1][i] for s in st_])
+                                    for i in range(4)]))
+        return {"staged": staged, "folds": folds}
+
+    def _dispatch_envelopes(self, store: KeySpace, plan, st) -> None:
+        if plan is None:
+            return
+        staged = plan["staged"]
+        self.folds += plan["folds"]
         if self.resident and self._host_combine() and self._unique_ok:
             # envelope merge is plain per-column max with no cross-family
             # device dependency: fold it straight into the host columns
@@ -1036,32 +1239,51 @@ class TpuMergeEngine:
 
     # ------------------------------------------------------------ registers
 
-    def _merge_registers(self, store: KeySpace, resolved) -> None:
+    def _stage_registers(self, store: KeySpace, resolved, st):
+        """STAGE (host-only): select + columnarize register writes, then
+        group-combine.  The (kid_of, key_enc) eligibility mask is memoized
+        per shared object pair — replica snapshots of one keyspace compute
+        it once, not once per replica."""
         from ..utils.native_tables import nonnull_mask
         staged = []  # (pos=kids, t, node, vals)
+        emask_memo: dict = {}
         for b, kid_of in resolved:
             if not b.n_keys:
                 continue
+            mk = (id(kid_of), id(b.key_enc))
+            em = emask_memo.get(mk)
+            if em is None:
+                em = (kid_of >= 0) & (b.key_enc == S.ENC_BYTES)
+                emask_memo[mk] = em
             has = nonnull_mask(b.reg_val)
-            idx = np.nonzero((kid_of >= 0) & (b.key_enc == S.ENC_BYTES) & has)[0]
+            idx = np.nonzero(em & has)[0]
             if len(idx):
                 staged.append((kid_of[idx], b.reg_t[idx], b.reg_node[idx],
-                               [b.reg_val[i] for i in idx]))
+                               list(map(b.reg_val.__getitem__,
+                                        idx.tolist()))))
         if not staged:
-            return
-        def _fold_reg(st):
-            t_f, n_f, wb = _lex_fold([s[1] for s in st],
-                                     [s[2] for s in st])
-            return (st[0][0], t_f, n_f, list(_sel_obj([s[3] for s in st], wb)))
+            return None
+        def _fold_reg(st_):
+            t_f, n_f, wb = _lex_fold([s[1] for s in st_],
+                                     [s[2] for s in st_])
+            return (st_[0][0], t_f, n_f,
+                    list(_sel_obj([s[3] for s in st_], wb)))
 
-        def _cat_reg(st, cat):
+        def _cat_reg(st_, cat):
             vals_cat: list = []
-            for s in st:
+            for s in st_:
                 vals_cat.extend(s[3])
-            return (cat, np.concatenate([s[1] for s in st]),
-                    np.concatenate([s[2] for s in st]), vals_cat)
+            return (cat, np.concatenate([s[1] for s in st_]),
+                    np.concatenate([s[2] for s in st_]), vals_cat)
 
-        staged = self._combine_groups(staged, _fold_reg, _cat_reg)
+        staged, folds = self._combine_groups(staged, _fold_reg, _cat_reg)
+        return {"staged": staged, "folds": folds}
+
+    def _dispatch_registers(self, store: KeySpace, plan, st) -> None:
+        if plan is None:
+            return
+        staged = plan["staged"]
+        self.folds += plan["folds"]
         total = sum(len(p) for p, *_ in staged)
         n = store.keys.n
         base, size, all_new = self._bulk_region([p for p, *_ in staged],
@@ -1086,10 +1308,10 @@ class TpuMergeEngine:
                 src = self._src_state("reg", sp)
                 for p, bt_, bn_, vals in staged:
                     pb = self._pool_add(vals, rv_t=bt_, rv_node=bn_)
-                    idx, dbt, dbn = self._upload_batch(
+                    t, nd, src = self._bulk_src_call(
+                        B.bulk_lww_src, B.bulk_lww_src_iota, (t, nd, src),
                         p, base, sp, [(bt_, K.NEUTRAL_T),
-                                      self._i32_up(bn_, K.NEUTRAL_T)])
-                    t, nd, src = B.bulk_lww_src(t, nd, src, idx, dbt, dbn, pb)
+                                      self._i32_up(bn_, K.NEUTRAL_T)], pb)
                 self._family_done("reg", {"rv_t": t, "rv_node": nd}, n, sp,
                                   src=src,
                                   recon={"rv_t": "rv_t",
@@ -1155,8 +1377,10 @@ class TpuMergeEngine:
 
     # ------------------------------------------------------------- counters
 
-    def _merge_counter_rows(self, store: KeySpace, resolved,
-                            st: MergeStats) -> None:
+    def _stage_counter_rows(self, store: KeySpace, resolved, st):
+        """STAGE (host-only for OTHER planes; appends missing slot rows to
+        the cnt plane itself via _resolve_cnt_rows): columnarize + combine
+        counter slot writes."""
         n0 = store.cnt.n
         staged = []  # (rows, total, uuid, base, base_t)
         for b, kid_of in resolved:
@@ -1174,21 +1398,29 @@ class TpuMergeEngine:
             staged.append((rows, b.cnt_val[sel], b.cnt_uuid[sel],
                            b.cnt_base[sel], b.cnt_base_t[sel]))
         if not staged:
-            return
-        def _fold_cnt(st):
+            return None
+        def _fold_cnt(st_):
             # both (value @ time) pairs fold independently on host
-            f_uuid, f_val, _ = _lex_fold([s[2] for s in st],
-                                         [s[1] for s in st])
-            f_bt, f_base, _ = _lex_fold([s[4] for s in st],
-                                        [s[3] for s in st])
-            return (st[0][0], f_val, f_uuid, f_base, f_bt)
+            f_uuid, f_val, _ = _lex_fold([s[2] for s in st_],
+                                         [s[1] for s in st_])
+            f_bt, f_base, _ = _lex_fold([s[4] for s in st_],
+                                        [s[3] for s in st_])
+            return (st_[0][0], f_val, f_uuid, f_base, f_bt)
 
         # disjoint is the common catch-up shape here: R replicas each carry
         # their own node's slots
-        staged = self._combine_groups(
+        staged, folds = self._combine_groups(
             staged, _fold_cnt,
-            lambda st, cat: (cat,) + tuple(
-                np.concatenate([s[i] for s in st]) for i in range(1, 5)))
+            lambda st_, cat: (cat,) + tuple(
+                np.concatenate([s[i] for s in st_]) for i in range(1, 5)))
+        return {"staged": staged, "folds": folds, "n0": n0}
+
+    def _dispatch_counter_rows(self, store: KeySpace, plan, st) -> None:
+        if plan is None:
+            return
+        staged = plan["staged"]
+        n0 = plan["n0"]
+        self.folds += plan["folds"]
         n = store.cnt.n
         total = sum(len(r) for r, *_ in staged)
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
@@ -1208,7 +1440,7 @@ class TpuMergeEngine:
                 cbt = self._state_up(store.cnt.base_t, base, size, sp,
                                      K.NEUTRAL_T, all_new)
             if self.resident and self._host_combine():
-                # deferred win resolution (see _merge_registers): winners
+                # deferred win resolution (see _dispatch_registers): winners
                 # land in the src plane, and at flush the val/uuid pair —
                 # the two widest counter columns — reconstructs from the
                 # host pool instead of downloading.  The (rare) base pair
@@ -1220,11 +1452,11 @@ class TpuMergeEngine:
                     if (bt == K.NEUTRAL_T).all():
                         # neutral base plane (no counter deletes anywhere in
                         # the batch, the common case): skip uploading it
-                        idx, dv, du = self._upload_batch(
+                        val, uuid, src = self._bulk_src_call(
+                            B.bulk_counters_vu_src,
+                            B.bulk_counters_vu_src_iota, (val, uuid, src),
                             r, base, sp, [self._i32_up(v, 0),
-                                          (u, K.NEUTRAL_T)])
-                        val, uuid, src = B.bulk_counters_vu_src(
-                            val, uuid, src, idx, dv, du, pb)
+                                          (u, K.NEUTRAL_T)], pb)
                     else:
                         idx, dv, du, dbb, dbt = self._upload_batch(
                             r, base, sp, [(v, 0), (u, K.NEUTRAL_T), (bb, 0),
@@ -1306,41 +1538,51 @@ class TpuMergeEngine:
     def _resolve_cnt_rows(self, store: KeySpace, kids: np.ndarray,
                           nodes: np.ndarray) -> np.ndarray:
         """(kid, node) pairs -> store cnt rows via the per-rank direct
-        index (KeySpace.cnt_rank_rows_arr): one vectorized gather per
-        distinct origin node — replica batches carry one or few — with
-        missing slots bulk-created as neutral (val=0, t=NEUTRAL_T)."""
-        uniq_nodes, inv = np.unique(nodes, return_inverse=True)
+        index (KeySpace.cnt_rows_lookup — dense window or sparse hash,
+        the keyspace picks): one vectorized lookup per distinct origin
+        node — replica batches carry one or few — with missing slots
+        bulk-created as neutral (val=0, t=NEUTRAL_T)."""
         out = np.empty(len(kids), dtype=_I64)
-        one = len(uniq_nodes) == 1
-        for i, node in enumerate(uniq_nodes.tolist()):
-            sel = slice(None) if one else np.nonzero(inv == i)[0]
+        if not len(kids):
+            return out
+        # replica batches stage ONE origin node: a single memory-bound
+        # equality pass beats np.unique's sort
+        first = int(nodes[0])
+        if (nodes == first).all():
+            groups = [(first, slice(None))]
+        else:
+            uniq_nodes, inv = np.unique(nodes, return_inverse=True)
+            groups = [(int(nd), np.nonzero(inv == i)[0])
+                      for i, nd in enumerate(uniq_nodes.tolist())]
+        for node, sel in groups:
             k = kids[sel]
-            # the window covers only the kid RANGE this rank touches — a
-            # node owning a few slots must not pay an O(keys.n) array
-            base, arr = store.cnt_rank_rows_arr(
-                store.rank_of(int(node)), int(k.min()), int(k.max()) + 1)
-            kb = k - base if base else k
-            got = arr[kb].astype(_I64)
+            got = store.cnt_rows_lookup(store.rank_of(node), k)
             miss = got < 0
             if miss.any():
                 # a raw op-stream batch may repeat a (kid, node): one row
                 # per unique missing kid
-                mk = kb[miss]
+                mk = k[miss]
                 uk = np.unique(mk)
                 new_rows = store.cnt.append_block(
-                    len(uk), kid=uk + base, node=int(node), val=0,
+                    len(uk), kid=uk, node=node, val=0,
                     uuid=K.NEUTRAL_T, base=0, base_t=K.NEUTRAL_T)
-                arr[uk] = new_rows.astype(np.int32)
-                got[miss] = arr[mk]
+                store.cnt_rows_assign(store.rank_of(node), uk, new_rows)
+                # uk is sorted-unique and aligned with new_rows: map each
+                # missing kid to its row without a second index probe
+                got[miss] = new_rows[np.searchsorted(uk, mk)]
             out[sel] = got
         return out
 
     # ------------------------------------------------------------- elements
 
-    def _merge_elem_rows(self, store: KeySpace, resolved,
-                         st: MergeStats) -> None:
+    def _stage_elem_rows(self, store: KeySpace, resolved, st):
+        """STAGE (appends missing element rows to the el plane; all other
+        work is host prep): resolve (kid, member) combos to rows,
+        columnarize, group-combine.  Valueless batches (the set-member
+        catch-up shape) stage `vals=None` — no [None] * n list is ever
+        materialized or concatenated for them."""
         n0 = store.el.n
-        staged = []  # (rows, at, an, dt, vals, has_vals)
+        staged = []  # (rows, at, an, dt, vals-or-None, has_vals)
         # replica snapshots of one keyspace share el_ki/el_member list
         # OBJECTS (and, via the caller's key memo, the kid_of array), so
         # their (kid, member) combos resolve to the same rows — resolve
@@ -1368,7 +1610,7 @@ class TpuMergeEngine:
                 st.elem_rows += len(keep)
                 all_kept = len(keep) == len(b.el_ki)
                 members = b.el_member if all_kept \
-                    else [b.el_member[r] for r in keep]
+                    else list(map(b.el_member.__getitem__, keep.tolist()))
                 # two native batch calls: intern members, then
                 # resolve/create (kid, member) combo slots — no per-row
                 # Python
@@ -1390,38 +1632,56 @@ class TpuMergeEngine:
                         map(members.__getitem__, pos.tolist()))
                     store.el_val.extend([None] * n_new)
                 row_memo[mk] = (rows, keep, all_kept)
-            vals = b.el_val if all_kept else [b.el_val[r] for r in keep]
             # has-values: an inherited False hint is exact (any subset of
-            # an all-None list is all None) and skips the scan; anything
-            # else re-scans locally so a lone dict value in the parent
-            # cannot push every all-None sibling chunk down the value
-            # path.  slice(None) when every row was kept: views.
-            hv = b.el_has_vals is not False and has_values(vals)
+            # an all-None list is all None) and skips both the scan AND
+            # the value-list build; anything else re-scans locally so a
+            # lone dict value in the parent cannot push every all-None
+            # sibling chunk down the value path.
+            if b.el_has_vals is False:
+                vals, hv = None, False
+            else:
+                vals = b.el_val if all_kept \
+                    else list(map(b.el_val.__getitem__, keep.tolist()))
+                hv = has_values(vals)
+                if not hv:
+                    vals = None
             esel = slice(None) if all_kept else keep
             staged.append((rows, b.el_add_t[esel], b.el_add_node[esel],
                            b.el_del_t[esel], vals, hv))
         if not staged:
-            return
-        def _fold_el(st):
-            f_at, f_an, wb = _lex_fold([s[1] for s in st],
-                                       [s[2] for s in st])
-            f_dt = np.maximum.reduce([s[3] for s in st])
-            hv = any(s[5] for s in st)
-            vals = list(_sel_obj([s[4] for s in st], wb)) if hv \
-                else [None] * len(wb)
-            return (st[0][0], f_at, f_an, f_dt, vals, hv)
+            return None
+        def _fold_el(st_):
+            f_at, f_an, wb = _lex_fold([s[1] for s in st_],
+                                       [s[2] for s in st_])
+            f_dt = np.maximum.reduce([s[3] for s in st_])
+            hv = any(s[5] for s in st_)
+            vals = list(_sel_obj([s[4] for s in st_], wb)) if hv else None
+            return (st_[0][0], f_at, f_an, f_dt, vals, hv)
 
-        def _cat_el(st, cat):
-            vals_cat: list = []
-            for s in st:
-                vals_cat.extend(s[4])
+        def _cat_el(st_, cat):
+            hv = any(s[5] for s in st_)
+            if hv:
+                vals_cat: list = []
+                for s in st_:
+                    vals_cat.extend(s[4] if s[4] is not None
+                                    else [None] * len(s[0]))
+            else:
+                vals_cat = None
             return (cat,
-                    np.concatenate([s[1] for s in st]),
-                    np.concatenate([s[2] for s in st]),
-                    np.concatenate([s[3] for s in st]),
-                    vals_cat, any(s[5] for s in st))
+                    np.concatenate([s[1] for s in st_]),
+                    np.concatenate([s[2] for s in st_]),
+                    np.concatenate([s[3] for s in st_]),
+                    vals_cat, hv)
 
-        staged = self._combine_groups(staged, _fold_el, _cat_el)
+        staged, folds = self._combine_groups(staged, _fold_el, _cat_el)
+        return {"staged": staged, "folds": folds, "n0": n0}
+
+    def _dispatch_elem_rows(self, store: KeySpace, plan, st) -> None:
+        if plan is None:
+            return
+        staged = plan["staged"]
+        n0 = plan["n0"]
+        self.folds += plan["folds"]
         n = store.el.n
         total = sum(len(r) for r, *_ in staged)
         base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
@@ -1433,7 +1693,7 @@ class TpuMergeEngine:
                 base, size = 0, n
                 old_dt = None  # garbage enqueue deferred to flush
                 if self._host_combine():
-                    # deferred win resolution (see _merge_registers): the
+                    # deferred win resolution (see _dispatch_registers): the
                     # src plane is ALWAYS tracked — at flush it costs one
                     # int32 download and replaces the add_t + add_node
                     # int64 downloads (4 bytes/slot vs 16) while also
@@ -1452,12 +1712,11 @@ class TpuMergeEngine:
                     for rows_, a_, x_, d_, vals, _hv in staged:
                         x_arr = np.asarray(x_)
                         x_up = self._i32_up(x_arr, K.NEUTRAL_T)
-                        pb = self._pool_add(vals if _hv else None,
-                                            add_t=a_, add_node=x_arr)
-                        idx, da, dx = self._upload_batch(
-                            rows_, base, sp, [(a_, K.NEUTRAL_T), x_up])
-                        at, an, src = B.bulk_elems_src_nodt(
-                            at, an, src, idx, da, dx, pb)
+                        pb = self._pool_add(vals, add_t=a_, add_node=x_arr)
+                        at, an, src = self._bulk_src_call(
+                            B.bulk_elems_src_nodt, B.bulk_elems_src_nodt_iota,
+                            (at, an, src), rows_, base, sp,
+                            [(a_, K.NEUTRAL_T), x_up], pb)
                         d_arr = np.asarray(d_)
                         nz = np.flatnonzero(d_arr)
                         if len(nz):
@@ -1522,7 +1781,9 @@ class TpuMergeEngine:
                 cand = np.asarray(wins[0])[:nA] & \
                     np.isin(enc[el_kid[rows0]], S.VALUE_ENCS)
                 for j in np.nonzero(cand)[0]:
-                    el_val[int(rows0[j])] = staged[int(winb_h[j])][4][int(j)]
+                    sv = staged[int(winb_h[j])][4]
+                    el_val[int(rows0[j])] = None if sv is None \
+                        else sv[int(j)]
                 return
             for (pos, _, _, _, vals, has_vals), win in zip(staged, wins):
                 win_arr = np.asarray(win)[: len(pos)]
@@ -1540,8 +1801,8 @@ class TpuMergeEngine:
         self._drop_family(store, "el")
         all_rows = np.concatenate([r for r, *_ in staged])
         vals_flat: list = []
-        for _, _, _, _, v, _ in staged:
-            vals_flat.extend(v)
+        for r, _, _, _, v, _ in staged:
+            vals_flat.extend(v if v is not None else [None] * len(r))
         trows, slot_idx = np.unique(all_rows, return_inverse=True)
         cur_dt = store.el.del_t[trows].copy()
         n_slots = K.next_pow2(len(trows) + 1)
@@ -1568,12 +1829,14 @@ class TpuMergeEngine:
     @staticmethod
     def _enqueue_elem_garbage(store: KeySpace, rows, at, dt, old_dt) -> None:
         """Queue tombstones whose del_t advanced (dead rows need GC once the
-        cluster horizon passes)."""
+        cluster horizon passes).  Bulk path: one heapify, not n pushes —
+        a snapshot-merge flush queues millions."""
         newly = np.nonzero((at < dt) & (dt > old_dt))[0]
-        el_kid = store.el.kid
-        el_member = store.el_member
-        key_bytes = store.key_bytes
-        for di in newly:
-            row = int(rows[di])
-            store._enqueue_garbage(int(dt[di]), key_bytes[int(el_kid[row])],
-                                   el_member[row])
+        if not len(newly):
+            return
+        rws = np.asarray(rows)[newly]
+        kids = store.el.kid[rws].tolist()
+        store.enqueue_garbage_bulk(
+            np.asarray(dt)[newly].tolist(),
+            list(map(store.key_bytes.__getitem__, kids)),
+            list(map(store.el_member.__getitem__, rws.tolist())))

@@ -42,7 +42,9 @@ from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 __all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
            "bulk_counters", "bulk_counters_vu", "bulk_counters_vu_src",
            "bulk_counters_src", "bulk_elems",
-           "bulk_lww_src", "bulk_elems_src_nodt", "bulk_elems_nodt"]
+           "bulk_lww_src", "bulk_elems_src_nodt", "bulk_elems_nodt",
+           "bulk_lww_src_iota", "bulk_counters_vu_src_iota",
+           "bulk_elems_src_nodt_iota"]
 
 # An element add-side without its del side IS the plain LWW pair — same
 # kernels, no duplicate _pair_win call sites:
@@ -148,10 +150,7 @@ def bulk_counters(val, uuid, base, base_t, idx, bv, bt, bb, bbt):
     return val, uuid, base, base_t
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2))
-def bulk_lww_src(t, n, src, idx, bt, bn, base):
-    """bulk_lww with deferred win resolution (see the *_src block comment
-    at the top of the file): winners scatter `base + iota` into `src`."""
+def _lww_src_body(t, n, src, idx, bt, bn, base):
     size = t.shape[0]
     ic = jnp.minimum(idx, size - 1)
     ct, cn, cs = t[ic], n[ic], src[ic]
@@ -164,10 +163,29 @@ def bulk_lww_src(t, n, src, idx, bt, bn, base):
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
-def bulk_counters_vu_src(val, uuid, src, idx, bv, bt, base):
-    """bulk_counters_vu with deferred win resolution: the merged val/uuid
-    pair is RECONSTRUCTED at flush from the host pool via `src`, so the two
-    widest counter columns never download."""
+def bulk_lww_src(t, n, src, idx, bt, bn, base):
+    """bulk_lww with deferred win resolution (see the *_src block comment
+    at the top of the file): winners scatter `base + iota` into `src`."""
+    return _lww_src_body(t, n, src, idx, bt, bn, base)
+
+
+def _idx_iota(r0, nrows, np_: int, size):
+    """Contiguous batch idx derived on device: [r0, r0+nrows) then
+    out-of-range pad slots — same protocol as the host-built vector."""
+    i = jax.lax.iota(jnp.int32, np_)
+    return jnp.where(i < nrows, r0 + i, size + i)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("np_",))
+def bulk_lww_src_iota(t, n, src, r0, nrows, bt, bn, base, *, np_: int):
+    """bulk_lww_src for CONTIGUOUS batch rows: the idx vector is derived
+    inside the same kernel from (r0, nrows) scalars — one dispatch instead
+    of an iota build plus a scatter, and no intermediate idx buffer."""
+    idx = _idx_iota(r0, nrows, np_, t.shape[0])
+    return _lww_src_body(t, n, src, idx, bt, bn, base)
+
+
+def _counters_vu_src_body(val, uuid, src, idx, bv, bt, base):
     size = val.shape[0]
     ic = jnp.minimum(idx, size - 1)
     cv, ct, cs = val[ic], uuid[ic], src[ic]
@@ -179,6 +197,23 @@ def bulk_counters_vu_src(val, uuid, src, idx, bv, bt, base):
     src = src.at[idx].set(jnp.where(win, _iota_src(base, idx.shape[0]), cs),
                           mode="drop", unique_indices=True)
     return val, uuid, src
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def bulk_counters_vu_src(val, uuid, src, idx, bv, bt, base):
+    """bulk_counters_vu with deferred win resolution: the merged val/uuid
+    pair is RECONSTRUCTED at flush from the host pool via `src`, so the two
+    widest counter columns never download."""
+    return _counters_vu_src_body(val, uuid, src, idx, bv, bt, base)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("np_",))
+def bulk_counters_vu_src_iota(val, uuid, src, r0, nrows, bv, bt, base, *,
+                              np_: int):
+    """bulk_counters_vu_src for CONTIGUOUS batch rows (see
+    bulk_lww_src_iota)."""
+    idx = _idx_iota(r0, nrows, np_, val.shape[0])
+    return _counters_vu_src_body(val, uuid, src, idx, bv, bt, base)
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
@@ -228,4 +263,5 @@ def bulk_elems(at, an, dt, idx, bat, ban, bdt):
 
 
 bulk_elems_src_nodt = bulk_lww_src
+bulk_elems_src_nodt_iota = bulk_lww_src_iota
 bulk_elems_nodt = bulk_lww

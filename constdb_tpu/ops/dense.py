@@ -15,6 +15,8 @@ first-match tie rule makes that automatic when the local write is the winner.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
@@ -24,7 +26,7 @@ import jax.numpy as jnp  # noqa: E402
 from .segment import NEUTRAL_T  # noqa: E402
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0, 1))
 def dense_merge_counters(vals, ts):
     """[R, S] per-slot (value, uuid) LWW with max-value tie.
     -> (val[S], t[S])."""
@@ -33,7 +35,7 @@ def dense_merge_counters(vals, ts):
     return val, t_max
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0, 1, 2))
 def dense_merge_elems(at, an, dt):
     """[R, S] element merge: lexicographic (add_t, add_node) winner + max
     del_t.  -> (at[S], an[S], dt[S], win_batch[S]); win_batch==0 keeps the
@@ -46,7 +48,7 @@ def dense_merge_elems(at, an, dt):
     return at_max, an_max, dt.max(axis=0), win_batch
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0, 1))
 def dense_merge_lww(t, n):
     """[R, S] plain LWW slots (registers): lexicographic (t, node) winner.
     -> (t[S], n[S], win_batch[S])."""
@@ -57,7 +59,7 @@ def dense_merge_lww(t, n):
     return t_max, n_max, jnp.argmax(winner, axis=0)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def dense_max(cols):
     """[R, S, C] pointwise max over R — envelopes."""
     return cols.max(axis=0)

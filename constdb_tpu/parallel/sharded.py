@@ -110,7 +110,10 @@ def sharded_merge_step(mesh: Mesh):
         out_specs=(P("kv"), P("kv"), P("kv"), P("kv"), P("kv"), P("kv"),
                    P("kv", None), P()),
     )
-    return jax.jit(fn)
+    # the [R, S] batch stacks are one-shot uploads staged solely for this
+    # reduction — donating them lets XLA reuse their HBM for the outputs
+    # instead of holding both footprints live across the step
+    return jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4, 5))
 
 
 def shard_batch_arrays(mesh: Mesh, *arrays):

@@ -76,6 +76,11 @@ class KeySpace:
     NODE_RANK_BITS = 20  # up to ~1M distinct node ids per cluster lifetime
     MEMBER_BITS = 32     # up to ~4G distinct member byte-strings
     NEUTRAL_T = S.NEUTRAL_T
+    # dense per-rank counter windows convert to a hash once they would
+    # span > DENSE_FLOOR kids at < 1/MIN_FILL occupancy (sparse wide-range
+    # ranks must not cost O(kid range) host RAM)
+    CNT_WINDOW_MIN_FILL = 8
+    CNT_WINDOW_DENSE_FLOOR = 1 << 16
 
     def __init__(self) -> None:
         self.keys = _KeyCols()
@@ -94,8 +99,14 @@ class KeySpace:
         # (op path) instead of a hash probe per row.  Each rank holds
         # (base, int32 array) covering only the kid RANGE it has touched
         # (-1 = absent), so a node owning a handful of high-kid slots
-        # costs KBs, not O(keys.n).
+        # costs KBs, not O(keys.n).  A rank whose touched kids are SPARSE
+        # over a wide range (occupancy below 1/CNT_WINDOW_MIN_FILL of a
+        # window past CNT_WINDOW_DENSE_FLOOR entries) falls back to an
+        # I64Dict in `cnt_rank_hash` instead — O(slots) RAM, not
+        # O(kid range) (round-5 advisor).
         self.cnt_rank_rows: dict[int, tuple[int, np.ndarray]] = {}
+        self.cnt_rank_hash: dict[int, object] = {}
+        self.cnt_rank_live: dict[int, int] = {}
         # per-kid row lists are derived lazily from the columns (bulk merges
         # append millions of rows; only point reads need the lists)
         self.cnt_rows_by_kid: dict[int, list[int]] = {}
@@ -223,6 +234,26 @@ class KeySpace:
         self._garbage_seq += 1
         heapq.heappush(self.garbage, (t, self._garbage_seq, key, member))
 
+    def enqueue_garbage_bulk(self, ts: list, keys: list, members: list) -> None:
+        """Bulk tombstone enqueue.  A snapshot-merge flush queues millions
+        of entries, where the per-push path was a top flush cost — but a
+        SMALL batch into a huge standing heap must not pay a full O(heap)
+        re-heapify either, so pushes win whenever n·log(heap) is cheaper."""
+        n = len(ts)
+        if not n:
+            return
+        seq0 = self._garbage_seq
+        self._garbage_seq = seq0 + n
+        seqs = range(seq0 + 1, seq0 + 1 + n)
+        heap = self.garbage
+        total = len(heap) + n
+        if n * max(total.bit_length(), 1) < total:
+            for entry in zip(ts, seqs, keys, members):
+                heapq.heappush(heap, entry)
+        else:
+            heap.extend(zip(ts, seqs, keys, members))
+            heapq.heapify(heap)
+
     def record_key_delete(self, key: bytes, t: int) -> None:
         if self.key_deletes.get(key, -1) < t:
             self.key_deletes[key] = t
@@ -253,27 +284,109 @@ class KeySpace:
             base, arr = ent
             if lo >= base and hi <= base + len(arr):
                 return ent
-        nb = lo & ~1023
-        if ent is not None:
-            nb = min(nb, base)
-            top = max(base + len(arr), hi)
-        else:
-            top = hi
-        cap = 1 << max(top - nb - 1, 1023).bit_length()
+        # the grown window's geometry comes from the SAME helper the
+        # dense-vs-hash decision uses (cnt_rows_assign/_cnt_row) — the
+        # predicted cap and the allocated cap cannot drift apart
+        nb, cap = self._window_cap(lo, hi, ent)
         new = np.full(cap, -1, dtype=np.int32)
         if ent is not None:
+            base, arr = ent
             new[base - nb: base - nb + len(arr)] = arr
         self.cnt_rank_rows[rank] = (nb, new)
         return nb, new
 
+    @staticmethod
+    def _window_cap(lo: int, hi: int, ent) -> tuple[int, int]:
+        """(base, cap) the dense window would need to cover [lo, hi)."""
+        nb = lo & ~1023
+        if ent is not None:
+            base, arr = ent
+            nb = min(nb, base)
+            top = max(base + len(arr), hi)
+        else:
+            top = hi
+        return nb, 1 << max(top - nb - 1, 1023).bit_length()
+
+    def _rank_to_hash(self, rank: int):
+        """Convert a rank's dense window (if any) to hash mode."""
+        h = I64Dict(max(self.cnt_rank_live.get(rank, 0), 16))
+        ent = self.cnt_rank_rows.pop(rank, None)
+        if ent is not None:
+            base, arr = ent
+            live = np.nonzero(arr >= 0)[0]
+            if len(live):
+                h.put_batch(live + base, arr[live].astype(_I64))
+        self.cnt_rank_hash[rank] = h
+        return h
+
+    def cnt_rows_lookup(self, rank: int, kids: np.ndarray) -> np.ndarray:
+        """Vectorized kid -> cnt row for one rank (-1 = absent).  Never
+        grows the dense window — pure lookups mask against it instead."""
+        h = self.cnt_rank_hash.get(rank)
+        if h is not None:
+            return h.lookup_batch(kids)
+        ent = self.cnt_rank_rows.get(rank)
+        if ent is None:
+            return np.full(len(kids), -1, dtype=_I64)
+        base, arr = ent
+        lo = int(kids.min()) if len(kids) else 0
+        hi = int(kids.max()) + 1 if len(kids) else 0
+        if lo >= base and hi <= base + len(arr):
+            return arr[kids - base].astype(_I64)
+        out = np.full(len(kids), -1, dtype=_I64)
+        m = (kids >= base) & (kids < base + len(arr))
+        out[m] = arr[kids[m] - base]
+        return out
+
+    def cnt_rows_assign(self, rank: int, kids: np.ndarray,
+                        rows: np.ndarray) -> None:
+        """Record kid -> row for freshly created slots (kids unique).
+        Picks the representation: the dense window grows to cover the new
+        kids unless that leaves it < 1/CNT_WINDOW_MIN_FILL occupied past
+        the dense floor — then the rank converts to hash mode."""
+        live = self.cnt_rank_live.get(rank, 0) + len(kids)
+        self.cnt_rank_live[rank] = live
+        h = self.cnt_rank_hash.get(rank)
+        if h is None:
+            lo, hi = int(kids.min()), int(kids.max()) + 1
+            ent = self.cnt_rank_rows.get(rank)
+            _, cap = self._window_cap(lo, hi, ent)
+            if cap <= self.CNT_WINDOW_DENSE_FLOOR or \
+                    live * self.CNT_WINDOW_MIN_FILL >= cap:
+                base, arr = self.cnt_rank_rows_arr(rank, lo, hi)
+                arr[kids - base] = rows.astype(np.int32)
+                return
+            h = self._rank_to_hash(rank)
+        h.put_batch(kids, rows)
+
     def _cnt_row(self, kid: int, node: int) -> int:
         """Existing or fresh (both pairs unwritten) slot row."""
-        base, arr = self.cnt_rank_rows_arr(self.rank_of(node), kid, kid + 1)
+        rank = self.rank_of(node)
+        h = self.cnt_rank_hash.get(rank)
+        if h is not None:
+            row = h.get(kid, -1)
+            if row < 0:
+                row = self.cnt.append(kid=kid, node=node, val=0,
+                                      uuid=self.NEUTRAL_T,
+                                      base=0, base_t=self.NEUTRAL_T)
+                h.put(kid, row)
+                self.cnt_rank_live[rank] = \
+                    self.cnt_rank_live.get(rank, 0) + 1
+            return row
+        ent = self.cnt_rank_rows.get(rank)
+        _, cap = self._window_cap(kid, kid + 1, ent)
+        if cap > self.CNT_WINDOW_DENSE_FLOOR and \
+                (self.cnt_rank_live.get(rank, 0) + 1) * \
+                self.CNT_WINDOW_MIN_FILL < cap:
+            self._rank_to_hash(rank)
+            return self._cnt_row(kid, node)
+        base, arr = self.cnt_rank_rows_arr(rank, kid, kid + 1)
         row = int(arr[kid - base])
         if row < 0:
             row = self.cnt.append(kid=kid, node=node, val=0, uuid=self.NEUTRAL_T,
                                   base=0, base_t=self.NEUTRAL_T)
             arr[kid - base] = row
+            self.cnt_rank_live[rank] = self.cnt_rank_live.get(rank, 0) + 1
         return row
 
     def _sync_cnt_lists(self) -> None:
@@ -339,11 +452,25 @@ class KeySpace:
         """Vectorized re-derivation of every key's sum cache (used by the
         batched engines after bulk slot merges)."""
         n = self.cnt.n
-        sums = np.zeros(self.keys.n, dtype=_I64)
-        if n:
-            np.add.at(sums, self.cnt.kid[:n],
-                      self.cnt.val[:n] - self.cnt.base[:n])
-        self.keys.cnt_sum[: self.keys.n] = sums
+        nk = self.keys.n
+        if not n:
+            self.keys.cnt_sum[:nk] = 0
+            return
+        contrib = self.cnt.val[:n] - self.cnt.base[:n]
+        kid = self.cnt.kid[:n]
+        amax = int(np.abs(contrib).max())
+        # bincount accumulates in float64 — exact only while every partial
+        # sum stays under 2^53, guaranteed by n * max|contrib| < 2^53;
+        # larger magnitudes fall back to the (slower) exact int64 add.at
+        if amax and n * amax < (1 << 53):
+            sums = np.bincount(kid, weights=contrib, minlength=nk)
+            self.keys.cnt_sum[:nk] = sums[:nk].astype(_I64)
+        elif amax == 0:
+            self.keys.cnt_sum[:nk] = 0
+        else:
+            sums = np.zeros(nk, dtype=_I64)
+            np.add.at(sums, kid, contrib)
+            self.keys.cnt_sum[:nk] = sums
 
     def counter_merge_slot(self, kid: int, node: int, total: int, uuid: int,
                            base: int, base_t: int) -> None:
@@ -637,7 +764,10 @@ class KeySpace:
             "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
                               + self.el.nbytes()
                               + sum(a.nbytes for _, a
-                                    in self.cnt_rank_rows.values())),
+                                    in self.cnt_rank_rows.values())
+                              # hash-mode ranks: ~16B/entry estimate
+                              + sum(16 * len(h)
+                                    for h in self.cnt_rank_hash.values())),
             "keys": self.keys.n,
             "counter_slots": self.cnt.n,
             "element_rows": self.el.n,

@@ -22,6 +22,7 @@ import os
 import subprocess
 import sys
 from dataclasses import dataclass
+from typing import Optional
 
 _PROBE_SRC = (
     "import jax; d = jax.devices(); "
@@ -37,22 +38,36 @@ class BackendProbe:
     error: str = ""
 
 
-_PROBE_MEMO: list = []
+_PROBE_MEMO: list = []  # [(BackendProbe, monotonic timestamp)]
+
+# failed probes expire so a long-lived process can recover once a wedged
+# device heals (round 5: the first probe timed out and the whole bench —
+# and anything else in that process — was pinned to the CPU fallback
+# forever); successful probes stay cached for the process lifetime
+FAILED_PROBE_TTL = 300.0
 
 
-def probe_backend(timeout: float = 90.0,
-                  cached: bool = True) -> BackendProbe:
+def probe_backend(timeout: float = 90.0, cached: bool = True,
+                  fail_ttl: Optional[float] = None) -> BackendProbe:
     """Report the default backend's platform/device count, never hanging.
     The (per-process) result is memoized by default: entry points that
     probe more than once on one boot (e.g. __graft_entry__ entry() +
     dryrun_multichip) pay a single subprocess init — and a wedged device
-    a single timeout — not one per call."""
+    a single timeout — not one per call.  Successful probes cache forever;
+    FAILED probes only for `fail_ttl` seconds (default FAILED_PROBE_TTL,
+    env CONSTDB_PROBE_FAIL_TTL), after which the next call re-probes."""
+    import time as _time
+    if fail_ttl is None:
+        fail_ttl = float(os.environ.get("CONSTDB_PROBE_FAIL_TTL",
+                                        str(FAILED_PROBE_TTL)))
     if cached and _PROBE_MEMO:
-        return _PROBE_MEMO[0]
+        probe, ts = _PROBE_MEMO[0]
+        if probe.ok or _time.monotonic() - ts < fail_ttl:
+            return probe
     probe = _probe_backend_uncached(timeout)
     if cached:
         _PROBE_MEMO.clear()
-        _PROBE_MEMO.append(probe)
+        _PROBE_MEMO.append((probe, _time.monotonic()))
     return probe
 
 

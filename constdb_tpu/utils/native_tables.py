@@ -70,6 +70,16 @@ def load_ext():
     return None
 
 
+def reload_tiers() -> bool:
+    """Forget the (possibly negative) loader caches and retry — the public
+    hook for callers that build the native artifacts at runtime (bench.py
+    ensure_native).  Returns True when the CPython extension loads."""
+    global _ext, _lib
+    _ext = None
+    _lib = None
+    return load_ext() is not None
+
+
 def load_native() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
