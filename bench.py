@@ -79,6 +79,29 @@ def ensure_native(timeout: float = 600.0) -> None:
           f"{time.perf_counter() - t0:.1f}s (loaded={ok})", file=sys.stderr)
 
 
+def host_fingerprint() -> dict:
+    """Box identity stamped into every bench JSON line: cross-box
+    comparisons (the r05/r06 host_note confusion) become a field check
+    instead of prose archaeology."""
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("model name", "hardware")):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return {
+        "cpu_model": model or platform.processor() or platform.machine(),
+        "cores": os.cpu_count(),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "platform": platform.platform(),
+    }
+
+
 def _uuids(rng, n, span_ms=600_000):
     # float-scaled draws: ~5x faster than bounded-integer rejection
     # sampling at the 10M scale (this is workload GENERATION — outside the
@@ -342,6 +365,15 @@ def time_engine(make_engine, chunks, repeats: int = 2,
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="constdb-tpu snapshot-merge "
+                                 "benchmark")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="hash-shard the host merge across this many "
+                    "worker processes (default: CONSTDB_SHARDS / auto; "
+                    "1 = single-keyspace path)")
+    args, _ = ap.parse_known_args()
     # default = the BASELINE.json north-star scale (10M keys x 8 replicas);
     # the CPU baseline rate is measured on a capped key count (the per-row
     # engine's keys/sec is scale-flat, the 10M run would take ~20 min)
@@ -421,18 +453,63 @@ def main() -> None:
     # production (link.py apply_group)
     group = int(os.environ.get("CONSTDB_BENCH_GROUP", str(4 * n_rep)))
     fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
-    eng_holder = {}
-
-    def make_eng():
-        eng_holder["e"] = TpuMergeEngine(resident=True, dense_fold=fold)
-        return eng_holder["e"]
-
+    from constdb_tpu.store.sharded_keyspace import (ShardedKeySpace,
+                                                    default_shards)
+    shards = args.shards if args.shards is not None else default_shards()
+    # every run goes through the sharded keyspace facade: shards == 1 is
+    # the degenerate single-keyspace path (byte-identical to driving the
+    # engine directly — tests/test_sharded_keyspace.py pins it) so the
+    # JSON line always carries per-shard host_secs; shards > 1 fans the
+    # same chunk stream out by key hash to worker processes (one
+    # KeySpace + resident engine each), so cnt/el staging and flush
+    # apply run on all cores instead of one
+    if shards > 1:
+        # job granularity: one replica-aligned cluster per job (n_rep
+        # chunks of one key range) keeps the worker-side fold intact
+        # while giving the parent-encode → worker-merge pipeline several
+        # jobs in flight; the single-path `group` would put the whole
+        # stream in ~2 jobs and serialize encode against merge
+        sgroup = int(os.environ.get("CONSTDB_SHARD_GROUP", str(n_rep)))
+        print(f"[bench] sharded merge: {shards} worker processes, "
+              f"{sgroup}-chunk jobs", file=sys.stderr)
+        # carry the fold knob into the worker processes (captured into
+        # the pool env at creation); CONSTDB_SHARD_ENGINE is honored here
+        # exactly as on the replica-ingest path (README Tuning table)
+        os.environ.setdefault("CONSTDB_SHARD_FOLD", fold)
+        sks = ShardedKeySpace(
+            n_shards=shards, mode="process",
+            engine_spec=os.environ.get("CONSTDB_SHARD_ENGINE", "tpu"),
+            group=sgroup)
+    else:
+        sks = ShardedKeySpace(
+            n_shards=1, group=group,
+            engine_factory=lambda: TpuMergeEngine(resident=True,
+                                                  dense_fold=fold))
     # best-of-2 even at the 10M scale: the driver records a single bench
-    # invocation, and one unlucky run (shared box, tunnel variance) should
-    # not be the round's number (~90s extra, well within budget)
-    tpu_t, dev_store = time_engine(make_eng, chunks, repeats=2, group=group)
+    # invocation, and one unlucky run (shared box, tunnel variance)
+    # should not be the round's number (~90s extra, well within budget)
+    tpu_t = float("inf")
+    for _ in range(2):
+        sks.reset()
+        t0 = time.perf_counter()
+        for c in chunks:
+            sks.submit(c)
+        sks.flush()
+        tpu_t = min(tpu_t, time.perf_counter() - t0)
+    dev_store = sks
+    shard_secs = sks.host_secs_per_shard()  # last run (reset clears)
+    folds = sum(s.get("folds", 0) for s in shard_secs)
+    bytes_h2d = sum(s.get("bytes_h2d", 0) for s in shard_secs)
+    bytes_d2h = sum(s.get("bytes_d2h", 0) for s in shard_secs)
+    fam = {}
+    stg = {}
+    for s in shard_secs:
+        for k, v in s.get("family_secs", {}).items():
+            fam[k] = fam.get(k, 0.0) + v
+        for k, v in s.get("stage_secs", {}).items():
+            stg[k] = stg.get(k, 0.0) + v
+    pipeline = os.environ.get("CONSTDB_PIPELINE", "1") != "0"
     rate = n_keys / tpu_t
-    eng = eng_holder["e"]
     # wake the (pre-forked, idle) oracle worker NOW: its CPU replay
     # overlaps the merge epilogue (link probe + device-store canonical
     # extraction) instead of running serially after everything else
@@ -447,17 +524,15 @@ def main() -> None:
                   f"verification unavailable", file=sys.stderr)
     t_verify0 = time.perf_counter()
     print(f"[bench] device engine (resident, {jax.default_backend()}, "
-          f"group={group}, folds={eng.folds}): "
+          f"group={group}, shards={shards}, folds={folds}): "
           f"{tpu_t:.3f}s on {n_keys} keys = {rate:,.0f} keys/s",
           file=sys.stderr)
-    fam = getattr(eng, "family_secs", {})
     if fam:
         breakdown = " ".join(f"{k}={v:.3f}s" for k, v in sorted(fam.items()))
         print(f"[bench] stage breakdown (last run, critical-path host "
               f"times; flush includes blocking downloads): {breakdown}",
               file=sys.stderr)
-    stg = getattr(eng, "stage_secs", {})
-    if stg and getattr(eng, "pipeline", False):
+    if stg and pipeline:
         overlapped = " ".join(f"{k}={v:.3f}s" for k, v in sorted(stg.items()))
         print(f"[bench] staging (background worker, overlaps device "
               f"compute — NOT additive with the breakdown above): "
@@ -471,16 +546,25 @@ def main() -> None:
         "keys": n_keys,
         "replicas": n_rep,
         "wall_s": round(tpu_t, 2),
-        "folds": eng.folds,
+        "folds": folds,
         "backend": jax.default_backend(),
         "host_secs": {k: round(v, 3) for k, v in sorted(fam.items())},
         "stage_secs": {k: round(v, 3) for k, v in sorted(stg.items())},
-        "pipeline": getattr(eng, "pipeline", False),
+        "pipeline": pipeline,
+        "shards": shards,
+        "host": host_fingerprint(),
     }
+    # per-shard host seconds: the whole point of the sharded merge is
+    # that cnt/el/flush SPLIT — make that visible per worker (length 1
+    # when the degenerate single-shard path ran)
+    out["shard_host_secs"] = [
+        {k: round(v, 3) for k, v in sorted(s["family_secs"].items())}
+        for s in shard_secs]
+    out["shard_stage_secs"] = [
+        {k: round(v, 3) for k, v in sorted(s["stage_secs"].items())}
+        for s in shard_secs]
 
     # ------- measured link ceiling: what fraction of the wall is transfer
-    bytes_h2d = getattr(eng, "bytes_h2d", 0)
-    bytes_d2h = getattr(eng, "bytes_d2h", 0)
     up_bw, down_bw = probe_link(jax)
     link_secs = bytes_h2d / up_bw + bytes_d2h / down_bw
     out["bytes_h2d"] = bytes_h2d
@@ -547,6 +631,7 @@ def main() -> None:
             "bandwidth bound, not VPU bound"
     if note:
         out["note"] = note
+    dev_store.close()  # shard workers / engine pools
     print(json.dumps(out))
     if verified is False:
         sys.exit(1)

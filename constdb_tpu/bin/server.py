@@ -112,7 +112,9 @@ async def amain(cfg: Config) -> None:
         snapshot_compress_level=cfg.snapshot_compress_level,
         snapshot_path=cfg.snapshot_path,
         tcp_backlog=cfg.tcp_backlog,
-        gc_peer_retention=float(cfg.gc_peer_retention))
+        gc_peer_retention=float(cfg.gc_peer_retention),
+        ingest_shards=cfg.ingest_shards,
+        ingest_shard_min_bytes=cfg.ingest_shard_min_bytes)
     log.info("constdb-tpu node %d (engine=%s) serving on %s",
              node.node_id, node.engine.name, app.advertised_addr)
 

@@ -107,6 +107,14 @@ class Config:
     pid_file: str = ""            # default: <work_dir>/constdb.pid (daemon)
     log_max_bytes: int = 64 << 20  # rolling-log size cap per file
     log_backups: int = 4           # rolled files kept
+    ingest_shards: int = 0  # process-parallel snapshot ingest: hash-shard
+    #                         a large downloaded snapshot across this many
+    #                         worker processes (store/sharded_keyspace.py).
+    #                         0 = auto (CONSTDB_SHARDS env / core count;
+    #                         stays 1 on <= 2 cores), 1 = off.
+    ingest_shard_min_bytes: int = 64 << 20  # snapshots below this take the
+    #                         plain single-keyspace path (worker spawn
+    #                         costs more than it saves on small syncs)
     # a peer silent for longer than this stops pinning the GC tombstone
     # horizon.  0 (default) = never exclude — the reference's behavior,
     # where one dead peer pins tombstone collection mesh-wide forever

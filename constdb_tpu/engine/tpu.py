@@ -1674,11 +1674,21 @@ class TpuMergeEngine:
                     vals_cat, hv)
 
         staged, folds = self._combine_groups(staged, _fold_el, _cat_el)
-        return {"staged": staged, "folds": folds, "n0": n0}
+        return {"staged": staged, "folds": folds, "n0": n0,
+                "el_epoch": store.el_compact_epoch}
 
     def _dispatch_elem_rows(self, store: KeySpace, plan, st) -> None:
         if plan is None:
             return
+        # staged element ROW INDICES are only valid while row ids are
+        # stable; _compact_elements re-identifies every row and bumps the
+        # epoch.  The single-writer discipline means this can never fire in
+        # correct usage — if it does, scattering would alias rows, so fail
+        # loudly before touching any column.
+        if plan["el_epoch"] != store.el_compact_epoch:
+            raise RuntimeError(
+                "element rows were compacted between stage and dispatch "
+                "(row-id stability broken: staged indices are stale)")
         staged = plan["staged"]
         n0 = plan["n0"]
         self.folds += plan["folds"]
@@ -1840,3 +1850,47 @@ class TpuMergeEngine:
             np.asarray(dt)[newly].tolist(),
             list(map(store.key_bytes.__getitem__, kids)),
             list(map(store.el_member.__getitem__, rws.tolist())))
+
+
+class ShardDispatcher:
+    """Thin shard-aware dispatcher: one resident engine per hash shard,
+    all sharing THIS process's device queue.
+
+    The sharded keyspace (store/sharded_keyspace.py) partitions keys into
+    independent stores; each shard gets its own engine so per-shard
+    resident mirrors, win pools, and staging pipelines never interact.
+    Dispatching shard s+1's merge while shard s's device kernels are
+    still in flight interleaves their batches on the same queue — JAX
+    dispatch is async, so the host moves on to the next shard's staging
+    while the device drains the previous one's scatters.  Semantics need
+    no care beyond that: shards share no rows, so any interleaving is
+    equivalent to any other.
+    """
+
+    def __init__(self, n_shards: int, engine_factory=None) -> None:
+        if engine_factory is None:
+            engine_factory = lambda: TpuMergeEngine(resident=True)  # noqa: E731
+        self.engines = [engine_factory() for _ in range(n_shards)]
+
+    def merge_shard(self, shard: int, store: KeySpace,
+                    batches: list) -> MergeStats:
+        return self.engines[shard].merge_many(store, batches)
+
+    def flush_all(self, stores: list) -> None:
+        for eng, store in zip(self.engines, stores):
+            if getattr(eng, "needs_flush", False):
+                eng.flush(store)
+
+    @property
+    def needs_flush(self) -> bool:
+        return any(getattr(e, "needs_flush", False) for e in self.engines)
+
+    def discard_resident(self) -> None:
+        for e in self.engines:
+            if hasattr(e, "discard_resident"):
+                e.discard_resident()
+
+    def close(self) -> None:
+        for e in self.engines:
+            if hasattr(e, "close"):
+                e.close()

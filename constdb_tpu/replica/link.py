@@ -16,9 +16,11 @@ Wire protocol (RESP frames on one TCP stream, symmetric after handshake):
     *[replack, uuid, now_ms]
 
 Sync decision (reference push.rs:91-111): partial iff the peer's resume
-uuid is still gap-free in my repl_log; decided PER ROUND, so a pusher that
-falls off its own ring mid-stream recovers by re-sending a full snapshot
-(the reference leaves this case as a TODO — pull.rs:167-172).
+uuid is still gap-free in my repl_log; re-checked every round AND before
+every frame, so a pusher that falls off its own ring mid-stream re-sends a
+full snapshot on the SAME connection instead of shipping a gapped frame
+and paying a teardown + redial (the reference leaves this case as a TODO —
+pull.rs:167-172; regression-tested in tests/test_link_pushloop.py).
 
 Connection ownership: one link per peer address.  The link dials when it
 has no live connection; an inbound SYNC for the same address *adopts* its
@@ -277,6 +279,23 @@ class ReplicaLink:
 
                 sent = 0
                 while (e := node.repl_log.next_after(cursor)) is not None:
+                    if e.prev_uuid > cursor:
+                        # the ring evicted past our cursor while this loop
+                        # yielded (the drain below): streaming `e` would
+                        # hand the peer a gap, blow up its pull loop
+                        # (ReplicateCommandsLost) and force a teardown +
+                        # redial + snapshot over a FRESH connection.
+                        # Recover IN PLACE instead: stop here and let the
+                        # round decision re-send a full snapshot on this
+                        # same stream (eviction past the cursor implies
+                        # can_resume_from(cursor) is False).  This is the
+                        # fallback the module header documents — the
+                        # reference leaves the case unhandled
+                        # (pull.rs:167-172).
+                        log.warning(
+                            "push %s: repl_log evicted past send cursor "
+                            "mid-stream; resyncing in place", meta.addr)
+                        break
                     self._write(writer, encode_msg(Arr([
                         Bulk(REPLICATE), Int(node.node_id), Int(e.prev_uuid),
                         Int(e.uuid), Bulk(e.name), *e.args])))
@@ -286,6 +305,11 @@ class ReplicaLink:
                         await writer.drain()  # backpressure + yield
                 if self._writer is writer:
                     meta.uuid_i_sent = cursor  # observability (INFO)
+                if not node.repl_log.can_resume_from(cursor):
+                    # fell off the ring mid-round: resync NOW (top of the
+                    # loop) instead of sleeping out a heartbeat first
+                    await writer.drain()
+                    continue
 
                 now = asyncio.get_running_loop().time()
                 if (meta.uuid_he_sent > meta.uuid_he_acked
@@ -417,77 +441,15 @@ class ReplicaLink:
             # THIS stream stays valid: the snapshot below + the gap-free
             # frames that follow it re-establish our pull position
             self._epoch = node.reset_epoch
-        applied_rows = 0
-        # Grouped apply cadence: accumulate up to `sync_merge_group` chunks
-        # and merge them in ONE engine call (Node.merge_batches → engine
-        # merge_many: aligned groups fold in a fused [R, N] device pass;
-        # unaligned ones still share one state roundtrip per family —
-        # reference pull.rs:66-74 batches ≤32 entries per apply for the same
-        # reason).  Adaptive liveness: if a call overruns the budget the
-        # group shrinks, then chunks SPLIT (batch_chunks re-chunks any
-        # batch) so a CPU-engine catch-up never wedges the event loop on
-        # one 64Ki-key merge.
-        group: list = []
-        max_group = max(1, self.app.sync_merge_group)
-        budget = self.app.sync_merge_budget
-        target = 1
-        # ramp UP from small sub-chunks so the first call can never wedge
-        # the loop, regardless of engine speed: fast calls first grow the
-        # split size to whole chunks, then the group size to max_group;
-        # slow calls walk the same ladder back down
-        split_keys = max(0, self.app.sync_initial_split)
-        loop = asyncio.get_running_loop()
-
-        async def apply_group() -> None:
-            nonlocal applied_rows, target, split_keys
-            if not group:
-                return
-            t0 = loop.time()
-            node.merge_batches(group)
-            dt = loop.time() - t0
-            applied_rows += sum(b.n_rows for b in group)
-            if dt > budget:
-                if target > 1:
-                    target = max(1, target // 2)
-                elif split_keys == 0:
-                    split_keys = 1 << 15
-                else:
-                    split_keys = max(1024, split_keys // 2)
-            elif dt < budget / 4:
-                if split_keys:
-                    split_keys <<= 1
-                    if split_keys >= (1 << 17):
-                        split_keys = 0  # chunks applied whole from here on
-                elif target < max_group:
-                    target = min(max_group, target * 2)
-            group.clear()
-            await asyncio.sleep(0)
-
-        replica_rows: list = []
-        with open(path, "rb") as f:
-            for kind, payload in SnapshotLoader(f):
-                if kind == "node":
-                    if payload.node_id and not self.meta.node_id:
-                        self.meta.node_id = payload.node_id
-                elif kind == "replicas":
-                    # held until the WHOLE snapshot is applied (below):
-                    # merge_records adopts the recorded pull watermarks,
-                    # which are only backed by state once every chunk has
-                    # merged — adopting mid-stream would let a crash or a
-                    # corrupt-chunk abort leave watermarks pointing past
-                    # ops the local keyspace never received
-                    replica_rows.extend(payload)
-                else:
-                    if split_keys and payload.n_keys > split_keys:
-                        for sub in batch_chunks(payload, split_keys):
-                            group.append(sub)
-                            if len(group) >= target:
-                                await apply_group()
-                    else:
-                        group.append(payload)
-                    if len(group) >= target:
-                        await apply_group()
-            await apply_group()
+        shards = self.app.snapshot_ingest_shards(size)
+        if shards > 1:
+            log.info("sharded snapshot ingest from %s: %d bytes over %d "
+                     "shard workers", self.meta.addr, size, shards)
+            applied_rows, replica_rows = \
+                await self._apply_snapshot_sharded(path, shards)
+        else:
+            applied_rows, replica_rows = \
+                await self._apply_snapshot_plain(path)
         if replica_rows:
             # transitive mesh join (reference pull.rs:136-153) + watermark
             # adoption, now that the state backing them is fully merged
@@ -502,6 +464,161 @@ class ReplicaLink:
             os.unlink(path)
         except OSError:
             pass
+
+    async def _apply_batches(self, batches) -> int:
+        """Merge a stream of columnar batches into the node under the
+        grouped-apply cadence: accumulate up to `sync_merge_group` chunks
+        and merge them in ONE engine call (Node.merge_batches → engine
+        merge_many: aligned groups fold in a fused [R, N] device pass;
+        unaligned ones still share one state roundtrip per family —
+        reference pull.rs:66-74 batches ≤32 entries per apply for the same
+        reason).  Adaptive liveness: if a call overruns the budget the
+        group shrinks, then chunks SPLIT (batch_chunks re-chunks any
+        batch) so a CPU-engine catch-up never wedges the event loop on
+        one 64Ki-key merge.  Shared by the plain snapshot apply AND the
+        sharded-ingest consolidation.  Returns rows applied."""
+        node = self.node
+        applied_rows = 0
+        group: list = []
+        max_group = max(1, self.app.sync_merge_group)
+        budget = self.app.sync_merge_budget
+        target = 1
+        # ramp UP from small sub-chunks so the first call can never wedge
+        # the loop, regardless of engine speed: fast calls first grow the
+        # split size to whole chunks, then the group size to max_group;
+        # slow calls walk the same ladder back down
+        split_keys = max(0, self.app.sync_initial_split)
+        did_split = False  # did the CURRENT group actually get sub-chunked?
+        loop = asyncio.get_running_loop()
+
+        async def apply_group() -> None:
+            nonlocal applied_rows, target, split_keys, did_split
+            if not group:
+                return
+            t0 = loop.time()
+            node.merge_batches(group)
+            dt = loop.time() - t0
+            applied_rows += sum(b.n_rows for b in group)
+            if dt > budget:
+                if target > 1:
+                    target = max(1, target // 2)
+                elif split_keys == 0:
+                    split_keys = 1 << 15
+                else:
+                    split_keys = max(1024, split_keys // 2)
+            elif dt < budget / 4:
+                if split_keys and did_split:
+                    # splitting is ACTIVE: widen the sub-chunks first
+                    split_keys <<= 1
+                    if split_keys >= (1 << 17):
+                        split_keys = 0  # chunks applied whole from here on
+                elif target < max_group:
+                    # chunks already apply whole (stream chunks smaller
+                    # than the split, or the split ramped out): grow the
+                    # GROUP — doubling an inactive split would burn the
+                    # whole ramp budget without changing a single call
+                    target = min(max_group, target * 2)
+            group.clear()
+            did_split = False
+            await asyncio.sleep(0)
+
+        for payload in batches:
+            if split_keys and payload.n_keys > split_keys:
+                for sub in batch_chunks(payload, split_keys):
+                    # per sub-chunk, not per payload: apply_group resets
+                    # the flag at every group boundary, and the LATER
+                    # groups of this payload's sub-chunks must still
+                    # classify as split-active (else the controller grows
+                    # the group while splitting is still happening,
+                    # inverting the documented ramp order)
+                    did_split = True
+                    group.append(sub)
+                    if len(group) >= target:
+                        await apply_group()
+            else:
+                group.append(payload)
+            if len(group) >= target:
+                await apply_group()
+        await apply_group()
+        return applied_rows
+
+    async def _apply_snapshot_plain(self, path: str):
+        """Single-keyspace snapshot apply (the default path)."""
+        replica_rows: list = []
+
+        def batch_sections():
+            with open(path, "rb") as f:
+                for kind, payload in SnapshotLoader(f):
+                    if kind == "node":
+                        if payload.node_id and not self.meta.node_id:
+                            self.meta.node_id = payload.node_id
+                    elif kind == "replicas":
+                        # held until the WHOLE snapshot is applied:
+                        # merge_records adopts the recorded pull
+                        # watermarks, which are only backed by state once
+                        # every chunk has merged — adopting mid-stream
+                        # would let a crash or a corrupt-chunk abort leave
+                        # watermarks pointing past ops the local keyspace
+                        # never received
+                        replica_rows.extend(payload)
+                    else:
+                        yield payload
+
+        applied_rows = await self._apply_batches(batch_sections())
+        return applied_rows, replica_rows
+
+    async def _apply_snapshot_sharded(self, path: str, shards: int):
+        """Process-parallel snapshot apply (store/sharded_keyspace.py):
+        fan RAW batch sections out by key hash to shard worker processes
+        — they decode, hash, and merge in parallel while this loop keeps
+        serving — then consolidate each shard's merged (deduplicated)
+        state into the serving keyspace through the node's own engine,
+        re-chunked through the grouped-apply cadence so no single merge
+        wedges the event loop."""
+        from ..store.sharded_keyspace import ShardedKeySpace
+        node = self.node
+        loop = asyncio.get_running_loop()
+        spec = os.environ.get("CONSTDB_SHARD_ENGINE") or \
+            ("tpu" if getattr(node.engine, "name", "") == "tpu" else "cpu")
+        sks = ShardedKeySpace(n_shards=shards, mode="process",
+                              engine_spec=spec,
+                              group=max(1, self.app.sync_merge_group))
+        x = node.stats.extra
+        x["sharded_ingests"] = x.get("sharded_ingests", 0) + 1
+        x["sharded_ingest_workers"] = shards
+        applied_rows = 0
+        replica_rows: list = []
+        try:
+            with open(path, "rb") as f:
+                for kind, payload in SnapshotLoader(f, raw_batches=True):
+                    if kind == "node":
+                        if payload.node_id and not self.meta.node_id:
+                            self.meta.node_id = payload.node_id
+                    elif kind == "replicas":
+                        replica_rows.extend(payload)
+                    else:
+                        # submit can block on the pool's bounded in-flight
+                        # window — run it off-loop so pulls/acks keep
+                        # flowing while completions land
+                        await loop.run_in_executor(None, sks.submit_raw,
+                                                   payload)
+            await loop.run_in_executor(None, sks.flush)
+            # consolidation rides the SAME adaptive grouped-apply cadence
+            # as the plain path — a whole-shard export through a slow
+            # engine must not wedge the loop any more than a snapshot
+            # chunk may.  Streamed shard by shard with free=True: the
+            # worker's copy of a shard is dropped the moment its export
+            # lands, so peak residency is the serving keyspace plus ONE
+            # shard, not 2x the whole snapshot.
+            applied_rows = 0
+            for s in range(shards):
+                b = await loop.run_in_executor(
+                    None, sks.export_shard_batch, s, True)
+                if b.n_rows or b.del_keys:
+                    applied_rows += await self._apply_batches(iter([b]))
+        finally:
+            await loop.run_in_executor(None, sks.close)
+        return applied_rows, replica_rows
 
 
 async def _read_msg(reader: asyncio.StreamReader, parser: RespParser,

@@ -43,9 +43,11 @@ class ServerApp:
                  snapshot_path: str = "",
                  sync_merge_group: int = 8,
                  sync_merge_budget: float = 0.1,
-                 sync_initial_split: int = 4096,
+                 sync_initial_split: int = 1024,
                  tcp_backlog: int = 1024,
-                 gc_peer_retention: float = 0.0):
+                 gc_peer_retention: float = 0.0,
+                 ingest_shards: int = 0,
+                 ingest_shard_min_bytes: int = 64 << 20):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -64,17 +66,30 @@ class ServerApp:
         self.snapshot_path = snapshot_path
         # snapshot-apply cadence: chunks per engine call (ceiling), the
         # per-call liveness budget (seconds) the adaptive controller steers
-        # toward, and the sub-chunk size the ramp starts from
+        # toward, and the sub-chunk size the ramp starts from.  The start
+        # must be small enough that the FIRST call cannot wedge the loop
+        # even through the per-row CPU engine on a slow box (~10k keys/s
+        # single-core: 1024 keys ≈ 0.1s; 4096 measurably broke the 1s
+        # client-RTT bound under full-suite heap pressure) — the ramp
+        # doubles per fast call, so a fast engine reaches whole chunks
+        # within a handful of calls either way
         self.sync_merge_group = sync_merge_group
         self.sync_merge_budget = sync_merge_budget
         self.sync_initial_split = sync_initial_split
         self.tcp_backlog = tcp_backlog
+        # process-parallel snapshot ingest (store/sharded_keyspace.py):
+        # 0 = auto (CONSTDB_SHARDS / core count; 1 on <= 2 cores),
+        # 1 = off.  Snapshots below the byte floor always take the plain
+        # path — spawning shard workers costs more than they save there.
+        self.ingest_shards = ingest_shards
+        self.ingest_shard_min_bytes = ingest_shard_min_bytes
         # peers silent beyond this stop pinning the GC horizon
         self.gc_peer_retention = gc_peer_retention
         node.replicas.gc_peer_retention_ms = int(gc_peer_retention * 1000)
         self._server: Optional[asyncio.base_events.Server] = None
         self._cron_task: Optional[asyncio.Task] = None
         self._conn_tasks: set[asyncio.Task] = set()
+        self._closing = False
         from ..persist.share import SharedDump
         self.shared_dump = SharedDump(self)
 
@@ -83,6 +98,17 @@ class ServerApp:
     @property
     def advertised_addr(self) -> str:
         return self._advertised or f"{self.host}:{self.port}"
+
+    def snapshot_ingest_shards(self, size: int) -> int:
+        """How many hash shards a downloaded snapshot of `size` bytes
+        should fan out over (1 = plain single-keyspace path)."""
+        if size < self.ingest_shard_min_bytes:
+            return 1
+        n = self.ingest_shards
+        if n == 0:
+            from ..store.sharded_keyspace import default_shards
+            n = default_shards()
+        return max(1, n)
 
     async def start(self) -> None:
         os.makedirs(self.work_dir, exist_ok=True)
@@ -106,6 +132,7 @@ class ServerApp:
                  self.advertised_addr)
 
     async def close(self) -> None:
+        self._closing = True
         if self._cron_task is not None:
             self._cron_task.cancel()
         for m in list(self.node.replicas.peers.values()):
@@ -123,6 +150,16 @@ class ServerApp:
             t.cancel()
         if self._server is not None:
             await self._server.wait_closed()
+        # second link sweep: a connection accepted just before the
+        # listener closed can reach _upgrade_to_replica AFTER the sweep
+        # above, registering a fresh link whose serve/push tasks would
+        # outlive this app — a zombie stream that keeps a "closed" node
+        # applying its peer's ops (found while pinning the ring-falloff
+        # resync fallback: the zombie kept the restarted peer secretly
+        # caught up, so the full-sync path never ran)
+        for m in list(self.node.replicas.peers.values()):
+            if isinstance(m.link, ReplicaLink):
+                await m.link.stop()
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -173,6 +210,9 @@ class ServerApp:
 
     async def _on_connection(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter) -> None:
+        if self._closing:  # raced the listener shutdown: refuse outright
+            writer.close()
+            return
         task = asyncio.current_task()
         self._conn_tasks.add(task)
         self.node.stats.connections_accepted += 1
@@ -226,6 +266,9 @@ class ServerApp:
     def _upgrade_to_replica(self, msg, reader, writer, parser) -> None:
         """Passive handshake: register/refresh the peer, reply `sync 1`,
         hand the connection to its link."""
+        if self._closing:  # the second close() sweep would stop the link,
+            writer.close()  # but never adopting is cheaper and race-free
+            return
         items = msg.items
         try:
             role = as_int(items[1])

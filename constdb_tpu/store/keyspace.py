@@ -122,6 +122,13 @@ class KeySpace:
         self.el_rows_by_kid: dict[int, list[int]] = {}
         self._el_synced = 0
         self.el_dead = 0
+        # bumped by _compact_elements (the ONLY operation allowed to
+        # re-identify element rows).  Row ids are stable between bumps —
+        # the batched engine stages row indices on a worker thread and
+        # scatters into them at dispatch, so it pins this counter across
+        # the stage→dispatch window (engine/tpu.py) and fails loudly if a
+        # compaction slipped in between.
+        self.el_compact_epoch = 0
 
         # key-level tombstone record for snapshot DELETES + GC
         # (parity: reference db.rs `deletes` map)
@@ -681,8 +688,19 @@ class KeySpace:
         reuse: row ids must stay stable BETWEEN compactions so the batched
         engine's staged row indices never alias)."""
         self.touch("el")  # row ids change: resident device mirrors are stale
+        self.el_compact_epoch += 1
         n = self.el.n
         live = np.nonzero(self.el.kid[:n] >= 0)[0]
+        # row-id stability accounting: rows only die through gc() (which
+        # counts el_dead) and only compaction re-identifies them, so the
+        # dead-row census must match exactly.  A mismatch means some path
+        # reused or dropped a row id between compactions — the batched
+        # engine's staged row indices would silently alias.  Real raise,
+        # not assert: `python -O` must not strip this guard.
+        if n - len(live) != self.el_dead:
+            raise RuntimeError(
+                f"element row-id stability broken: {n - len(live)} dead "
+                f"rows found but {self.el_dead} accounted")
         new_el = _ElCols()
         new_el.append_block(len(live), kid=self.el.kid[live],
                             add_t=self.el.add_t[live],

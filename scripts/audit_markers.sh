@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Fast `-m 'not slow'` marker audit: run the tier-1 selection and FAIL if
+# any test slower than the budget (CONSTDB_MARKER_AUDIT_BUDGET, default
+# 5s) is missing the `slow` marker.  The measurement lives in
+# tests/conftest.py (pytest_runtest_logreport), gated on the
+# CONSTDB_MARKER_AUDIT env var; this script just supplies the report path
+# and interprets it.  Extra pytest args pass through (e.g. a sub-path).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+report=$(mktemp /tmp/constdb_marker_audit.XXXXXX)
+trap 'rm -f "$report"' EXIT
+CONSTDB_MARKER_AUDIT="$report" JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+rc=$?
+if [ -s "$report" ]; then
+  echo "MARKER AUDIT FAILED — unmarked tests over budget (add @pytest.mark.slow):" >&2
+  cat "$report" >&2
+  exit 1
+fi
+if [ $rc -ne 0 ]; then
+  echo "marker audit: no unmarked slow tests, but the suite itself failed (rc=$rc)" >&2
+  exit $rc
+fi
+echo "marker audit OK: no unmarked test over budget"

@@ -38,6 +38,30 @@ if os.environ.get("CONSTDB_TEST_TPU"):
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# ------------------------------------------------------------ marker audit
+# Tier-1 filters `-m 'not slow'`, so a long test that FORGOT the marker
+# silently bloats the tier-1 wall until the timeout bites.  scripts/
+# audit_markers.sh runs the suite with CONSTDB_MARKER_AUDIT=<report path>:
+# every test whose call phase exceeds CONSTDB_MARKER_AUDIT_BUDGET seconds
+# (default 5) WITHOUT a `slow` marker lands in the report file, and the
+# script fails when it is non-empty.  Inert unless the env var is set.
+_AUDIT_PATH = os.environ.get("CONSTDB_MARKER_AUDIT")
+if _AUDIT_PATH:
+    _AUDIT_BUDGET = float(os.environ.get("CONSTDB_MARKER_AUDIT_BUDGET", "5"))
+    _audit_offenders = []
+
+    def pytest_runtest_logreport(report):
+        if report.when == "call" and report.duration > _AUDIT_BUDGET \
+                and "slow" not in report.keywords:
+            _audit_offenders.append(
+                f"{report.nodeid} {report.duration:.1f}s")
+
+    def pytest_sessionfinish(session, exitstatus):
+        with open(_AUDIT_PATH, "w") as f:
+            for line in _audit_offenders:
+                f.write(line + "\n")
+
+
 CPU_MESH_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",

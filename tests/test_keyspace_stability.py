@@ -1,0 +1,219 @@
+"""Element row-id stability + counter-window boundary behavior.
+
+Row ids: the batched engine stages element ROW INDICES (possibly on a
+background thread) and scatters into them at dispatch; `_compact_elements`
+is the only operation allowed to re-identify rows.  The contract used to
+live in a docstring — now `KeySpace.el_compact_epoch` + an engine-side
+guard enforce it, and these tests pin both directions.
+
+Counter windows: PR 1 added the dense-window → sparse-hash fallback
+(`cnt_rows_lookup`/`cnt_rows_assign`) without edge-case tests; these sit
+exactly on the 64k dense floor and the 1/8-occupancy threshold.
+"""
+
+import numpy as np
+import pytest
+
+import bench
+from constdb_tpu.engine.base import MergeStats
+from constdb_tpu.engine.cpu import CpuMergeEngine
+from constdb_tpu.engine.tpu import TpuMergeEngine
+from constdb_tpu.store.keyspace import KeySpace
+
+_I64 = np.int64
+
+
+# ------------------------------------------------------- row-id stability
+
+
+def _store_with_elements(n_keys=300, n_rep=2, seed=17):
+    ks = KeySpace()
+    cpu = CpuMergeEngine()
+    for b in bench.make_workload(n_keys, n_rep, seed=seed):
+        cpu.merge(ks, b)
+    return ks
+
+
+def test_compact_bumps_epoch_and_checks_accounting():
+    ks = _store_with_elements()
+    assert ks.el_compact_epoch == 0
+    ks._compact_elements()  # zero dead rows: a pure rebuild
+    assert ks.el_compact_epoch == 1
+    # corrupt the dead-row census: the stability guard must fail loudly
+    ks.el.kid[0] = -1  # a row died without gc() accounting it
+    with pytest.raises(RuntimeError, match="row-id stability"):
+        ks._compact_elements()
+
+
+def test_dispatch_rejects_stale_staged_rows():
+    """A compaction between the engine's element STAGE and DISPATCH would
+    alias every staged row index — the epoch guard refuses to scatter."""
+    ks = _store_with_elements()
+    batch = bench.make_workload(300, 1, seed=18)[0]
+    eng = TpuMergeEngine(resident=False, pipeline=False)
+    st = MergeStats()
+    eng._unique_ok = True
+    eng._n0_keys = ks.keys.n
+    kid_of = eng._resolve_keys(ks, batch, st)
+    plan = eng._stage_elem_rows(ks, [(batch, kid_of)], st)
+    ks._compact_elements()  # the forbidden interleaving
+    with pytest.raises(RuntimeError, match="row-id stability"):
+        eng._dispatch_elem_rows(ks, plan, st)
+    eng.close()
+
+
+def test_interleaved_garbage_compaction_and_bulk_merge():
+    """enqueue_garbage_bulk → gc (kills rows) → compaction → another bulk
+    merge: the engine path stays canonically identical to the CPU
+    reference doing the exact same sequence, and row ids stay dense."""
+    seed_batches = bench.make_workload(400, 2, seed=19)
+    more = bench.make_workload(400, 2, seed=20)
+
+    def run(engine_cls):
+        ks = KeySpace()
+        eng = engine_cls()
+        if hasattr(eng, "merge_many"):
+            eng.merge_many(ks, seed_batches)
+        else:
+            for b in seed_batches:
+                eng.merge(ks, b)
+        if getattr(eng, "needs_flush", False):
+            eng.flush(ks)
+        # bulk tombstones + a GC sweep past every timestamp, then force a
+        # compaction (the organic trigger needs >10k dead rows)
+        dead_members = [ks.el_member[r] for r in range(0, ks.el.n, 3)
+                        if ks.el_member[r] is not None]
+        horizon = int(max(ks.el.add_t.max(), ks.el.del_t.max())) + 10
+        ks.enqueue_garbage_bulk(
+            [horizon] * 4,
+            [ks.key_bytes[0]] * 4,
+            [b"absent-%d" % i for i in range(4)])
+        ks.gc(horizon)
+        ks._compact_elements()
+        assert (ks.el.kid[: ks.el.n] >= 0).all()  # rows are dense again
+        if hasattr(eng, "merge_many"):
+            eng.merge_many(ks, more)
+        else:
+            for b in more:
+                eng.merge(ks, b)
+        if getattr(eng, "needs_flush", False):
+            eng.flush(ks)
+        if hasattr(eng, "close"):
+            eng.close()
+        return ks
+
+    got = run(lambda: TpuMergeEngine(resident=True))
+    want = run(CpuMergeEngine)
+    assert got.canonical() == want.canonical()
+    assert got.el_compact_epoch == want.el_compact_epoch == 1
+
+
+# ------------------------------------------- counter window edge behavior
+
+
+def _fresh_rank(ks, kids):
+    rows = ks.cnt.append_block(len(kids), kid=kids, node=7, val=0,
+                               uuid=ks.NEUTRAL_T, base=0,
+                               base_t=ks.NEUTRAL_T)
+    rank = ks.rank_of(7)
+    ks.cnt_rows_assign(rank, kids, rows)
+    return rank, rows
+
+
+def test_window_exactly_at_dense_floor_stays_dense():
+    """A window whose cap lands EXACTLY on CNT_WINDOW_DENSE_FLOOR (64k)
+    stays dense no matter how sparse — the hash fallback only engages
+    PAST the floor."""
+    ks = KeySpace()
+    floor = KeySpace.CNT_WINDOW_DENSE_FLOOR
+    kids = np.array([0, floor - 1], dtype=_I64)  # cap == floor, 2 live
+    rank, rows = _fresh_rank(ks, kids)
+    assert rank in ks.cnt_rank_rows and rank not in ks.cnt_rank_hash
+    assert ks.cnt_rows_lookup(rank, kids).tolist() == rows.tolist()
+
+
+def test_window_one_past_floor_sparse_converts():
+    """One kid past the floor at minimal occupancy: the rank converts to
+    hash mode instead of allocating a 128k dense window."""
+    ks = KeySpace()
+    floor = KeySpace.CNT_WINDOW_DENSE_FLOOR
+    kids = np.array([0, floor], dtype=_I64)  # cap == 2 * floor, 2 live
+    rank, rows = _fresh_rank(ks, kids)
+    assert rank in ks.cnt_rank_hash and rank not in ks.cnt_rank_rows
+    assert ks.cnt_rows_lookup(rank, kids).tolist() == rows.tolist()
+
+
+def test_occupancy_exactly_at_threshold_stays_dense():
+    """live * MIN_FILL == cap sits ON the boundary and stays dense (the
+    conversion rule is strict `<`)."""
+    ks = KeySpace()
+    cap = 2 * KeySpace.CNT_WINDOW_DENSE_FLOOR  # 128k window
+    need = cap // KeySpace.CNT_WINDOW_MIN_FILL  # 16384 live slots
+    kids = np.concatenate([np.arange(need - 1, dtype=_I64),
+                           np.array([cap - 1], dtype=_I64)])
+    rank, rows = _fresh_rank(ks, kids)
+    assert rank in ks.cnt_rank_rows and rank not in ks.cnt_rank_hash
+    got = ks.cnt_rows_lookup(rank, kids)
+    assert got.tolist() == rows.tolist()
+
+
+def test_occupancy_one_below_threshold_converts():
+    """live * MIN_FILL == cap - MIN_FILL (one slot short): converts."""
+    ks = KeySpace()
+    cap = 2 * KeySpace.CNT_WINDOW_DENSE_FLOOR
+    need = cap // KeySpace.CNT_WINDOW_MIN_FILL - 1  # 16383 live slots
+    kids = np.concatenate([np.arange(need - 1, dtype=_I64),
+                           np.array([cap - 1], dtype=_I64)])
+    rank, rows = _fresh_rank(ks, kids)
+    assert rank in ks.cnt_rank_hash and rank not in ks.cnt_rank_rows
+    got = ks.cnt_rows_lookup(rank, kids)
+    assert got.tolist() == rows.tolist()
+    # and the op path keeps extending the hash without re-densifying
+    r_new = ks._cnt_row(cap // 2, node=7)
+    assert ks.cnt_rows_lookup(rank, np.array([cap // 2]))[0] == r_new
+
+
+def test_lookup_masks_outside_dense_window():
+    """Pure lookups never grow the window: kids outside it come back -1,
+    in-window kids resolve, and the window geometry is untouched."""
+    ks = KeySpace()
+    kids = np.arange(2048, 2548, dtype=_I64)
+    rank, rows = _fresh_rank(ks, kids)
+    base, arr = ks.cnt_rank_rows[rank]
+    probe = np.array([0, 2100, 2547, 1_000_000], dtype=_I64)
+    got = ks.cnt_rows_lookup(rank, probe)
+    assert got[0] == -1 and got[3] == -1
+    assert got[1] == rows[2100 - 2048] and got[2] == rows[-1]
+    assert ks.cnt_rank_rows[rank][0] == base
+    assert len(ks.cnt_rank_rows[rank][1]) == len(arr)
+    # empty probe: well-defined empty result
+    assert len(ks.cnt_rows_lookup(rank, np.zeros(0, dtype=_I64))) == 0
+    # absent rank: all -1
+    assert ks.cnt_rows_lookup(999, probe).tolist() == [-1] * 4
+
+
+def test_window_boundary_merge_matches_cpu():
+    """End-to-end at the boundary: a merge whose counter kids straddle
+    the dense floor produces identical state through the batched engine
+    and the CPU reference."""
+    floor = KeySpace.CNT_WINDOW_DENSE_FLOOR
+    n_keys = floor + 8  # kids run straight through the floor boundary
+    b = bench.make_workload(n_keys, 1, seed=23)[0]
+
+    ks_tpu = KeySpace()
+    eng = TpuMergeEngine(resident=True)
+    eng.merge_many(ks_tpu, [b])
+    eng.flush(ks_tpu)
+    eng.close()
+
+    ks_cpu = KeySpace()
+    CpuMergeEngine().merge(ks_cpu, b)
+    # canonical comparison would walk 64k keys through Python; compare
+    # the counter planes directly instead
+    n = ks_cpu.cnt.n
+    assert ks_tpu.cnt.n == n
+    for col in ("kid", "node", "val", "uuid", "base", "base_t"):
+        assert np.array_equal(ks_tpu.cnt.col(col)[:n],
+                              ks_cpu.cnt.col(col)[:n]), col
+    assert np.array_equal(ks_tpu.keys.cnt_sum[: ks_cpu.keys.n],
+                          ks_cpu.keys.cnt_sum[: ks_cpu.keys.n])

@@ -54,6 +54,8 @@ def _resp(port, *parts, retries=60):
     return out
 
 
+@pytest.mark.slow  # ~6s of real process spawns: over the tier-1 per-test
+# budget (scripts/audit_markers.sh); still runs in unfiltered invocations
 def test_three_node_cluster_from_toml(tmp_path):
     ports = [_free_port() for _ in range(3)]
     procs = []
