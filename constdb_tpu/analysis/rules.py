@@ -1,0 +1,492 @@
+"""The repo-specific invariants, one Rule per postmortem class.
+
+Each rule's docstring names the production incident / advisor finding it
+encodes (full writeups: docs/INVARIANTS.md).  Adding a rule is ~30
+lines: subclass `Rule`, scope it in `applies`, emit via `self.finding`,
+append to ALL_RULES, drop a seeded violation under
+tests/analysis_corpus/<mirrored-dir>/, and re-run
+`python -m constdb_tpu.analysis --write-baseline` if the live tree has
+pre-existing findings worth tracking instead of fixing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Rule, dotted, own_nodes
+
+
+def _scoped(ctx: FileContext, *dirs: str) -> bool:
+    return any(d in ctx.parts[:-1] for d in dirs)
+
+
+class AsyncBlockRule(Rule):
+    """ASYNC-BLOCK: no blocking calls on the event loop.
+
+    The asyncio loop IS the single-writer exec thread (server/io.py
+    module header): one blocking call stalls every client, every replica
+    link, and the cron.  Round 5's chaos suite found a blocking replica
+    path wedging exactly this way.  Flags `time.sleep`, sync socket
+    construction, builtin file IO, `Future.result()` and subprocess
+    waits inside `async def` — and inside sync helpers NESTED in an
+    async def, which run on the loop when called."""
+
+    name = "ASYNC-BLOCK"
+    hint = ("move the blocking work to loop.run_in_executor(...), an "
+            "async API, or a worker process; bounded local spill-file "
+            "IO may be baselined with a note instead")
+
+    BLOCKING = {
+        "time.sleep": "blocks the loop for the full sleep",
+        "socket.socket": "sync socket on the event loop",
+        "socket.create_connection": "sync connect blocks the loop",
+        "open": "sync file IO on the event loop",
+        "os.system": "blocks until the child exits",
+        "os.popen": "blocks on the child's pipe",
+        "subprocess.run": "blocks until the child exits",
+        "subprocess.call": "blocks until the child exits",
+        "subprocess.check_call": "blocks until the child exits",
+        "subprocess.check_output": "blocks until the child exits",
+    }
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "server", "replica")
+
+    def check(self, ctx: FileContext):
+        for qual, fn, is_async, async_ctx in ctx.functions:
+            if not (is_async or async_ctx):
+                continue
+            where = "async def" if is_async else \
+                "sync helper nested in an async def (runs on the loop)"
+            for node in own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                why = self.BLOCKING.get(name)
+                if why is not None:
+                    yield self.finding(
+                        ctx, node, qual, name,
+                        f"blocking call {name}() inside {where}: {why}")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "result" and not node.args:
+                    yield self.finding(
+                        ctx, node, qual, ".result()",
+                        f".result() inside {where} blocks the loop until "
+                        "the future resolves")
+
+
+class StagePureRule(Rule):
+    """STAGE-PURE: the static twin of the runtime stage/dispatch epoch
+    guard (engine/tpu.py `_dispatch_elem_rows`).
+
+    merge_many splits every CRDT family into STAGE (host-only prep, runs
+    on the staging pool, possibly concurrently) and DISPATCH (device
+    calls + pool bookkeeping, main thread, family order).  A `_stage_*`
+    function touching jax/device state races the main thread's dispatch;
+    a `_dispatch_*` function doing heavy host staging (`_stacked`,
+    `_combine_groups`, `np.stack`) burns the critical path the pipeline
+    exists to hide."""
+
+    name = "STAGE-PURE"
+    hint = ("STAGE runs on the staging pool: keep it numpy+store-plane "
+            "only.  Heavy host prep in DISPATCH belongs in the matching "
+            "_stage_* step (returned via the plan dict)")
+
+    DEVICE_MARKERS = {
+        "_jax", "_put_state", "_put_batch", "_device_get", "_full",
+        "_grow", "_src_state", "_resident_state", "_family_done",
+        "_pool_add", "flush", "device_put", "device_get",
+    }
+    HEAVY_STAGE_CALLS = {"self._stacked", "self._combine_groups",
+                         "np.stack", "numpy.stack"}
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "engine")
+
+    def check(self, ctx: FileContext):
+        for qual, fn, _is_async, _actx in ctx.functions:
+            base = qual.rsplit(".", 1)[-1]
+            if base.startswith("_stage"):
+                for node in own_nodes(fn):
+                    if isinstance(node, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id == "self" and \
+                            node.attr in self.DEVICE_MARKERS:
+                        yield self.finding(
+                            ctx, node, qual, f"self.{node.attr}",
+                            f"STAGE step touches device state "
+                            f"self.{node.attr} — stages run on the "
+                            "staging pool and must stay host-pure")
+                    elif isinstance(node, ast.Name) and \
+                            node.id in ("jax", "jnp"):
+                        yield self.finding(
+                            ctx, node, qual, node.id,
+                            f"STAGE step references {node.id} — device "
+                            "work belongs in the _dispatch_* twin")
+            elif base.startswith("_dispatch"):
+                for node in own_nodes(fn):
+                    if isinstance(node, ast.Call) and \
+                            dotted(node.func) in self.HEAVY_STAGE_CALLS:
+                        yield self.finding(
+                            ctx, node, qual, dotted(node.func),
+                            f"DISPATCH step calls {dotted(node.func)} — "
+                            "heavy host staging on the critical path the "
+                            "pipeline exists to overlap")
+
+
+class CheckThenMutateRule(Rule):
+    """CHECK-THEN-MUTATE: ceilings/invariants are checked BEFORE pool or
+    table state mutates — the `_pool_add` bug class (ADVICE.md round 5:
+    the int32 src-plane ceiling was checked AFTER appending, leaving a
+    half-merged round + orphaned pool entry on overflow).
+
+    In engine code, any `raise` OR `assert` that follows (in source
+    order) a mutation of `self._pool_*` / `self._win_*` / the win value
+    pool / a store-plane `append_block` within the same function is an
+    error: the raise path strands partially-mutated state mid-round.
+    `assert` counts double — it is a raise path AND `python -O` strips
+    it (the codebase's own rule: a real raise, not an assert, guards
+    data loss — engine/tpu.py `_resident_state`)."""
+
+    name = "CHECK-THEN-MUTATE"
+    hint = ("compute the expected outcome and raise BEFORE mutating "
+            "(the fix applied to _pool_add in PR 1), or flush/roll back "
+            "before raising")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "engine")
+
+    @staticmethod
+    def _mutation(node: ast.AST) -> str:
+        """Non-empty description when `node` mutates guarded state."""
+        def _pool_attr(t: ast.AST) -> str:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and (t.attr.startswith("_pool_")
+                         or t.attr.startswith("_win_")
+                         or t.attr == "_val_pool"):
+                return f"self.{t.attr}"
+            return ""
+
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                got = _pool_attr(t)
+                if got:
+                    return got
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name.endswith(".append_block"):
+                return name
+            if name in ("self._val_pool.append", "self._pool_add"):
+                return name
+        return ""
+
+    def check(self, ctx: FileContext):
+        for qual, fn, _is_async, _actx in ctx.functions:
+            first_mut = None   # (lineno, what)
+            events = []        # (lineno, kind, node, what)
+            for node in own_nodes(fn):
+                what = self._mutation(node)
+                if what:
+                    events.append((node.lineno, "mut", node, what))
+                elif isinstance(node, (ast.Raise, ast.Assert)):
+                    kind = "assert" if isinstance(node, ast.Assert) \
+                        else "raise"
+                    events.append((node.lineno, kind, node, ""))
+            events.sort(key=lambda e: e[0])
+            for lineno, kind, node, what in events:
+                if kind == "mut":
+                    if first_mut is None:
+                        first_mut = (lineno, what)
+                elif first_mut is not None:
+                    extra = " (and python -O strips asserts entirely)" \
+                        if kind == "assert" else ""
+                    yield self.finding(
+                        ctx, node, qual, kind,
+                        f"{kind} path at line {lineno} follows the "
+                        f"mutation of {first_mut[1]} at line "
+                        f"{first_mut[0]}: failing here strands "
+                        f"partially-mutated engine state{extra}")
+
+
+class EnvRegistryRule(Rule):
+    """ENV-REGISTRY: every `CONSTDB_*` env read inside the package goes
+    through `conf.py`'s registry helpers and is documented.
+
+    Round 5 grew six tuning knobs read ad hoc across five modules; the
+    README table drifted immediately.  conf.ENV_REGISTRY is now the one
+    place a knob is declared (the helpers raise on unregistered names at
+    runtime; a project-level check pins the registry into the README
+    tuning table)."""
+
+    name = "ENV-REGISTRY"
+    hint = ("declare the variable in conf.ENV_REGISTRY, read it via "
+            "conf.env_str/env_int/env_float/env_flag, and add it to the "
+            "README Tuning table")
+
+    READS = {"os.environ.get", "environ.get", "os.getenv",
+             "os.environ.setdefault", "environ.setdefault"}
+    HELPERS = {"env_str", "env_int", "env_float", "env_flag", "env_raw"}
+
+    def __init__(self) -> None:
+        self._registry: set | None = None
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.basename != "conf.py"
+
+    def registry(self) -> set:
+        if self._registry is None:
+            from .. import conf
+            self._registry = set(conf.ENV_REGISTRY)
+        return self._registry
+
+    @staticmethod
+    def _const_env_name(node: ast.AST) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith("CONSTDB_"):
+            return node.value
+        return ""
+
+    def check(self, ctx: FileContext):
+        # qualname stays "" for this rule: the env-var name IS the
+        # stable identity (path + token), wherever in the file it moves
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and node.args:
+                name = dotted(node.func)
+                env = self._const_env_name(node.args[0])
+                if not env:
+                    continue
+                if name in self.READS:
+                    yield self.finding(
+                        ctx, node, "", env,
+                        f"direct {name}({env!r}) bypasses the conf.py "
+                        "env registry")
+                elif name.rsplit(".", 1)[-1] in self.HELPERS and \
+                        env not in self.registry():
+                    yield self.finding(
+                        ctx, node, "", f"{env}:unregistered",
+                        f"{env} is read via a conf helper but missing "
+                        "from conf.ENV_REGISTRY")
+            elif isinstance(node, ast.Subscript) and \
+                    dotted(node.value) in ("os.environ", "environ"):
+                env = self._const_env_name(node.slice)
+                if env:
+                    yield self.finding(
+                        ctx, node, "", env,
+                        f"os.environ[{env!r}] subscript bypasses the "
+                        "conf.py env registry")
+
+
+class ShmLifecycleRule(Rule):
+    """SHM-LIFECYCLE: every `SharedMemory(create=True)` is close()d AND
+    unlink()ed on all paths.
+
+    A leaked /dev/shm segment survives the process on Linux — N leaked
+    merge-job segments at snapshot scale fill the tmpfs and take the box
+    down.  The creating function must reference <name>.close() and
+    <name>.unlink() from a try handler/finally; creations whose
+    ownership legitimately transfers (e.g. the worker export segment,
+    freed by the parent's export_free round-trip) carry an inline
+    `# lint: ignore[SHM-LIFECYCLE]` with the reason on the spot."""
+
+    name = "SHM-LIFECYCLE"
+    hint = ("wrap the segment's population + hand-off in try/except "
+            "BaseException: close()+unlink()+raise, or document the "
+            "ownership transfer with # lint: ignore[SHM-LIFECYCLE]")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "parallel")
+
+    def check(self, ctx: FileContext):
+        for qual, fn, _a, _c in ctx.functions:
+            creations = []  # (node, var)
+            trys = []
+            for node in own_nodes(fn):
+                if isinstance(node, ast.Try):
+                    trys.append(node)
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                if not dotted(call.func).endswith("SharedMemory"):
+                    continue
+                if not any(kw.arg == "create"
+                           and isinstance(kw.value, ast.Constant)
+                           and kw.value.value is True
+                           for kw in call.keywords):
+                    continue
+                t = node.targets[0]
+                var = t.id if isinstance(t, ast.Name) else \
+                    getattr(t, "attr", "?")
+                creations.append((node, var))
+            if not creations:
+                continue
+
+            def protected(var: str) -> bool:
+                want = {f"{var}.close", f"{var}.unlink",
+                        f"self.{var}.close", f"self.{var}.unlink"}
+                for t in trys:
+                    bodies = list(t.finalbody)
+                    for h in t.handlers:
+                        bodies.extend(h.body)
+                    seen = set()
+                    for stmt in bodies:
+                        for n in ast.walk(stmt):
+                            if isinstance(n, ast.Call):
+                                seen.add(dotted(n.func))
+                    if {f"{var}.close", f"self.{var}.close"} & seen and \
+                            {f"{var}.unlink", f"self.{var}.unlink"} & seen:
+                        return True
+                return False
+
+            for node, var in creations:
+                if not protected(var):
+                    yield self.finding(
+                        ctx, node, qual, var,
+                        f"SharedMemory(create=True) assigned to {var!r} "
+                        "has no try handler/finally calling both "
+                        f"{var}.close() and {var}.unlink() — an error "
+                        "between creation and hand-off leaks the "
+                        "/dev/shm segment")
+
+
+class BareExceptRule(Rule):
+    """BARE-EXCEPT-SWALLOW: no `except Exception: pass` in the
+    replication/apply paths.
+
+    probe_backend() caching failures forever (ADVICE.md round 5) and the
+    close-window zombie link (PR 2) both hid behind broad swallowed
+    excepts.  In replica/, server/, parallel/ and persist/, a bare /
+    Exception / BaseException handler whose body is only `pass` is an
+    error — narrow it to the exceptions the cleanup can actually raise,
+    or at minimum log.  `__del__` is exempt (raising there is worse)."""
+
+    name = "BARE-EXCEPT-SWALLOW"
+    hint = ("narrow to the concrete exceptions (e.g. OSError for fs "
+            "cleanup) or log the swallowed error")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "replica", "server", "parallel", "persist")
+
+    @staticmethod
+    def _broad(h: ast.ExceptHandler) -> bool:
+        t = h.type
+        if t is None:
+            return True
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        return any(isinstance(n, ast.Name)
+                   and n.id in ("Exception", "BaseException")
+                   for n in names)
+
+    @staticmethod
+    def _swallows(h: ast.ExceptHandler) -> bool:
+        return all(isinstance(s, ast.Pass)
+                   or (isinstance(s, ast.Expr)
+                       and isinstance(s.value, ast.Constant))
+                   for s in h.body)
+
+    def check(self, ctx: FileContext):
+        for qual, fn, _a, _c in ctx.functions:
+            if qual.rsplit(".", 1)[-1] == "__del__":
+                continue
+            for node in own_nodes(fn):
+                if isinstance(node, ast.ExceptHandler) and \
+                        self._broad(node) and self._swallows(node):
+                    yield self.finding(
+                        ctx, node, qual, "except-pass",
+                        "broad except swallowing every error in a "
+                        "replication/apply path hides real failures "
+                        "(the probe_backend / zombie-link bug class)")
+
+
+class ForkCaptureRule(Rule):
+    """FORK-CAPTURE: callables crossing the process-pool boundary are
+    module-level functions, and their args are plain data.
+
+    host_pool workers are forkserver children: a lambda / closure /
+    bound method as `target=` either fails to pickle or — worse —
+    drags a captured KeySpace / engine / event loop across the fork,
+    where its native tables and device handles are garbage (the module
+    contract: only shard ids and plane payloads cross the boundary)."""
+
+    name = "FORK-CAPTURE"
+    hint = ("make the worker entry a module-level function; ship shard "
+            "ids + encoded plane bytes, never live store/engine/loop "
+            "objects")
+
+    SUSPECT_ARGS = {"store", "ks", "keyspace", "engine", "eng", "node",
+                    "app", "loop", "server", "self"}
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "parallel")
+
+    def check(self, ctx: FileContext):
+        module_defs = {n.name for n in ast.iter_child_nodes(ctx.tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+        for qual, fn, _a, _c in ctx.functions:
+            nested_defs = {n.name for n in own_nodes(fn)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+            for node in own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if not (name == "Process" or name.endswith(".Process")):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        v = kw.value
+                        if isinstance(v, ast.Lambda):
+                            yield self.finding(
+                                ctx, v, qual, "lambda",
+                                "lambda as a process target captures its "
+                                "defining scope across the fork")
+                        elif isinstance(v, ast.Attribute):
+                            yield self.finding(
+                                ctx, v, qual, dotted(v) or v.attr,
+                                "bound method / attribute as a process "
+                                "target drags its instance across the "
+                                "fork")
+                        elif isinstance(v, ast.Name) and \
+                                v.id in nested_defs and \
+                                v.id not in module_defs:
+                            yield self.finding(
+                                ctx, v, qual, v.id,
+                                f"nested function {v.id!r} as a process "
+                                "target is a closure over the enclosing "
+                                "frame")
+                    elif kw.arg == "args" and \
+                            isinstance(kw.value, (ast.Tuple, ast.List)):
+                        for el in kw.value.elts:
+                            if isinstance(el, ast.Attribute) and \
+                                    isinstance(el.value, ast.Name) and \
+                                    el.value.id == "self":
+                                yield self.finding(
+                                    ctx, el, qual, dotted(el),
+                                    f"{dotted(el)} shipped as a worker "
+                                    "arg: instance state must not cross "
+                                    "the process boundary")
+                            elif isinstance(el, ast.Name) and \
+                                    el.id in self.SUSPECT_ARGS:
+                                yield self.finding(
+                                    ctx, el, qual, el.id,
+                                    f"{el.id!r} shipped as a worker arg "
+                                    "looks like a live store/engine/"
+                                    "loop object — only shard ids and "
+                                    "plane payloads cross the boundary")
+
+
+ALL_RULES: list[Rule] = [
+    AsyncBlockRule(),
+    StagePureRule(),
+    CheckThenMutateRule(),
+    EnvRegistryRule(),
+    ShmLifecycleRule(),
+    BareExceptRule(),
+    ForkCaptureRule(),
+]

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from dataclasses import dataclass
 
 try:
@@ -62,6 +63,77 @@ def _mini_toml_load(f) -> dict:
             except ValueError:
                 data[key] = float(val)
     return data
+
+
+# ------------------------------------------------------------ env registry
+# The ONE place a CONSTDB_* tuning knob is declared.  Reads anywhere in
+# the package go through the env_* helpers below (which raise on
+# unregistered names), the ENV-REGISTRY lint rule rejects direct
+# os.environ reads, and tests/test_analysis.py pins every registered
+# name into the README "Tuning" table — so a knob cannot ship
+# undeclared or undocumented.  Tools OUTSIDE the package (bench.py,
+# opbench.py, tests) may still read their own CONSTDB_BENCH_*/test-only
+# vars directly; the registry covers the operational surface.
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str   # rendered default, for docs/errors (not parsed)
+    doc: str       # one-line effect, mirrored by the README table
+
+
+ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
+    EnvVar("CONSTDB_SHARDS", "auto",
+           "hash-shard count for the process-parallel merge; 1 = the "
+           "exact single-keyspace path"),
+    EnvVar("CONSTDB_SHARD_ENGINE", "tpu|cpu by node engine",
+           "engine each shard worker builds (cpu keeps workers JAX-free)"),
+    EnvVar("CONSTDB_SHARD_FOLD", "auto",
+           "dense-fold strategy carried across the worker process "
+           "boundary (workers cannot take a closure)"),
+    EnvVar("CONSTDB_PIPELINE", "1",
+           "stage/dispatch overlap inside merge_many; 0 = serial path"),
+    EnvVar("CONSTDB_STAGE_WORKERS", "min(4, cores-1)",
+           "threads in the engine's staging pool"),
+    EnvVar("CONSTDB_PROBE_FAIL_TTL", "300",
+           "seconds a FAILED backend probe is cached before re-probing"),
+    EnvVar("CONSTDB_POOL_FLUSH_MB", "1536",
+           "win-value pool cap (MB) before a streamed catch-up "
+           "auto-flushes"),
+    EnvVar("CONSTDB_NO_NATIVE", "",
+           "any value forces the pure-Python table/RESP tiers (floor "
+           "measurement)"),
+)}
+
+
+def _env_read(name: str) -> str | None:
+    if name not in ENV_REGISTRY:
+        raise KeyError(
+            f"{name} is not declared in conf.ENV_REGISTRY — register it "
+            "(name, default, doc) and add a README Tuning row")
+    return os.environ.get(name)
+
+
+def env_str(name: str, default: str = "") -> str:
+    v = _env_read(name)
+    return default if v is None else v
+
+
+def env_int(name: str, default: int) -> int:
+    v = _env_read(name)
+    return default if v is None or v == "" else int(v)
+
+
+def env_float(name: str, default: float) -> float:
+    v = _env_read(name)
+    return default if v is None or v == "" else float(v)
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """'0' (and only '0') is false when the variable is set — matching
+    every pre-registry call site's `!= "0"` convention."""
+    v = _env_read(name)
+    return default if v is None or v == "" else v != "0"
 
 
 @dataclass

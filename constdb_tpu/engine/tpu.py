@@ -219,9 +219,9 @@ class TpuMergeEngine:
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
                             "flush": 0.0}
         self.stage_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0}
-        import os as _os
+        from ..conf import env_flag, env_int
         if pipeline is None:
-            pipeline = _os.environ.get("CONSTDB_PIPELINE", "1") != "0"
+            pipeline = env_flag("CONSTDB_PIPELINE", True)
         self.pipeline = bool(pipeline)
         self._stage_ex = None          # lazy single-worker staging executor
         self._stage_pending = None     # in-flight stage futures (flush joins)
@@ -247,8 +247,7 @@ class TpuMergeEngine:
         # newly-dead ones into GC queue entries after add_t reconstruction
         self._el_del_touched: list[np.ndarray] = []
         self._jit_cache: dict = {}  # keyed per-shape jitted builders
-        self.pool_flush_bytes = int(_os.environ.get(
-            "CONSTDB_POOL_FLUSH_MB", "1536")) << 20
+        self.pool_flush_bytes = env_int("CONSTDB_POOL_FLUSH_MB", 1536) << 20
         self.needs_flush = False
         self._mesh = mesh
         if mesh is not None:
@@ -538,10 +537,11 @@ class TpuMergeEngine:
         if self._stage_ex is None:
             import os as _os
             from concurrent.futures import ThreadPoolExecutor
-            n = int(_os.environ.get(
-                "CONSTDB_STAGE_WORKERS",
-                str(max(1, min(len(self.FAM_ORDER),
-                               (_os.cpu_count() or 2) - 1)))))
+
+            from ..conf import env_int
+            n = env_int("CONSTDB_STAGE_WORKERS",
+                        max(1, min(len(self.FAM_ORDER),
+                                   (_os.cpu_count() or 2) - 1)))
             self._stage_ex = ThreadPoolExecutor(
                 max_workers=max(n, 1), thread_name_prefix="constdb-stage")
         return self._stage_ex
@@ -876,11 +876,22 @@ class TpuMergeEngine:
             created = np.nonzero(kid_of >= n0)[0]
             uniq_ids, first = np.unique(kid_of[created], return_index=True)
             pos = created[first]
-            rows = store.keys.append_block(
+            # interner ids must be exactly the next table block — checked
+            # BEFORE the append mutates the table (CHECK-THEN-MUTATE: a
+            # failure after append_block would strand half-created rows;
+            # and a real raise, because python -O strips asserts)
+            if len(uniq_ids) != n_new or int(uniq_ids[0]) != n0 or \
+                    int(uniq_ids[-1]) != n0 + n_new - 1:
+                span = f"[{int(uniq_ids[0])}, {int(uniq_ids[-1])}]" \
+                    if len(uniq_ids) else "[]"
+                raise RuntimeError(
+                    f"key interner issued non-contiguous new ids {span} "
+                    f"(n={len(uniq_ids)}) for block [{n0}, {n0 + n_new - 1}]")
+            store.keys.append_block(
                 n_new,
                 enc=batch.key_enc[pos], ct=batch.key_ct[pos], mt=0,
                 dt=batch.key_dt[pos], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
-            assert rows[0] == uniq_ids[0] and rows[-1] == uniq_ids[-1]
+            rows = uniq_ids
             store.key_bytes.extend(map(batch.keys.__getitem__, pos.tolist()))
             store.reg_val.extend([None] * n_new)
             st.keys_created += n_new
@@ -1624,10 +1635,22 @@ class TpuMergeEngine:
                     uniq_rows, first = np.unique(rows[created],
                                                  return_index=True)
                     pos = created[first]
-                    got = store.el.append_block(
+                    # combo-index ids must be exactly the next el block —
+                    # checked BEFORE append_block mutates the plane
+                    # (CHECK-THEN-MUTATE; real raise, python -O safe)
+                    if len(uniq_rows) != n_new or \
+                            int(uniq_rows[0]) != rn0 or \
+                            int(uniq_rows[-1]) != rn0 + n_new - 1:
+                        span = f"[{int(uniq_rows[0])}, " \
+                            f"{int(uniq_rows[-1])}]" \
+                            if len(uniq_rows) else "[]"
+                        raise RuntimeError(
+                            f"el combo index issued non-contiguous rows "
+                            f"{span} (n={len(uniq_rows)}) for block "
+                            f"[{rn0}, {rn0 + n_new - 1}]")
+                    store.el.append_block(
                         n_new, kid=kid_arr[keep][pos],
                         add_t=0, add_node=0, del_t=0)
-                    assert got[0] == uniq_rows[0] and got[-1] == uniq_rows[-1]
                     store.el_member.extend(
                         map(members.__getitem__, pos.tolist()))
                     store.el_val.extend([None] * n_new)

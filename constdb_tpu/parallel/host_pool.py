@@ -55,7 +55,8 @@ def _make_engine(spec: str):
     if spec == "cpu":
         from ..engine.cpu import CpuMergeEngine
         return CpuMergeEngine()
-    fold = os.environ.get("CONSTDB_SHARD_FOLD", "auto")
+    from ..conf import env_str
+    fold = env_str("CONSTDB_SHARD_FOLD", "auto")
     if spec in ("tpu", "tpu-resident"):
         from ..engine.tpu import TpuMergeEngine
         return TpuMergeEngine(resident=True, dense_fold=fold)
@@ -165,6 +166,11 @@ def _worker_main(conn, shard: int, n_shards: int, engine_spec: str,
                 from multiprocessing import shared_memory
                 payload = bytes(_encode_batch(
                     batch_from_keyspace(flushed_store())))
+                # ownership transfers across messages BY DESIGN: the
+                # parent copies the segment out, then sends export_free,
+                # whose branch below close()s + unlink()s it; a crashed
+                # worker's segment is reclaimed by the shared resource
+                # tracker at exit.  # lint: ignore[SHM-LIFECYCLE]
                 export_shm = shared_memory.SharedMemory(
                     create=True, size=max(len(payload), 1))
                 export_shm.buf[: len(payload)] = payload
@@ -281,21 +287,31 @@ class HostShardPool:
         total = sum(len(p) for p in planes) + \
             sum(len(e[0]) for e in entries)
         shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
-        off = 0
-        plane_spans = []
-        for p in planes:
-            shm.buf[off:off + len(p)] = p
-            plane_spans.append((off, len(p)))
-            off += len(p)
-        wire = []
-        for payload, tok_k, tok_e, hv, kpid, epid in entries:
-            shm.buf[off:off + len(payload)] = payload
-            wire.append((off, len(payload), tok_k, tok_e, hv, kpid, epid))
-            off += len(payload)
-        jid = self._next_jid
-        self._next_jid += 1
-        self._jobs[jid] = {"acks": self.n_shards, "shm": shm,
-                           "pins": list(pins)}
+        try:
+            # population + registration under a guard: a failure in here
+            # (a bad buffer write, a dead worker pipe) would otherwise
+            # leak the /dev/shm segment until process exit — from
+            # registration onward, reap()/close() own the cleanup
+            off = 0
+            plane_spans = []
+            for p in planes:
+                shm.buf[off:off + len(p)] = p
+                plane_spans.append((off, len(p)))
+                off += len(p)
+            wire = []
+            for payload, tok_k, tok_e, hv, kpid, epid in entries:
+                shm.buf[off:off + len(payload)] = payload
+                wire.append((off, len(payload), tok_k, tok_e, hv, kpid,
+                             epid))
+                off += len(payload)
+            jid = self._next_jid
+            self._next_jid += 1
+            self._jobs[jid] = {"acks": self.n_shards, "shm": shm,
+                               "pins": list(pins)}
+        except BaseException:
+            shm.close()
+            shm.unlink()
+            raise
         for conn in self._conns:
             conn.send(("merge", jid, shm.name, plane_spans, wire))
         return jid
@@ -423,7 +439,7 @@ class HostShardPool:
             try:
                 job["shm"].close()
                 job["shm"].unlink()
-            except Exception:  # pragma: no cover - already gone
+            except OSError:  # pragma: no cover - already gone
                 pass
         self._jobs.clear()
 

@@ -7,8 +7,10 @@ the loop IS the single-writer exec thread, so apply/push steps simply run
 inline between awaits.
 
 Wire protocol (RESP frames on one TCP stream, symmetric after handshake):
-  dialer:   *[sync, 0, node_id, alias, my_addr, resume_uuid]
-  acceptor: *[sync, 1, node_id, alias, my_addr, resume_uuid]
+  dialer:   *[sync, 0, node_id, alias, my_addr, resume_uuid, caps]
+  acceptor: *[sync, 1, node_id, alias, my_addr, resume_uuid, caps]
+  (`caps` is a capability bitmask — CAP_* below; pre-capability peers
+  send 6-item frames and parse as caps=0)
   then each side concurrently pushes its own stream and pulls the peer's:
     *[fullsync, size, repl_last_uuid]  + `size` raw snapshot bytes
     *[partsync]
@@ -56,6 +58,14 @@ PARTSYNC = b"partsync"
 REPLICATE = b"replicate"
 REPLACK = b"replack"
 
+# Handshake capability bits: items[6] of BOTH sync frames (dialer and
+# reply).  A pre-capability peer sends 6-item frames and parses as 0 —
+# absence is tolerated, never assumed to mean support (ADVICE.md round
+# 5: the FULLSYNC reset flag silently downgraded on mixed-version
+# meshes, recreating exactly the resurrection scenario it prevents).
+CAP_FULLSYNC_RESET = 1   # honors FULLSYNC's 4th (state-wipe) field
+MY_CAPS = CAP_FULLSYNC_RESET
+
 _READ_CHUNK = 1 << 16
 
 
@@ -75,6 +85,9 @@ class ReplicaLink:
         # node.reset_epoch at connection install; a mismatch marks this
         # stream as pre-dating a local state wipe (see _pull_loop REPLACK)
         self._epoch = 0
+        # capability bits the peer advertised in the live connection's
+        # handshake (0 = pre-capability peer / no connection yet)
+        self._peer_caps = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -144,7 +157,7 @@ class ReplicaLink:
                 Bulk(SYNC), Int(0), Int(self.node.node_id),
                 Bulk(self.node.alias.encode()),
                 Bulk(self.app.advertised_addr.encode()),
-                Int(self.meta.uuid_he_sent)])))
+                Int(self.meta.uuid_he_sent), Int(MY_CAPS)])))
             await writer.drain()
             parser = make_parser()
             msg = await _read_msg(reader, parser,
@@ -178,15 +191,20 @@ class ReplicaLink:
             raise CstError(f"bad sync reply from {self.meta.addr}: {msg!r}")
         self.meta.node_id = as_int(items[2])
         self.meta.alias = as_bytes(items[3]).decode("utf-8", "replace")
+        self._peer_caps = as_int(items[6]) if len(items) > 6 else 0
         return as_int(items[5])
 
     # ---------------------------------------------------------------- adopt
 
     def adopt(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-              parser: RespParser, peer_resume: int) -> None:
+              parser: RespParser, peer_resume: int,
+              peer_caps: int = 0) -> None:
         """Install an inbound connection (the passive side of SYNC —
-        reference replica.rs:16-40 steals the client's Conn)."""
+        reference replica.rs:16-40 steals the client's Conn).
+        `peer_caps`: capability bits from the peer's SYNC frame (0 = a
+        pre-capability peer)."""
         self.meta.dial_suspended = False  # the mesh re-admitted us
+        self._peer_caps = peer_caps
         self._install(reader, writer, parser, peer_resume)
 
     def kick(self) -> None:
@@ -272,8 +290,28 @@ class ReplicaLink:
                         # keys whose tombstones we already collected — a
                         # plain snapshot merge cannot delete them, so it
                         # must WIPE before merging (fullsync reset flag)
+                        reset = meta.needs_full
+                        if reset and not (self._peer_caps
+                                          & CAP_FULLSYNC_RESET):
+                            # a pre-capability peer would silently merge
+                            # WITHOUT wiping — the exact resurrection
+                            # scenario the reset flag exists to prevent.
+                            # Refuse loudly instead of downgrading; the
+                            # dial loop retries with backoff until the
+                            # peer upgrades (or an operator intervenes).
+                            log.error(
+                                "push %s: peer needs a state-clearing "
+                                "full resync but did not advertise the "
+                                "fullsync-reset capability (mixed-"
+                                "version mesh?); refusing to downgrade "
+                                "to a non-wiping sync", meta.addr)
+                            x = node.stats.extra
+                            x["fullsync_reset_refused"] = \
+                                x.get("fullsync_reset_refused", 0) + 1
+                            writer.close()
+                            return
                         cursor = await self._send_snapshot(
-                            writer, reset=meta.needs_full)
+                            writer, reset=reset)
                     synced = True
                     meta.needs_full = False
 
@@ -454,7 +492,8 @@ class ReplicaLink:
             # transitive mesh join (reference pull.rs:136-153) + watermark
             # adoption, now that the state backing them is fully merged
             node.replicas.merge_records(replica_rows,
-                                        my_addr=self.app.advertised_addr)
+                                        my_addr=self.app.advertised_addr,
+                                        adopt_watermarks=True)
         if repl_last > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = repl_last
         node.hlc.observe(repl_last)
@@ -578,7 +617,8 @@ class ReplicaLink:
         from ..store.sharded_keyspace import ShardedKeySpace
         node = self.node
         loop = asyncio.get_running_loop()
-        spec = os.environ.get("CONSTDB_SHARD_ENGINE") or \
+        from ..conf import env_str
+        spec = env_str("CONSTDB_SHARD_ENGINE") or \
             ("tpu" if getattr(node.engine, "name", "") == "tpu" else "cpu")
         sks = ShardedKeySpace(n_shards=shards, mode="process",
                               engine_spec=spec,

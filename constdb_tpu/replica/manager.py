@@ -128,20 +128,28 @@ class ReplicaManager:
         return [m for m in self.peers.values() if m.alive]
 
     def merge_records(self, rows: Iterable[ReplicaRecord],
-                      my_addr: str = "") -> list[ReplicaMeta]:
+                      my_addr: str = "",
+                      adopt_watermarks: bool = False) -> list[ReplicaMeta]:
         """Merge a REPLICAS snapshot section (LWW per addr); returns peers
         that became live-and-new (candidates for transitive MEET).
 
-        The recorded PULL WATERMARK (uuid_he_sent) is adopted (max-merge).
-        Every caller merges the snapshot's full keyspace state alongside
-        this section, so ops below the recorded watermark are already
-        reflected in what we just merged — resuming from it is lossless.
-        NOT adopting it is a convergence bug, not merely wasteful: a
-        cold-restarted node would dial with resume 0, and peers would
-        replay their whole ring — re-delivering ADDS whose tombstones the
-        whole mesh already GC-collected, resurrecting deleted members
-        with no surviving delete op anywhere to kill them again (found by
-        the round-5 chaos suite)."""
+        `adopt_watermarks=True` additionally max-merges each record's
+        PULL WATERMARK (uuid_he_sent).  That is ONLY lossless when the
+        caller merges the snapshot's full keyspace state in the same
+        operation — ops below the recorded watermark are then already
+        reflected locally, so resuming from it skips nothing.  The two
+        snapshot-backed call sites (replica/link.py full-sync apply,
+        server/io.py boot restore) pass True; a bare membership merge
+        (e.g. a future gossip-style exchange) MUST NOT — adopting
+        watermarks without the backing state silently skips op
+        re-delivery (ADVICE.md round 5: the coupling was previously
+        enforced by comment only).  For the snapshot-backed sites,
+        adopting is itself a convergence requirement, not merely a
+        saving: a cold-restarted node dialing with resume 0 makes peers
+        replay their whole ring — re-delivering ADDS whose tombstones
+        the mesh already GC-collected, resurrecting deleted members with
+        no surviving delete op to kill them again (round-5 chaos
+        suite)."""
         fresh = []
         for r in rows:
             if r.addr == my_addr:
@@ -162,7 +170,7 @@ class ReplicaManager:
                 m.node_id = r.node_id
             if r.alias and not m.alias:
                 m.alias = r.alias
-            if r.uuid_he_sent > m.uuid_he_sent:
+            if adopt_watermarks and r.uuid_he_sent > m.uuid_he_sent:
                 m.uuid_he_sent = r.uuid_he_sent
             if is_new and m.alive:
                 fresh.append(m)

@@ -276,6 +276,9 @@ class ServerApp:
             peer_alias = as_bytes(items[3]).decode("utf-8", "replace")
             peer_addr = as_bytes(items[4]).decode("utf-8", "replace")
             peer_resume = as_int(items[5])
+            # capability bits (replica/link.py CAP_*); a pre-capability
+            # peer sends 6-item frames — tolerate, never assume support
+            peer_caps = as_int(items[6]) if len(items) > 6 else 0
         except (IndexError, CstError):
             writer.write(b"-malformed sync\r\n")
             writer.close()
@@ -308,12 +311,14 @@ class ServerApp:
             # membership through full syncs (pull.rs:136-153), which leaves
             # hub-and-spoke topologies permanently partitioned
             node.execute([Bulk(b"meet"), Bulk(peer_addr.encode())])
+        from ..replica.link import MY_CAPS
         writer.write(encode_msg_arr([
             Bulk(SYNC), Int(1), Int(node.node_id), Bulk(node.alias.encode()),
-            Bulk(self.advertised_addr.encode()), Int(meta.uuid_he_sent)]))
+            Bulk(self.advertised_addr.encode()), Int(meta.uuid_he_sent),
+            Int(MY_CAPS)]))
         link = meta.link if isinstance(meta.link, ReplicaLink) else \
             ReplicaLink(self, meta)
-        link.adopt(reader, writer, parser, peer_resume)
+        link.adopt(reader, writer, parser, peer_resume, peer_caps=peer_caps)
         link.start()  # dial loop doubles as the reconnect supervisor
 
 
@@ -349,7 +354,11 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
         # point falls outside the ring (push.rs:95-110).
         node.repl_log.last_uuid = meta.repl_last_uuid
         node.repl_log.evicted_up_to = meta.repl_last_uuid
-        node.replicas.merge_records(records, my_addr=app.advertised_addr)
+        # snapshot-backed: the restored keyspace carries the state behind
+        # the recorded watermarks, so adopting them is lossless (and
+        # required — see merge_records)
+        node.replicas.merge_records(records, my_addr=app.advertised_addr,
+                                    adopt_watermarks=True)
         log.info("restored snapshot %s (%d keys)", app.snapshot_path,
                  node.ks.n_keys())
     await app.start()

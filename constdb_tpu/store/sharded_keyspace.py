@@ -55,7 +55,8 @@ def default_shards() -> int:
     """CONSTDB_SHARDS, defaulting to 1 (today's exact single-keyspace
     path) on <= 2 cores — process-parallel merge needs spare cores to
     help — and to the core count (capped) above that."""
-    env = os.environ.get("CONSTDB_SHARDS")
+    from ..conf import env_str
+    env = env_str("CONSTDB_SHARDS")
     if env:
         return max(1, min(int(env), MAX_SHARDS))
     ncpu = os.cpu_count() or 1

@@ -58,8 +58,8 @@ def probe_backend(timeout: float = 90.0, cached: bool = True,
     env CONSTDB_PROBE_FAIL_TTL), after which the next call re-probes."""
     import time as _time
     if fail_ttl is None:
-        fail_ttl = float(os.environ.get("CONSTDB_PROBE_FAIL_TTL",
-                                        str(FAILED_PROBE_TTL)))
+        from ..conf import env_float
+        fail_ttl = env_float("CONSTDB_PROBE_FAIL_TTL", FAILED_PROBE_TTL)
     if cached and _PROBE_MEMO:
         probe, ts = _PROBE_MEMO[0]
         if probe.ok or _time.monotonic() - ts < fail_ttl:

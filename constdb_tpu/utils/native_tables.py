@@ -48,7 +48,8 @@ def load_ext():
     """The CPython extension module, or None.  CONSTDB_NO_NATIVE=1 forces
     the pure-Python tiers (A/B floor measurement — opbench.py)."""
     global _ext
-    if os.environ.get("CONSTDB_NO_NATIVE"):
+    from ..conf import env_str
+    if env_str("CONSTDB_NO_NATIVE"):
         return None
     if _ext is not None:
         return _ext or None

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# The full gate, one command:
+#   1. invariant lint (baseline mode)        — scripts/lint.sh
+#   2. tier-1 suite + slow-marker audit      — scripts/audit_markers.sh
+#      (same pytest selection as scripts/t1.sh, plus the per-test
+#      budget check, so the suite runs ONCE for both purposes)
+# Exit code is the first failure's; each stage prints its own verdict.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint (baseline mode) =="
+./scripts/lint.sh || exit $?
+
+echo
+echo "== tier-1 tests + slow-marker audit =="
+./scripts/audit_markers.sh "$@" || exit $?
+
+echo
+echo "ci.sh: all gates green"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Invariant lint in baseline mode: fails only on findings NOT recorded
+# in constdb_tpu/analysis/baseline.json (growth).  Rule ↔ incident map:
+# docs/INVARIANTS.md.  Extra args pass through (e.g. --write-baseline,
+# explicit paths, --list-rules).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m constdb_tpu.analysis --baseline "$@"

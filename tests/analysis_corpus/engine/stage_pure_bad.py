@@ -1,0 +1,23 @@
+"""Seeded STAGE-PURE violations (never imported)."""
+import numpy as np
+
+
+class FakeEngine:
+    def _stage_widgets(self, store, resolved, st):
+        dev = self._put_batch(np.zeros(4))       # STAGE-PURE: device call
+        self._jax.block_until_ready(dev)         # STAGE-PURE: self._jax
+        return {"staged": dev}
+
+    def _stage_gadgets(self, store, resolved, st):
+        import jax
+        return jax.numpy.zeros(4)                # STAGE-PURE: jax in stage
+
+    def _dispatch_widgets(self, store, plan, st):
+        stack = np.stack([plan["staged"]])       # STAGE-PURE: heavy staging
+        combined = self._combine_groups(         # STAGE-PURE: stage helper
+            [stack], None, None)
+        return combined
+
+    def _dispatch_clean(self, store, plan, st):
+        return self._put_batch(plan["staged"])   # clean: device work is
+        #                                          dispatch's job

@@ -1,0 +1,20 @@
+"""Seeded ENV-REGISTRY violations (never imported)."""
+import os
+
+from constdb_tpu.conf import env_int
+
+
+def direct_read():
+    return os.environ.get("CONSTDB_SECRET_KNOB", "1")   # ENV-REGISTRY
+
+
+def subscript_read():
+    return os.environ["CONSTDB_OTHER_KNOB"]             # ENV-REGISTRY
+
+
+def unregistered_helper_read():
+    return env_int("CONSTDB_NOT_IN_REGISTRY", 3)        # ENV-REGISTRY
+
+
+def fine():
+    return env_int("CONSTDB_POOL_FLUSH_MB", 1536)       # clean: registered

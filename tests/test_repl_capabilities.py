@@ -1,0 +1,177 @@
+"""Handshake capability negotiation (replica/link.py CAP_*) and explicit
+watermark adoption (replica/manager.py merge_records).
+
+ADVICE.md round 5: the FULLSYNC `reset` (state-wipe) flag silently
+downgraded on mixed-version meshes — a pre-flag peer merged the snapshot
+WITHOUT wiping, recreating exactly the resurrection scenario the flag
+prevents, with no error on either side.  The handshake now advertises a
+capability bitmask (items[6] of both SYNC frames) and the pusher
+log-and-REFUSES the state-clearing resync when the peer lacks it."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_link_pushloop import _Writer, _log_write, _mk_link  # noqa: E402
+
+from constdb_tpu.persist.snapshot import ReplicaRecord  # noqa: E402
+from constdb_tpu.replica.link import (CAP_FULLSYNC_RESET, FULLSYNC,  # noqa: E402
+                                      MY_CAPS)
+from constdb_tpu.replica.manager import ReplicaManager  # noqa: E402
+from constdb_tpu.resp.codec import make_parser  # noqa: E402
+from constdb_tpu.resp.message import Arr, Bulk, Int, as_bytes, as_int  # noqa: E402
+
+
+def _fullsync_reset_flags(buf: bytes):
+    """Every FULLSYNC frame's 4th (reset) field in the written stream."""
+    parser = make_parser()
+    parser.feed(bytes(buf))
+    out = []
+    while (msg := parser.next_msg()) is not None:
+        items = msg.items if isinstance(msg, Arr) else None
+        if not items or as_bytes(items[0]).lower() != FULLSYNC:
+            continue
+        out.append(as_int(items[3]) if len(items) > 3 else None)
+        size = as_int(items[1])
+        raw = parser.take_raw(size)
+        while raw is not None and len(raw) < size:
+            more = parser.take_raw(size - len(raw))
+            if not more:
+                break
+            raw += more
+    return out
+
+
+def _off_ring_link(tmp_path, needs_full: bool, peer_caps: int):
+    """A link whose peer resume point (0) fell off the repl_log ring, so
+    the first push round must decide full-vs-refuse."""
+    node, app, link = _mk_link(tmp_path, cap=500)
+    for i in range(120):
+        _log_write(node, i)
+    assert not node.repl_log.can_resume_from(0)
+    link.meta.needs_full = needs_full
+    link._peer_caps = peer_caps
+    return node, app, link
+
+
+def test_pusher_refuses_reset_without_capability(tmp_path, caplog):
+    """needs_full peer + caps=0 (pre-capability build): NO snapshot is
+    streamed, the connection drops, the refusal is logged + counted, and
+    needs_full stays latched for the retry."""
+    async def main():
+        node, app, link = _off_ring_link(tmp_path, needs_full=True,
+                                         peer_caps=0)
+        writer = _Writer()
+        await asyncio.wait_for(link._push_loop(writer, peer_resume=0),
+                               timeout=5.0)
+        assert writer.closed, "refusal must drop the connection"
+        assert _fullsync_reset_flags(writer.buf) == []
+        assert app.shared_dump.dumps == 0, "no snapshot for a refused sync"
+        assert node.stats.extra.get("fullsync_reset_refused") == 1
+        assert link.meta.needs_full is True, "refusal must not consume " \
+            "the needs_full latch"
+        assert any("fullsync-reset capability" in r.message
+                   for r in caplog.records)
+    asyncio.run(main())
+
+
+def test_pusher_sends_wiping_resync_with_capability(tmp_path):
+    """Same situation, peer advertises CAP_FULLSYNC_RESET: FULLSYNC with
+    reset=1 streams and the needs_full latch clears."""
+    async def main():
+        node, app, link = _off_ring_link(
+            tmp_path, needs_full=True, peer_caps=CAP_FULLSYNC_RESET)
+        writer = _Writer()
+        task = asyncio.create_task(link._push_loop(writer, peer_resume=0))
+        try:
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                if _fullsync_reset_flags(writer.buf):
+                    break
+        finally:
+            task.cancel()
+        assert _fullsync_reset_flags(writer.buf) == [1]
+        assert app.shared_dump.dumps == 1
+        assert link.meta.needs_full is False
+        assert not writer.closed
+    asyncio.run(main())
+
+
+def test_plain_fullsync_keeps_reset_zero(tmp_path):
+    """An ordinary off-ring catch-up (needs_full=False) never wipes —
+    whatever the peer's capabilities."""
+    async def main():
+        node, app, link = _off_ring_link(tmp_path, needs_full=False,
+                                         peer_caps=0)
+        writer = _Writer()
+        task = asyncio.create_task(link._push_loop(writer, peer_resume=0))
+        try:
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                if _fullsync_reset_flags(writer.buf):
+                    break
+        finally:
+            task.cancel()
+        assert _fullsync_reset_flags(writer.buf) == [0]
+    asyncio.run(main())
+
+
+def test_check_sync_reply_parses_caps(tmp_path):
+    node, app, link = _mk_link(tmp_path)
+    reply = Arr([Bulk(b"sync"), Int(1), Int(7), Bulk(b"peer"),
+                 Bulk(b"127.0.0.1:2"), Int(42), Int(MY_CAPS)])
+    assert link._check_sync_reply(reply) == 42
+    assert link._peer_caps == MY_CAPS
+    legacy = Arr([Bulk(b"sync"), Int(1), Int(7), Bulk(b"peer"),
+                  Bulk(b"127.0.0.1:2"), Int(42)])  # 6-item pre-cap frame
+    assert link._check_sync_reply(legacy) == 42
+    assert link._peer_caps == 0
+
+
+def test_caps_exchanged_end_to_end(tmp_path):
+    """Real two-node handshake: both sides land on MY_CAPS."""
+    from cluster_util import Client, close_cluster, make_cluster
+
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        try:
+            c = await Client().connect(apps[0].advertised_addr)
+            await c.cmd("meet", apps[1].advertised_addr)
+            for _ in range(200):
+                await asyncio.sleep(0.05)
+                links = [m.link for a in apps
+                         for m in a.node.replicas.live_peers()
+                         if m.link is not None and m.link.connected]
+                if len(links) >= 2:
+                    break
+            assert len(links) >= 2
+            assert all(lk._peer_caps == MY_CAPS for lk in links)
+            await c.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
+
+
+# --------------------------------------------------- watermark adoption
+
+def test_merge_records_watermarks_opt_in():
+    """A bare membership merge must NOT adopt pull watermarks (it has no
+    keyspace state behind them); the snapshot-backed call sites opt in
+    explicitly (ADVICE.md round 5)."""
+    rows = [ReplicaRecord("10.0.0.9:1", 9, "p", add_t=5,
+                          uuid_he_sent=1_000)]
+    mgr = ReplicaManager()
+    got = mgr.merge_records(rows)  # bare membership merge
+    assert got and got[0].addr == "10.0.0.9:1"
+    assert mgr.get("10.0.0.9:1").uuid_he_sent == 0
+
+    mgr2 = ReplicaManager()
+    mgr2.merge_records(rows, adopt_watermarks=True)  # snapshot-backed
+    assert mgr2.get("10.0.0.9:1").uuid_he_sent == 1_000
+    # LWW max-merge: an older record never regresses the watermark
+    mgr2.merge_records([ReplicaRecord("10.0.0.9:1", 9, "p", add_t=5,
+                                      uuid_he_sent=500)],
+                       adopt_watermarks=True)
+    assert mgr2.get("10.0.0.9:1").uuid_he_sent == 1_000
