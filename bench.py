@@ -364,6 +364,245 @@ def time_engine(make_engine, chunks, repeats: int = 2,
     return best, store
 
 
+# --------------------------------------------------------------------------
+# --mode stream: steady-state replication apply (the coalescing pull path)
+
+
+def make_frame_log(n_frames: int, n_keys: int, seed: int = 11) -> list:
+    """Deterministic replicate-frame log over a mixed keyspace — the
+    shape one peer's steady-state stream has on the wire (REPLICATE
+    frames with monotone HLC uuids from one origin), including the DEL
+    rewrites that act as coalescer barriers."""
+    import random
+
+    from constdb_tpu.resp.message import Bulk, Int
+
+    rng = random.Random(seed)
+    frames = []
+    prev = 0
+    for i in range(1, n_frames + 1):
+        uuid = (MS0 + i) << SEQ_BITS
+        k = b"%06d" % rng.randrange(n_keys)
+        r = rng.random()
+        if r < 0.30:
+            body = (b"set", b"r" + k, b"v%08d" % i)
+        elif r < 0.52:
+            body = (b"cntset", b"c" + k, rng.randrange(-10_000, 10_000))
+        elif r < 0.72:
+            # multi-member set writes (tag/follower-list shape)
+            body = (b"sadd", b"s" + k,
+                    *(b"m%03d" % rng.randrange(64) for _ in range(4)))
+        elif r < 0.80:
+            body = (b"srem", b"s" + k, b"m%03d" % rng.randrange(64))
+        elif r < 0.90:
+            # multi-field record writes (YCSB's canonical user-record
+            # workload writes 10 fields per op; 5 here is conservative)
+            fv = []
+            for f in range(5):
+                fv += [b"f%02d" % rng.randrange(16), b"v%07d%d" % (i, f)]
+            body = (b"hset", b"h" + k, *fv)
+        elif r < 0.995:
+            body = (b"hdel", b"h" + k, b"f%02d" % rng.randrange(16))
+        elif r < 0.998:
+            body = (b"delbytes", b"r" + k)   # scalar DEL: coalesces
+        else:
+            body = (b"delset", b"s" + k)     # collection DEL: barrier
+        # DELs are ~0.5% of the stream: ConstDB's serving workload is
+        # write-once constant data (PAPER.md), so deletes are
+        # administrative, not steady-state — but they must be PRESENT so
+        # the bench exercises the barrier flush machinery for real
+        frames.append([Bulk(b"replicate"), Int(99), Int(prev), Int(uuid),
+                       Bulk(body[0]),
+                       *[Int(a) if isinstance(a, int) else Bulk(a)
+                         for a in body[1:]]])
+        prev = uuid
+    return frames
+
+
+def save_frame_log(path: str, frames: list) -> None:
+    from constdb_tpu.resp.codec import encode_msg
+    from constdb_tpu.resp.message import Arr
+
+    with open(path, "wb") as f:
+        for items in frames:
+            f.write(encode_msg(Arr(items)))
+
+
+def load_frame_log(path: str) -> list:
+    from constdb_tpu.resp.codec import make_parser
+
+    parser = make_parser()
+    frames = []
+    with open(path, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            parser.feed(data)
+            while (msg := parser.next_msg()) is not None:
+                frames.append(msg.items)
+    return frames
+
+
+def replay_stream(frames, make_engine, apply_batch: int,
+                  latency_s: float):
+    """Replay a frame log through the coalescing applier exactly the way
+    the pull loop drives it.  Returns (node, wall_seconds,
+    per-frame visibility latencies) — visibility = intake→landed."""
+    from constdb_tpu.replica.coalesce import CoalescingApplier
+    from constdb_tpu.replica.manager import ReplicaMeta
+    from constdb_tpu.server.node import Node
+
+    node = Node(node_id=1, engine=make_engine())
+    applier = CoalescingApplier(node, ReplicaMeta("bench-peer:0"),
+                                max_frames=apply_batch,
+                                max_latency=latency_s,
+                                now=time.perf_counter)
+    # visibility latency is SAMPLED (every 64th frame): per-frame clock
+    # reads would tax the measured path itself, and ~1.5% of a frame log
+    # is ample for a p99.  Sampled frames drain into `lat` when the
+    # batch covering them actually LANDS (merge_stream_batch hook) — the
+    # definition of visibility the coalescer's watermark rule uses.
+    lat: list[float] = []
+    pending_ts: list[float] = []
+    clock = time.perf_counter
+    real_land = node.merge_stream_batch
+
+    def landing(bb, n):
+        real_land(bb, n)
+        now = clock()
+        lat.extend(now - t for t in pending_ts)
+        pending_ts.clear()
+
+    node.merge_stream_batch = landing
+    t0 = clock()
+    for i, items in enumerate(frames):
+        applier.apply(items)
+        if not i & 63:
+            if not applier.pending:  # landed immediately (barrier /
+                lat.append(0.0)      # per-frame path)
+            else:
+                pending_ts.append(clock())
+    applier.flush()
+    node.ensure_flushed()
+    end = clock()
+    lat.extend(end - t for t in pending_ts)
+    node.merge_stream_batch = real_land
+    return node, end - t0, lat
+
+
+def stream_main(args) -> None:
+    """`bench.py --mode stream`: coalesced steady-state apply vs the
+    exact per-frame path (CONSTDB_APPLY_BATCH=1 degenerate), replaying
+    one recorded frame log through both and oracle-comparing the final
+    stores.  Emits ONE JSON line with frames/s + p99 visibility."""
+    n_frames = int(os.environ.get("CONSTDB_BENCH_FRAMES", 200_000))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_STREAM_KEYS", 20_000))
+    apply_batch = int(os.environ.get("CONSTDB_BENCH_APPLY_BATCH", 4096))
+    latency_s = float(os.environ.get("CONSTDB_BENCH_APPLY_LATENCY_MS",
+                                     1000.0)) / 1000.0
+    engine_kind = os.environ.get("CONSTDB_BENCH_STREAM_ENGINE", "xla")
+
+    ensure_native()
+    if args.frame_log and os.path.exists(args.frame_log):
+        frames = load_frame_log(args.frame_log)
+        print(f"[bench] replaying recorded frame log {args.frame_log}: "
+              f"{len(frames)} frames", file=sys.stderr)
+    else:
+        t0 = time.perf_counter()
+        frames = make_frame_log(n_frames, n_keys)
+        print(f"[bench] frame log gen: {len(frames)} frames over "
+              f"~{n_keys} keys in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        if args.frame_log:
+            save_frame_log(args.frame_log, frames)
+            print(f"[bench] recorded to {args.frame_log}", file=sys.stderr)
+
+    note = ""
+    if engine_kind == "cpu":
+        make_engine = CpuMergeEngine
+        backend = "none"
+    else:
+        from constdb_tpu.utils.backend import (force_cpu_platform,
+                                               probe_backend)
+
+        probe = probe_backend()
+        if not probe.ok:
+            note = (f"device backend unavailable ({probe.error}); "
+                    "XLA-on-CPU fallback")
+            print(f"[bench] WARNING: {note}", file=sys.stderr)
+            force_cpu_platform()
+        from constdb_tpu.engine.tpu import TpuMergeEngine
+        import jax
+
+        backend = jax.default_backend()
+        make_engine = TpuMergeEngine
+
+    # both paths replay the SAME log, interleaved, best-of-3 (the same
+    # convention the snapshot bench uses — one unlucky run on a shared
+    # box must not be the round's number).  The per-frame leg
+    # (apply_batch=1 routes every frame through node.apply_replicated —
+    # the pre-coalescing hot loop) doubles as the verification oracle.
+    wall = base_wall = float("inf")
+    node = base_node = lat = None
+    for _ in range(3):
+        n_, w_, l_ = replay_stream(frames, make_engine,
+                                   apply_batch=apply_batch,
+                                   latency_s=latency_s)
+        if w_ < wall:
+            node, wall, lat = n_, w_, l_
+        bn_, bw_, _ = replay_stream(frames, CpuMergeEngine,
+                                    apply_batch=1, latency_s=1.0)
+        if bw_ < base_wall:
+            base_node, base_wall = bn_, bw_
+    base_fps = len(frames) / base_wall
+    print(f"[bench] per-frame path: {base_wall:.3f}s = "
+          f"{base_fps:,.0f} frames/s", file=sys.stderr)
+    fps = len(frames) / wall
+    lat_ms = np.asarray(lat) * 1000.0
+    p50, p99 = (float(np.percentile(lat_ms, q)) for q in (50, 99))
+    print(f"[bench] coalesced (batch={apply_batch}, engine={engine_kind}/"
+          f"{backend}): {wall:.3f}s = {fps:,.0f} frames/s "
+          f"({fps / base_fps:.2f}x); visibility p50 {p50:.2f}ms "
+          f"p99 {p99:.2f}ms; {node.stats.repl_coalesce_flushes} flushes, "
+          f"{node.stats.repl_apply_barriers} barriers", file=sys.stderr)
+
+    got, want = node.canonical(), base_node.canonical()
+    n_diff = compare_canonical(got, want)
+    verified = n_diff == 0
+    print(f"[bench] verify: {'OK' if verified else 'MISMATCH'} on "
+          f"{len(want)} keys ({n_diff} diffs)", file=sys.stderr)
+
+    out = {
+        "metric": "stream_apply_frames_per_sec",
+        "value": round(fps, 1),
+        "unit": "frames/sec",
+        "mode": "stream",
+        "frames": len(frames),
+        "stream_keys": n_keys,
+        "wall_s": round(wall, 3),
+        "per_frame_baseline_fps": round(base_fps, 1),
+        "vs_per_frame": round(fps / base_fps, 2),
+        "visibility_p50_ms": round(p50, 3),
+        "visibility_p99_ms": round(p99, 3),
+        "apply_batch": apply_batch,
+        "coalesce_flushes": node.stats.repl_coalesce_flushes,
+        "apply_barriers": node.stats.repl_apply_barriers,
+        "engine": engine_kind,
+        "backend": backend,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    if note:
+        out["note"] = note
+    eng = getattr(node, "engine", None)
+    if hasattr(eng, "close"):
+        eng.close()
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
+
+
 def main() -> None:
     import argparse
 
@@ -373,7 +612,18 @@ def main() -> None:
                     help="hash-shard the host merge across this many "
                     "worker processes (default: CONSTDB_SHARDS / auto; "
                     "1 = single-keyspace path)")
+    ap.add_argument("--mode", choices=["snapshot", "stream"],
+                    default="snapshot",
+                    help="snapshot = bulk catch-up merge (default); "
+                    "stream = steady-state replication apply through the "
+                    "coalescing pull path")
+    ap.add_argument("--frame-log", default=None,
+                    help="stream mode: record the generated frame log "
+                    "here (or replay it if the file exists)")
     args, _ = ap.parse_known_args()
+    if args.mode == "stream":
+        stream_main(args)
+        return
     # default = the BASELINE.json north-star scale (10M keys x 8 replicas);
     # the CPU baseline rate is measured on a capped key count (the per-row
     # engine's keys/sec is scale-flat, the 10M run would take ~20 min)

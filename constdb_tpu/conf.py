@@ -103,6 +103,12 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_NO_NATIVE", "",
            "any value forces the pure-Python table/RESP tiers (floor "
            "measurement)"),
+    EnvVar("CONSTDB_APPLY_BATCH", "512",
+           "max replicate frames coalesced into one merge on the "
+           "steady-state pull path; 1 = the exact per-frame path"),
+    EnvVar("CONSTDB_APPLY_LATENCY_MS", "5",
+           "max ms a coalesced replicate frame may wait before its "
+           "batch is force-flushed (idle streams flush immediately)"),
 )}
 
 

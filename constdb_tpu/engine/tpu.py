@@ -159,6 +159,11 @@ class TpuMergeEngine:
     # vector on device (iota) instead of uploading it; below it the jit
     # dispatch overhead outweighs the saved bytes (tests lower it to 1)
     IDX_IOTA_MIN = 4096
+    # op-stream micro-batches (rows_unique_per_slot=False) at or below
+    # this many total rows merge on HOST (engine/hostbatch.py): at that
+    # scale device dispatch fixed costs dwarf the merge, and the
+    # steady-state coalescer flushes such batches every few ms
+    HOST_SCATTER_MAX = 1 << 15
     # win-source pool ids live in an int32 device plane; merge_many flushes
     # before staging a round that could cross this (tests lower it)
     POOL_ID_CEILING = 1 << 31
@@ -206,6 +211,9 @@ class TpuMergeEngine:
         self._jax = jax
         self._devices = jax.devices()
         self.dense_fold = dense_fold
+        # staged copy of the fold/no-fold decision (merge_many prologue
+        # refreshes it; stages must not probe the backend themselves)
+        self._fold_on = dense_fold != "off"
         self.folds = 0          # aligned folds performed (observability)
         # stale-mirror rebuilds per family (observability: mixed op/merge
         # traffic must keep these O(writes-to-that-plane), never O(ops))
@@ -217,7 +225,7 @@ class TpuMergeEngine:
         # time — staging overlapped with device compute shows up there
         # while family_secs shrinks to the un-overlapped remainder.
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
-                            "flush": 0.0}
+                            "flush": 0.0, "host": 0.0}
         self.stage_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0}
         from ..conf import env_flag, env_int
         if pipeline is None:
@@ -472,7 +480,30 @@ class TpuMergeEngine:
                 kid_of = self._resolve_keys(store, b, st)
                 memo[mk] = kid_of
             resolved.append((b, kid_of))
+        if not self._unique_ok and self._mesh is None and \
+                sum(b.n_rows for b in batches) <= self.HOST_SCATTER_MAX:
+            # third placement strategy: op-stream micro-batches (the
+            # steady-state coalescer's flushes) merge on host — the
+            # duplicate-tolerant scatter path's device round-trips cost
+            # more than the merge at this scale.  Any resident mirror of
+            # the touched planes syncs down first, exactly like the
+            # device scatter path would via _drop_family.
+            from .hostbatch import merge_host_batch
+            for fam in list(self._res):
+                self._drop_family(store, fam)
+            import time as _time
+            t0 = _time.perf_counter()
+            for b, kid_of in resolved:
+                merge_host_batch(store, b, kid_of, st)
+            self.family_secs["host"] += _time.perf_counter() - t0
+            return st
         import time as _time
+        # the fold/no-fold decision is STAGED (the [R, N] stack builds it
+        # gates are host work that belongs on the staging pool, not the
+        # dispatch critical path) but _fold_backend reads device state
+        # (jax default backend / pallas health), so resolve it HERE in the
+        # serial prologue and let stages read the plain boolean
+        self._fold_on = self._fold_backend() != "off"
         stage = {"env": self._stage_envelopes, "reg": self._stage_registers,
                  "cnt": self._stage_counter_rows, "el": self._stage_elem_rows}
         dispatch = {"env": self._dispatch_envelopes,
@@ -1134,38 +1165,65 @@ class TpuMergeEngine:
 
     def _stage_envelopes(self, store: KeySpace, resolved, st):
         """STAGE (host-only): columnarize + group-combine the envelope
-        plane.  Runs on the staging worker under the pipeline."""
-        staged = []  # (pos, [ct, mt, dt, exp])
+        plane as [n, 4] ct/mt/dt/expire matrices, then make the WHOLE
+        placement decision (host-fold vs bulk vs scatter, device fold or
+        not) and pre-build every host-side array the dispatch twin will
+        upload — including the [R, N, 4] fold stack and the non-resident
+        state matrix (both were dispatch-side host work on the critical
+        path; STAGE-PURE).  Reading the store's env columns here is safe:
+        this plane is only written by _dispatch_envelopes, which the
+        pipeline orders strictly after this stage."""
+        staged = []  # (pos, [n, 4] matrix)
         for b, kid_of in resolved:
             valid = np.nonzero(kid_of >= 0)[0]
             if not len(valid):
                 continue
             if len(valid) == len(kid_of):
-                # full batch: stage the shared arrays themselves so the
+                # full batch: stage the shared kid array itself so the
                 # combiner can cluster replicas by object identity
-                staged.append((kid_of, [b.key_ct, b.key_mt,
-                                        b.key_dt, b.key_expire]))
+                staged.append((kid_of, np.stack(
+                    [b.key_ct, b.key_mt, b.key_dt, b.key_expire], axis=-1)))
             else:
-                staged.append((kid_of[valid],
-                               [b.key_ct[valid], b.key_mt[valid],
-                                b.key_dt[valid], b.key_expire[valid]]))
+                staged.append((kid_of[valid], np.stack(
+                    [b.key_ct[valid], b.key_mt[valid], b.key_dt[valid],
+                     b.key_expire[valid]], axis=-1)))
         if not staged:
             return None
         staged, folds = self._combine_groups(
             staged,
-            lambda st_: (st_[0][0],
-                         [np.maximum.reduce([s[1][i] for s in st_])
-                          for i in range(4)]),
-            lambda st_, cat: (cat, [np.concatenate([s[1][i] for s in st_])
-                                    for i in range(4)]))
-        return {"staged": staged, "folds": folds}
+            lambda st_: (st_[0][0], np.maximum.reduce([s[1] for s in st_])),
+            lambda st_, cat: (cat, np.concatenate([s[1] for s in st_])))
+        plan = {"staged": staged, "folds": folds}
+        if self.resident and self._host_combine() and self._unique_ok:
+            plan["mode"] = "host"
+            return plan
+        total = sum(len(p) for p, _ in staged)
+        n = store.keys.n
+        base, size, all_new = self._bulk_region([p for p, _ in staged],
+                                                self._n0_keys, n)
+        if not self._use_bulk(total, size):
+            plan["mode"] = "scatter"
+            return plan
+        plan["mode"] = "bulk"
+        plan.update(n=n, base=base, size=size, all_new=all_new)
+        plan["fold"] = self._fold_on and self._aligned(staged)
+        if plan["fold"]:
+            np_ = K.next_pow2(max(len(staged[0][0]), 1))
+            plan["stack"] = np.stack([_pad(m, np_, 0) for _, m in staged])
+        if not self.resident and not all_new:
+            sp = self._sp_size(size)
+            host = np.stack([store.keys.ct[base:n], store.keys.mt[base:n],
+                             store.keys.dt[base:n],
+                             store.keys.expire[base:n]], axis=-1)
+            plan["state_host"] = _pad(host, sp, 0)
+        return plan
 
     def _dispatch_envelopes(self, store: KeySpace, plan, st) -> None:
         if plan is None:
             return
         staged = plan["staged"]
         self.folds += plan["folds"]
-        if self.resident and self._host_combine() and self._unique_ok:
+        if plan["mode"] == "host":
             # envelope merge is plain per-column max with no cross-family
             # device dependency: fold it straight into the host columns
             # (rows are unique per staged entry, so gather-max-scatter is
@@ -1174,19 +1232,17 @@ class TpuMergeEngine:
             # path: both are int64 max.
             self._drop_family(store, "env")  # sync any device mirror first
             keys = store.keys
-            for pos, c in staged:
+            for pos, m in staged:
                 for i, (name, _) in enumerate(_FAMILIES["env"]):
                     col = keys.col(name)
                     cur = col[pos]
-                    np.maximum(cur, c[i], out=cur)
+                    np.maximum(cur, m[:, i], out=cur)
                     col[pos] = cur
             return
-        total = sum(len(p) for p, _ in staged)
-        n = store.keys.n
-        base, size, all_new = self._bulk_region([p for p, _ in staged],
-                                                self._n0_keys, n)
 
-        if self._use_bulk(total, size):
+        if plan["mode"] == "bulk":
+            n, base = plan["n"], plan["base"]
+            size, all_new = plan["size"], plan["all_new"]
             if self.resident:
                 cols, sp = self._resident_state(store, "env", n)
                 state = cols["stack"]
@@ -1196,24 +1252,18 @@ class TpuMergeEngine:
                 if all_new:
                     state = self._full(sp, 0, cols=4)
                 else:
-                    host = np.stack([store.keys.ct[base:n],
-                                     store.keys.mt[base:n],
-                                     store.keys.dt[base:n],
-                                     store.keys.expire[base:n]], axis=-1)
-                    state = self._put_state(_pad(host, sp, 0))
-            if self._fold_backend() != "off" and self._aligned(staged):
+                    state = self._put_state(plan["state_host"])
+            if plan["fold"]:
                 # envelopes are plain max — one stacked XLA reduction, one
-                # scatter (no win flags to track)
+                # scatter (no win flags to track); the [R, N, 4] stack was
+                # pre-built by the stage twin
                 from ..ops import dense as D
                 rows0, _nA, np_, idx = self._fold_prep(staged, base, sp)
-                stack = np.stack([_pad(np.stack(c, axis=-1), np_, 0)
-                                  for _, c in staged])
                 state = B.bulk_max(state, idx,
-                                   D.dense_max(self._put_batch(stack)))
+                                   D.dense_max(self._put_batch(plan["stack"])))
             else:
-                dev = [self._upload_batch(
-                    p, base, sp, [(np.stack(c, axis=-1), 0)])
-                    for p, c in staged]
+                dev = [self._upload_batch(p, base, sp, [(m, 0)])
+                       for p, m in staged]
                 for idx, c in dev:
                     state = B.bulk_max(state, idx, c)
             if self.resident:
@@ -1225,18 +1275,21 @@ class TpuMergeEngine:
             store.keys.dt[base:n] = out[:, 2]
             store.keys.expire[base:n] = out[:, 3]
             return
-        # scatter path over touched slots
+        # scatter path over touched slots.  The store-state gathers stay
+        # HERE (not in the stage): _drop_family may flush a resident
+        # mirror into these very columns first.
         self._drop_family(store, "env")
         kv = np.concatenate([p for p, _ in staged])
+        cat = np.concatenate([m for _, m in staged])
         trows, slot_idx = np.unique(kv, return_inverse=True)
         n_slots = K.next_pow2(len(trows) + 1)
         n_rows = K.next_pow2(len(kv))
         out = K.scatter_max4(
             _pad(slot_idx.astype(_I64), n_rows, n_slots - 1),
-            _pad(np.concatenate([c[0] for _, c in staged]), n_rows, K.NEUTRAL_T),
-            _pad(np.concatenate([c[1] for _, c in staged]), n_rows, K.NEUTRAL_T),
-            _pad(np.concatenate([c[2] for _, c in staged]), n_rows, K.NEUTRAL_T),
-            _pad(np.concatenate([c[3] for _, c in staged]), n_rows, K.NEUTRAL_T),
+            _pad(cat[:, 0], n_rows, K.NEUTRAL_T),
+            _pad(cat[:, 1], n_rows, K.NEUTRAL_T),
+            _pad(cat[:, 2], n_rows, K.NEUTRAL_T),
+            _pad(cat[:, 3], n_rows, K.NEUTRAL_T),
             _pad(store.keys.ct[trows], n_slots, 0),
             _pad(store.keys.mt[trows], n_slots, 0),
             _pad(store.keys.dt[trows], n_slots, 0),
@@ -1288,19 +1341,31 @@ class TpuMergeEngine:
                     np.concatenate([s[2] for s in st_]), vals_cat)
 
         staged, folds = self._combine_groups(staged, _fold_reg, _cat_reg)
-        return {"staged": staged, "folds": folds}
+        plan = {"staged": staged, "folds": folds}
+        # placement decision + fold-stack builds, staged (STAGE-PURE)
+        total = sum(len(p) for p, *_ in staged)
+        n = store.keys.n
+        base, size, all_new = self._bulk_region([p for p, *_ in staged],
+                                                self._n0_keys, n)
+        plan.update(n=n, base=base, size=size, all_new=all_new,
+                    use_bulk=self._use_bulk(total, size), fold=False)
+        if plan["use_bulk"] and not (self.resident and self._host_combine()):
+            plan["fold"] = self._fold_on and self._aligned(staged)
+            if plan["fold"]:
+                np_ = K.next_pow2(max(len(staged[0][0]), 1))
+                plan["t_s"] = self._stacked(staged, 1, K.NEUTRAL_T, np_)
+                plan["n_s"] = self._stacked(staged, 2, K.NEUTRAL_T, np_)
+        return plan
 
     def _dispatch_registers(self, store: KeySpace, plan, st) -> None:
         if plan is None:
             return
         staged = plan["staged"]
         self.folds += plan["folds"]
-        total = sum(len(p) for p, *_ in staged)
-        n = store.keys.n
-        base, size, all_new = self._bulk_region([p for p, *_ in staged],
-                                                self._n0_keys, n)
+        n, base = plan["n"], plan["base"]
+        size, all_new = plan["size"], plan["all_new"]
 
-        if self._use_bulk(total, size):
+        if plan["use_bulk"]:
             if self.resident:
                 cols, sp = self._resident_state(store, "reg", n)
                 t, nd = cols["rv_t"], cols["rv_node"]
@@ -1328,12 +1393,10 @@ class TpuMergeEngine:
                                   recon={"rv_t": "rv_t",
                                          "rv_node": "rv_node"})
                 return
-            fold = self._fold_backend() != "off" and self._aligned(staged)
+            fold = plan["fold"]
             if fold:
                 rows0, nA, np_, idx = self._fold_prep(staged, base, sp)
-                ft, fn, winb = self._fold_lww(
-                    self._stacked(staged, 1, K.NEUTRAL_T, np_),
-                    self._stacked(staged, 2, K.NEUTRAL_T, np_))
+                ft, fn, winb = self._fold_lww(plan["t_s"], plan["n_s"])
                 t, nd, win = B.bulk_lww(t, nd, idx, ft, fn)
                 wins = [win]
             else:
@@ -1424,19 +1487,35 @@ class TpuMergeEngine:
             staged, _fold_cnt,
             lambda st_, cat: (cat,) + tuple(
                 np.concatenate([s[i] for s in st_]) for i in range(1, 5)))
-        return {"staged": staged, "folds": folds, "n0": n0}
+        plan = {"staged": staged, "folds": folds, "n0": n0}
+        # placement decision + fold-stack builds, staged (STAGE-PURE).
+        # store.cnt.n is stable from here: only this family's stage
+        # appends counter rows, and its dispatch runs strictly after.
+        total = sum(len(r) for r, *_ in staged)
+        n = store.cnt.n
+        base, size, all_new = self._bulk_region([r for r, *_ in staged],
+                                                n0, n)
+        plan.update(n=n, base=base, size=size, all_new=all_new,
+                    use_bulk=self._use_bulk(total, size), fold=False)
+        if plan["use_bulk"] and not (self.resident and self._host_combine()):
+            plan["fold"] = self._fold_on and self._aligned(staged)
+            if plan["fold"]:
+                np_ = K.next_pow2(max(len(staged[0][0]), 1))
+                plan["v_s"] = self._stacked(staged, 1, 0, np_)
+                plan["u_s"] = self._stacked(staged, 2, K.NEUTRAL_T, np_)
+                plan["b_s"] = self._stacked(staged, 3, 0, np_)
+                plan["bt_s"] = self._stacked(staged, 4, K.NEUTRAL_T, np_)
+        return plan
 
     def _dispatch_counter_rows(self, store: KeySpace, plan, st) -> None:
         if plan is None:
             return
         staged = plan["staged"]
-        n0 = plan["n0"]
         self.folds += plan["folds"]
-        n = store.cnt.n
-        total = sum(len(r) for r, *_ in staged)
-        base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
+        n, base = plan["n"], plan["base"]
+        size, all_new = plan["size"], plan["all_new"]
 
-        if self._use_bulk(total, size):
+        if plan["use_bulk"]:
             if self.resident:
                 cols, sp = self._resident_state(store, "cnt", n)
                 val, uuid = cols["val"], cols["uuid"]
@@ -1481,17 +1560,14 @@ class TpuMergeEngine:
                                   src=src, written=written,
                                   recon={"val": "val", "uuid": "uuid"})
                 return
-            if self._fold_backend() != "off" and self._aligned(staged):
+            if plan["fold"]:
                 # aligned counter rows (same (key, node) slots per batch —
                 # repeated syncs from one origin): fold both (value @ time)
-                # pairs on-device, scatter once
+                # pairs on-device (stacks pre-built by the stage twin),
+                # scatter once
                 rows0, _nA, np_, idx = self._fold_prep(staged, base, sp)
-                fv, fu = self._fold_pair(
-                    self._stacked(staged, 1, 0, np_),
-                    self._stacked(staged, 2, K.NEUTRAL_T, np_))
-                fb, fbt = self._fold_pair(
-                    self._stacked(staged, 3, 0, np_),
-                    self._stacked(staged, 4, K.NEUTRAL_T, np_))
+                fv, fu = self._fold_pair(plan["v_s"], plan["u_s"])
+                fb, fbt = self._fold_pair(plan["b_s"], plan["bt_s"])
                 val, uuid, cb, cbt = B.bulk_counters(val, uuid, cb, cbt,
                                                      idx, fv, fu, fb, fbt)
             else:
@@ -1697,8 +1773,25 @@ class TpuMergeEngine:
                     vals_cat, hv)
 
         staged, folds = self._combine_groups(staged, _fold_el, _cat_el)
-        return {"staged": staged, "folds": folds, "n0": n0,
+        plan = {"staged": staged, "folds": folds, "n0": n0,
                 "el_epoch": store.el_compact_epoch}
+        # placement decision + fold-stack builds, staged (STAGE-PURE).
+        # store.el.n is stable from here: only this stage appends element
+        # rows, and its dispatch runs strictly after.
+        total = sum(len(r) for r, *_ in staged)
+        n = store.el.n
+        base, size, all_new = self._bulk_region([r for r, *_ in staged],
+                                                n0, n)
+        plan.update(n=n, base=base, size=size, all_new=all_new,
+                    use_bulk=self._use_bulk(total, size), fold=False)
+        if plan["use_bulk"] and not (self.resident and self._host_combine()):
+            plan["fold"] = self._fold_on and self._aligned(staged)
+            if plan["fold"]:
+                np_ = K.next_pow2(max(len(staged[0][0]), 1))
+                plan["a_s"] = self._stacked(staged, 1, K.NEUTRAL_T, np_)
+                plan["x_s"] = self._stacked(staged, 2, K.NEUTRAL_T, np_)
+                plan["d_s"] = self._stacked(staged, 3, 0, np_)
+        return plan
 
     def _dispatch_elem_rows(self, store: KeySpace, plan, st) -> None:
         if plan is None:
@@ -1713,13 +1806,11 @@ class TpuMergeEngine:
                 "element rows were compacted between stage and dispatch "
                 "(row-id stability broken: staged indices are stale)")
         staged = plan["staged"]
-        n0 = plan["n0"]
         self.folds += plan["folds"]
-        n = store.el.n
-        total = sum(len(r) for r, *_ in staged)
-        base, size, all_new = self._bulk_region([r for r, *_ in staged], n0, n)
+        n, base = plan["n"], plan["base"]
+        size, all_new = plan["size"], plan["all_new"]
 
-        if self._use_bulk(total, size):
+        if plan["use_bulk"]:
             if self.resident:
                 cols, sp = self._resident_state(store, "el", n)
                 at, an, dt = cols["add_t"], cols["add_node"], cols["del_t"]
@@ -1774,13 +1865,11 @@ class TpuMergeEngine:
                 an = self._state_up(store.el.add_node, base, size, sp, 0,
                                     all_new)
                 dt = self._state_up(store.el.del_t, base, size, sp, 0, all_new)
-            fold = self._fold_backend() != "off" and self._aligned(staged)
+            fold = plan["fold"]
             if fold:
                 rows0, nA, np_, idx = self._fold_prep(staged, base, sp)
-                fa, fx, fd, winb = self._fold_lex(
-                    self._stacked(staged, 1, K.NEUTRAL_T, np_),
-                    self._stacked(staged, 2, K.NEUTRAL_T, np_),
-                    self._stacked(staged, 3, 0, np_))
+                fa, fx, fd, winb = self._fold_lex(plan["a_s"], plan["x_s"],
+                                                  plan["d_s"])
                 at, an, dt, win = B.bulk_elems(at, an, dt, idx, fa, fx, fd)
                 wins = [win]
             else:

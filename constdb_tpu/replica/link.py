@@ -382,69 +382,92 @@ class ReplicaLink:
         dump = await self.app.shared_dump.acquire()
         self.node.stats.extra["full_syncs_sent"] = \
             self.node.stats.extra.get("full_syncs_sent", 0) + 1
-        self._write(writer, encode_msg(Arr([Bulk(FULLSYNC), Int(dump.size),
-                                            Int(dump.repl_last),
-                                            Int(1 if reset else 0)])))
-        with open(dump.path, "rb") as f:
-            while piece := f.read(_READ_CHUNK):
+        # open + reads off-loop: a full-resync burst on a loaded disk
+        # must not hiccup every client (ASYNC-BLOCK; the writes are
+        # socket-buffered and drain() yields between pieces).  The FIRST
+        # piece is read BEFORE the FULLSYNC header goes out so the
+        # stream never shows a header with zero payload bytes behind it
+        # — the pre-executor code had no such window (header + first
+        # read happened in one task step) and the wire contract keeps it
+        loop = asyncio.get_running_loop()
+        f = await loop.run_in_executor(None, open, dump.path, "rb")
+        try:
+            piece = await loop.run_in_executor(None, f.read, _READ_CHUNK)
+            self._write(writer, encode_msg(Arr([
+                Bulk(FULLSYNC), Int(dump.size), Int(dump.repl_last),
+                Int(1 if reset else 0)])))
+            while piece:
                 self._write(writer, piece)
                 await writer.drain()
+                piece = await loop.run_in_executor(None, f.read, _READ_CHUNK)
+        finally:
+            f.close()
         return dump.repl_last
 
     # ----------------------------------------------------------------- pull
 
     async def _pull_loop(self, reader, parser) -> None:
-        """Inbound half (reference pull.rs): apply replicate frames with
-        watermark checks; load snapshots through the MergeEngine."""
+        """Inbound half (reference pull.rs): coalesce replicate frames
+        into columnar micro-batches (replica/coalesce.py) and land them
+        through the MergeEngine; non-mergeable frames apply per-key as
+        barriers; snapshots load chunk-streamed as before.
+
+        Flush cadence: the applier enforces the frame-count and latency
+        bounds; this loop additionally flushes whenever the stream goes
+        IDLE (no complete frame left in the parser) before blocking on
+        the socket — a lone write lands with zero added latency, and
+        batches only form when frames actually queue up."""
+        from .coalesce import CoalescingApplier
+        applier = CoalescingApplier(
+            self.node, self.meta,
+            max_frames=getattr(self.app, "apply_batch", None),
+            max_latency=getattr(self.app, "apply_latency", None),
+            now=asyncio.get_running_loop().time)
         while True:
-            msg = await _read_msg(reader, parser, count=self._count_in)
+            msg = parser.next_msg()
+            if msg is None:
+                if applier.pending:
+                    applier.flush()  # stream idle: land now
+                data = await reader.read(_READ_CHUNK)
+                if not data:
+                    raise ConnectionError("EOF")
+                self._count_in(len(data))
+                parser.feed(data)
+                continue
             self.meta.last_seen_ms = now_ms()
             items = msg.items if isinstance(msg, Arr) else None
             if not items:
                 raise CstError(f"unexpected frame from {self.meta.addr}: {msg!r}")
             kind = as_bytes(items[0]).lower()
             if kind == REPLICATE:
-                self._apply_replicate(items)
+                applier.apply(items)
             elif kind == REPLACK:
                 uuid = as_int(items[1])
                 if uuid > self.meta.uuid_i_acked:
                     self.meta.uuid_i_acked = uuid
                     self.node.events.trigger(EVENT_REPLICA_ACKED, uuid)
-                if len(items) > 3:
-                    beacon = as_int(items[3])
-                    if beacon > self.meta.uuid_he_sent and \
-                            self._epoch == self.node.reset_epoch:
-                        # peer's stream is complete below its beacon.  The
-                        # epoch check drops beacons from a stream installed
-                        # BEFORE a local state wipe: those would re-advance
-                        # the zeroed pull watermark past ops the wipe
-                        # discarded, silently skipping their re-delivery
-                        self.meta.uuid_he_sent = beacon
-                        self.node.hlc.observe(beacon)
+                if len(items) > 3 and \
+                        self._epoch == self.node.reset_epoch:
+                    # peer's stream is complete below its beacon.  The
+                    # epoch check drops beacons from a stream installed
+                    # BEFORE a local state wipe: those would re-advance
+                    # the zeroed pull watermark past ops the wipe
+                    # discarded, silently skipping their re-delivery.
+                    # The applier gates the advance behind any frames
+                    # still pending (watermark-after-land).
+                    applier.observe_beacon(as_int(items[3]))
             elif kind == FULLSYNC:
+                applier.flush()  # barrier: snapshot handling moves the
+                #                  watermark out-of-band
                 await self._receive_snapshot(
                     reader, parser, size=as_int(items[1]),
                     repl_last=as_int(items[2]),
                     reset=bool(as_int(items[3])) if len(items) > 3 else False)
+                applier.resync()
             elif kind == PARTSYNC:
                 pass  # stream continues from our requested resume point
             else:
                 raise CstError(f"unknown repl frame {kind!r}")
-
-    def _apply_replicate(self, items) -> None:
-        """(reference pull.rs:184-235 apply_his_replicates)"""
-        meta = self.meta
-        origin = as_int(items[1])
-        prev_uuid = as_int(items[2])
-        uuid = as_int(items[3])
-        name = as_bytes(items[4])
-        if uuid <= meta.uuid_he_sent:
-            return  # duplicate (reconnect overlap) — idempotent skip
-        if prev_uuid > meta.uuid_he_sent:
-            raise ReplicateCommandsLost(
-                f"{self.meta.addr}: gap {meta.uuid_he_sent} -> {prev_uuid}")
-        self.node.apply_replicated(name, items[5:], origin, uuid)
-        meta.uuid_he_sent = uuid
 
     async def _receive_snapshot(self, reader, parser, size: int,
                                 repl_last: int, reset: bool = False) -> None:
@@ -459,7 +482,12 @@ class ReplicaLink:
         snapshot like a fresh node."""
         path = os.path.join(self.app.work_dir,
                             f"snapshot.{self.meta.addr.replace(':', '_')}")
-        with open(path, "wb") as f:
+        loop = asyncio.get_running_loop()
+        # spill-file open/close off-loop (ASYNC-BLOCK): close flushes the
+        # buffered tail to disk, which on a loaded disk blocks for real;
+        # the per-piece writes land in the page cache between awaits
+        f = await loop.run_in_executor(None, open, path, "wb")
+        try:
             remaining = size
             while remaining > 0:
                 got = parser.take_raw(min(remaining, _READ_CHUNK))
@@ -470,6 +498,12 @@ class ReplicaLink:
                     self._count_in(len(got))
                 f.write(got)
                 remaining -= len(got)
+        finally:
+            try:
+                await loop.run_in_executor(None, f.close)
+            except asyncio.CancelledError:
+                f.close()  # teardown path: close inline rather than leak
+                raise
         node = self.node
         if reset:
             log.warning("peer %s demands a state-clearing resync (we were "
@@ -584,26 +618,32 @@ class ReplicaLink:
     async def _apply_snapshot_plain(self, path: str):
         """Single-keyspace snapshot apply (the default path)."""
         replica_rows: list = []
+        # spill-file open off-loop (ASYNC-BLOCK); section reads stay
+        # inline — they are small page-cache slices between awaits
+        f = await asyncio.get_running_loop().run_in_executor(
+            None, open, path, "rb")
 
         def batch_sections():
-            with open(path, "rb") as f:
-                for kind, payload in SnapshotLoader(f):
-                    if kind == "node":
-                        if payload.node_id and not self.meta.node_id:
-                            self.meta.node_id = payload.node_id
-                    elif kind == "replicas":
-                        # held until the WHOLE snapshot is applied:
-                        # merge_records adopts the recorded pull
-                        # watermarks, which are only backed by state once
-                        # every chunk has merged — adopting mid-stream
-                        # would let a crash or a corrupt-chunk abort leave
-                        # watermarks pointing past ops the local keyspace
-                        # never received
-                        replica_rows.extend(payload)
-                    else:
-                        yield payload
+            for kind, payload in SnapshotLoader(f):
+                if kind == "node":
+                    if payload.node_id and not self.meta.node_id:
+                        self.meta.node_id = payload.node_id
+                elif kind == "replicas":
+                    # held until the WHOLE snapshot is applied:
+                    # merge_records adopts the recorded pull
+                    # watermarks, which are only backed by state once
+                    # every chunk has merged — adopting mid-stream
+                    # would let a crash or a corrupt-chunk abort leave
+                    # watermarks pointing past ops the local keyspace
+                    # never received
+                    replica_rows.extend(payload)
+                else:
+                    yield payload
 
-        applied_rows = await self._apply_batches(batch_sections())
+        try:
+            applied_rows = await self._apply_batches(batch_sections())
+        finally:
+            f.close()
         return applied_rows, replica_rows
 
     async def _apply_snapshot_sharded(self, path: str, shards: int):
@@ -629,7 +669,10 @@ class ReplicaLink:
         applied_rows = 0
         replica_rows: list = []
         try:
-            with open(path, "rb") as f:
+            # spill-file open off-loop, like every other blocking step of
+            # this path (submit/flush/export below)
+            f = await loop.run_in_executor(None, open, path, "rb")
+            try:
                 for kind, payload in SnapshotLoader(f, raw_batches=True):
                     if kind == "node":
                         if payload.node_id and not self.meta.node_id:
@@ -642,6 +685,8 @@ class ReplicaLink:
                         # flowing while completions land
                         await loop.run_in_executor(None, sks.submit_raw,
                                                    payload)
+            finally:
+                f.close()
             await loop.run_in_executor(None, sks.flush)
             # consolidation rides the SAME adaptive grouped-apply cadence
             # as the plain path — a whole-shard export through a slow

@@ -147,7 +147,9 @@ def mkcmd(*parts) -> Arr:
 # --- argument coercion (parity: reference NextArg trait, src/cmd.rs:348-397) ---
 
 def as_bytes(m: Msg) -> bytes:
-    if isinstance(m, (Simple, Err, Bulk)):
+    # exact-type fast path first: Bulk is ~every argument on the wire,
+    # and these coercions sit on the per-frame replication hot path
+    if type(m) is Bulk or isinstance(m, (Simple, Err, Bulk)):
         return m.val
     if isinstance(m, Int):
         return i64_to_bytes(m.val)
@@ -155,7 +157,7 @@ def as_bytes(m: Msg) -> bytes:
 
 
 def as_int(m: Msg) -> int:
-    if isinstance(m, Int):
+    if type(m) is Int or isinstance(m, Int):
         return m.val
     if isinstance(m, (Simple, Bulk)):
         v = bytes2i64(m.val)

@@ -878,6 +878,209 @@ def ttl_command(node, ctx, args):
     return Int(max(0, (exp >> SEQ_BITS) - now_ms()) // 1000)
 
 
+# ====================================================================
+# columnar encoders — the steady-state coalescing seam
+# (replica/coalesce.py).  Each encoder translates ONE replicated frame
+# into rows of the same columnar plane layout the snapshot writer
+# serializes (persist/snapshot.py _encode_batch over engine/base.py
+# ColumnarBatch), so a run of peer frames can fold through the batched
+# merge engine instead of the per-key op path.  Only commands whose op
+# handler is a pure pointwise CRDT merge are encodable — everything
+# else (deletes, expiry, membership, MV sibling pruning) stays on the
+# exact per-key path as a coalescer BARRIER.  An encoder raising
+# NotColumnar or any CstError makes the coalescer fall back to that
+# same per-key path, so error behavior is byte-identical too.
+# ====================================================================
+
+class NotColumnar(Exception):
+    """This frame cannot ride the columnar fast path; apply per-key."""
+
+
+COLUMNAR_ENCODERS: dict[bytes, Callable] = {}
+
+# Barrier scoping for the coalescer's NON-encodable frames.  A frame in
+# KEY_SCOPED_BARRIERS reads/sweeps live state of exactly the key in its
+# first argument (collection-delete member sweeps, expireat's
+# exists-check, mvwrite's sibling pruning) — it must flush the pending
+# batch ONLY when that key has pending rows; otherwise it commutes with
+# the whole batch and applies per-key without landing it.  STATE_FREE
+# frames never touch the keyspace at all (membership).  Everything else
+# non-encodable flushes unconditionally (unknown semantics).
+KEY_SCOPED_BARRIERS = frozenset(
+    (b"delset", b"deldict", b"delmv", b"dellist", b"expireat", b"mvwrite"))
+STATE_FREE_BARRIERS = frozenset((b"meet", b"forget"))
+
+
+def columnar(name: str):
+    """Register `fn(builder, recs)` as the columnar GROUP encoder for the
+    command registered under `name`.  `recs` is the coalescer's buffered
+    run of frames for that command — tuples `(key, origin, uuid, items)`
+    with `items` the RAW wire frame — and the encoder turns the whole
+    run into columnar rows with C-speed list comprehensions (the
+    per-frame python this replaces was the measured ceiling of the
+    steady-state pull path).
+
+    Contract: encoders PARSE BEFORE MUTATING the builder — every raise
+    must happen before the first builder mutation, so a failing run
+    leaves the batch untouched and the coalescer can retry rec-by-rec,
+    barrier-replaying only the genuinely malformed frames (which then
+    raise the exact op-path error).  Even a contract slip is safe:
+    every encodable write is an idempotent merge, so a replay over
+    half-encoded rows converges."""
+    def deco(fn):
+        assert name.encode() in COMMANDS, name
+        COLUMNAR_ENCODERS[name.encode()] = fn
+        return fn
+    return deco
+
+
+@columnar("set")
+def _enc_set(bb, recs: list) -> None:
+    # op twin: get_or_create + register_set (LWW) + updated_at-on-win;
+    # the unconditional envelope max is identical because ct >= rv_t
+    # holds invariantly, so a losing write's max(ct, uuid) is a no-op
+    vals = [as_bytes(r[3][6]) for r in recs]
+    uuids = [r[2] for r in recs]
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_BYTES, uuids)
+    bb.reg_run(ki0, uuids, [r[1] for r in recs], vals)
+
+
+@columnar("cntset")
+def _enc_cntset(bb, recs: list) -> None:
+    rows = [(r[1], as_int(r[3][6]), r[2]) for r in recs]  # (node, tot, u)
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_COUNTER,
+                      [r[2] for r in recs])
+    bb.cnt_rows.extend(
+        (ki0 + i, node, total, u, 0, S.NEUTRAL_T)
+        for i, (node, total, u) in enumerate(rows))
+    bb.n_rows += len(rows)
+
+
+# sadd (valueless members) / hset / lins (member+value pairs): element
+# add-side LWW writes.  `dt_check=True` marks the rows for the
+# coalescer's flush-time key-delete rule (op twin: `if uuid <
+# keys.dt[kid]: elem_rem(member, dt)` — evaluated against the LIVE dt
+# when the batch lands, which is when the per-key path would have
+# evaluated it had the frames applied there).
+
+def _members_of(items: list) -> list:
+    if len(items) < 7:
+        raise NotColumnar("bad arity")  # the handler raises WrongArity
+    return list(map(as_bytes, items[6:]))
+
+
+def _genc_elem_adds(bb, recs, enc, with_vals: bool) -> None:
+    if with_vals:
+        pairs = []
+        for r in recs:
+            it = r[3]
+            if len(it) < 8 or len(it) & 1:
+                raise NotColumnar("bad arity")
+            pairs.append((list(map(as_bytes, it[6::2])),
+                          list(map(as_bytes, it[7::2]))))
+    else:
+        pairs = [(_members_of(r[3]), None) for r in recs]
+    ki0 = bb.add_keys([r[0] for r in recs], enc, [r[2] for r in recs])
+    el = bb.el_rows
+    n = 0
+    for i, r in enumerate(recs):
+        m, v = pairs[i]
+        el.append((ki0 + i, m, v, r[2], r[1], 0, True))
+        n += len(m)
+    bb.n_rows += n
+    if with_vals:
+        bb._el_has_vals = True
+
+
+@columnar("sadd")
+def _enc_sadd(bb, recs):
+    _genc_elem_adds(bb, recs, S.ENC_SET, with_vals=False)
+
+
+@columnar("hset")
+def _enc_hset(bb, recs):
+    _genc_elem_adds(bb, recs, S.ENC_DICT, with_vals=True)
+
+
+@columnar("lins")
+def _enc_lins(bb, recs):
+    _genc_elem_adds(bb, recs, S.ENC_LIST, with_vals=True)
+
+
+# srem/hdel/lremat: del-side max.  A missing member row materializes
+# with add_t=0/add_node=0 on both paths (KeySpace.elem_rem vs the
+# engine's neutral-row creation), so encoding (0, 0, uuid) is exact.
+
+def _genc_elem_rems(bb, recs, enc) -> None:
+    members = [_members_of(r[3]) for r in recs]
+    ki0 = bb.add_keys([r[0] for r in recs], enc, [r[2] for r in recs])
+    el = bb.el_rows
+    n = 0
+    for i, r in enumerate(recs):
+        m = members[i]
+        el.append((ki0 + i, m, None, 0, 0, r[2], False))
+        n += len(m)
+    bb.n_rows += n
+
+
+@columnar("srem")
+def _enc_srem(bb, recs):
+    _genc_elem_rems(bb, recs, S.ENC_SET)
+
+
+@columnar("hdel")
+def _enc_hdel(bb, recs):
+    _genc_elem_rems(bb, recs, S.ENC_DICT)
+
+
+@columnar("lremat")
+def _enc_lremat(bb, recs):
+    poss = [(as_bytes(r[3][6]),) for r in recs]
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_LIST,
+                      [r[2] for r in recs])
+    bb.el_rows.extend(
+        (ki0 + i, poss[i], None, 0, 0, r[2], False)
+        for i, r in enumerate(recs))
+    bb.n_rows += len(recs)
+
+
+# Scalar DELETE rewrites coalesce too: delbytes/delcnt are pure
+# tombstone + LWW-pair writes, so they commute with everything a pending
+# batch can hold (unlike the collection deletes delset/deldict/delmv/
+# dellist, whose member sweep READS live rows — those stay barriers).
+
+@columnar("delbytes")
+def _enc_delbytes(bb, recs) -> None:
+    bb.add_del_keys([r[0] for r in recs], S.ENC_BYTES,
+                    [r[2] for r in recs])
+
+
+@columnar("delcnt")
+def _enc_delcnt(bb, recs) -> None:
+    """Counter delete: key tombstone + each listed slot's delete-observed
+    base as an LWW assignment (base @ delete-uuid); the slot's total
+    pair rides along neutral (val=0 @ NEUTRAL_T never beats a written
+    slot, and ties with an unwritten one at its own value)."""
+    slot_runs = []
+    for r in recs:
+        it = r[3]
+        if len(it) & 1:
+            raise NotColumnar("bad arity")  # key + (node, base) pairs
+        pairs = []
+        for i in range(6, len(it), 2):
+            node = as_int(it[i])
+            if node < 0:
+                raise NotColumnar("bad node id")  # handler uses next_uint
+            pairs.append((node, as_int(it[i + 1])))
+        slot_runs.append(pairs)
+    ki0 = bb.add_del_keys([r[0] for r in recs], S.ENC_COUNTER,
+                          [r[2] for r in recs])
+    for i, r in enumerate(recs):
+        for node, base in slot_runs[i]:
+            bb.cnt_rows.append((ki0 + i, node, 0, S.NEUTRAL_T, base, r[2]))
+            bb.n_rows += 1
+
+
 # membership + observability commands register themselves against this table
 from ..replica import commands as _replica_commands  # noqa: E402,F401
 from . import info as _info_commands  # noqa: E402,F401

@@ -90,6 +90,9 @@ def _section_stats(node, out):
     out.append(("total_net_output_bytes", st.net_out_bytes))
     out.append(("repl_net_input_bytes", st.repl_in_bytes))
     out.append(("repl_net_output_bytes", st.repl_out_bytes))
+    out.append(("repl_frames_coalesced", st.repl_frames_coalesced))
+    out.append(("repl_coalesce_flushes", st.repl_coalesce_flushes))
+    out.append(("repl_apply_barriers", st.repl_apply_barriers))
     out.append(("merge_batches", st.merges))
     out.append(("merge_rows", st.merge_rows))
     out.append(("merge_seconds_total", round(st.merge_secs, 6)))

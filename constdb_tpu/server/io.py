@@ -47,7 +47,9 @@ class ServerApp:
                  tcp_backlog: int = 1024,
                  gc_peer_retention: float = 0.0,
                  ingest_shards: int = 0,
-                 ingest_shard_min_bytes: int = 64 << 20):
+                 ingest_shard_min_bytes: int = 64 << 20,
+                 apply_batch: Optional[int] = None,
+                 apply_latency: Optional[float] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -83,6 +85,12 @@ class ServerApp:
         # path — spawning shard workers costs more than they save there.
         self.ingest_shards = ingest_shards
         self.ingest_shard_min_bytes = ingest_shard_min_bytes
+        # steady-state coalescing bounds for the pull path
+        # (replica/coalesce.py); None = the CONSTDB_APPLY_BATCH /
+        # CONSTDB_APPLY_LATENCY_MS env defaults.  apply_batch=1 pins a
+        # node to the exact per-frame path.
+        self.apply_batch = apply_batch
+        self.apply_latency = apply_latency
         # peers silent beyond this stop pinning the GC horizon
         self.gc_peer_retention = gc_peer_retention
         node.replicas.gc_peer_retention_ms = int(gc_peer_retention * 1000)

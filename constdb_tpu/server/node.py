@@ -35,6 +35,12 @@ class NodeStats:
     repl_out_bytes: int = 0
     connections_accepted: int = 0
     current_clients: int = 0
+    # steady-state pull-path coalescing (replica/coalesce.py): frames
+    # folded into columnar micro-batches, batches landed, and frames
+    # that fell back to the exact per-key path (barriers)
+    repl_frames_coalesced: int = 0
+    repl_coalesce_flushes: int = 0
+    repl_apply_barriers: int = 0
     merges: int = 0
     merge_rows: int = 0
     merge_secs: float = 0.0
@@ -159,6 +165,19 @@ class Node:
         x["group_merges"] = x.get("group_merges", 0) + 1
         x["group_merge_batches"] = x.get("group_merge_batches", 0) + len(batches)
         self._dump_stale()
+
+    def merge_stream_batch(self, builder, frames: int) -> None:
+        """Land one coalesced replication micro-batch (the steady-state
+        pull path, replica/coalesce.py) through the same engine seam
+        snapshot ingest uses.  `builder.finalize()` evaluates the
+        element-plane key-delete rule against LIVE host columns, so any
+        device-resident merge state must flush first — the same
+        flush-before-read discipline `apply_replicated` applies per
+        frame."""
+        self.ensure_flushed()
+        self.merge_batches([builder.finalize()])
+        self.stats.repl_frames_coalesced += frames
+        self.stats.repl_coalesce_flushes += 1
 
     def reset_for_full_resync(self, keep_link=None) -> None:
         """Wipe local CRDT state and rejoin as a fresh node (the receive

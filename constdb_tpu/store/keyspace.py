@@ -214,6 +214,19 @@ class KeySpace:
     def alive(self, kid: int) -> bool:
         return S.key_alive(int(self.keys.ct[kid]), int(self.keys.dt[kid]))
 
+    def key_delete_times(self, keys: list) -> np.ndarray:
+        """Vectorized key bytes -> current key-level delete time (0 for
+        absent keys).  The coalescing replication applier
+        (replica/coalesce.py) evaluates the element-plane key-delete rule
+        against the LIVE dt at the moment its batch lands — one batched
+        native lookup instead of a hash probe per pending frame."""
+        kids = self.key_index.lookup_batch(keys)
+        out = np.zeros(len(keys), dtype=_I64)
+        m = kids >= 0
+        if m.any():
+            out[m] = self.keys.dt[kids[m]]
+        return out
+
     def enc_of(self, kid: int) -> int:
         return int(self.keys.enc[kid])
 

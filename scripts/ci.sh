@@ -12,6 +12,25 @@ echo "== lint (baseline mode) =="
 ./scripts/lint.sh || exit $?
 
 echo
+echo "== lint baseline ratchet =="
+# Retired debt must not silently regrow: the committed baseline's total
+# finding count may only go DOWN.  PR 4 retired the last 17 findings
+# (13 STAGE-PURE fold-stack builds, 4 ASYNC-BLOCK spill opens), so the
+# ratchet sits at zero — any future baselined finding needs this number
+# raised in review, on purpose.
+python - <<'EOF' || exit $?
+import json, sys
+MAX_BASELINED = 0
+base = json.load(open("constdb_tpu/analysis/baseline.json"))
+total = sum(base.get("findings", {}).values())
+print(f"baselined findings: {total} (ratchet: {MAX_BASELINED})")
+if total > MAX_BASELINED:
+    print("ci.sh: baseline GREW past the ratchet — fix the findings or "
+          "raise MAX_BASELINED in scripts/ci.sh deliberately")
+    sys.exit(1)
+EOF
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

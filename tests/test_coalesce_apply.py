@@ -1,0 +1,382 @@
+"""Coalesced steady-state replication apply (replica/coalesce.py).
+
+The load-bearing claims, each pinned here:
+  * coalesced apply is byte-identical to the per-frame path — unit-level
+    differential over every encodable command (both engines), and a live
+    2-node-mesh export-compare where one subscriber coalesces and the
+    other runs CONSTDB_APPLY_BATCH=1 under a mixed write/DEL/membership
+    stream;
+  * barrier frames flush correctly (key-scoped ones only when their key
+    is pending);
+  * the pull watermark / REPLACK beacon NEVER advances past an unlanded
+    batch (watermark-after-land, docs/INVARIANTS.md);
+  * the latency bound flushes a lone frame (and the pull loop's idle
+    check lands it with zero added latency in the live mesh);
+  * CONSTDB_APPLY_BATCH=1 degenerates to the exact per-frame path;
+  * bench.py --mode stream smoke (CPU engine, small log).
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from constdb_tpu.errors import ReplicateCommandsLost
+from constdb_tpu.replica.coalesce import CoalescingApplier
+from constdb_tpu.replica.manager import ReplicaMeta
+from constdb_tpu.resp.message import Bulk, Int
+from constdb_tpu.server.node import Node
+from constdb_tpu.utils.hlc import SEQ_BITS
+
+from cluster_util import Client, close_cluster, converge, full_mesh
+
+MS0 = 1_700_000_000_000
+
+
+def u(i: int) -> int:
+    return (MS0 + i) << SEQ_BITS
+
+
+def frame(prev: int, uuid: int, name: bytes, *args):
+    items = [Bulk(b"replicate"), Int(7), Int(prev), Int(uuid), Bulk(name)]
+    for a in args:
+        items.append(Int(a) if isinstance(a, int) else Bulk(a))
+    return items
+
+
+def mixed_stream(n: int, seed: int = 3, keys: int = 80):
+    """A deterministic mixed frame log covering every encodable command
+    plus every barrier class."""
+    rng = random.Random(seed)
+    frames = []
+    prev = 0
+    for i in range(1, n + 1):
+        r = rng.random()
+        k = b"k%03d" % rng.randrange(keys)
+        if r < 0.22:
+            f = (b"set", b"r" + k, b"v%d" % i)
+        elif r < 0.40:
+            f = (b"cntset", b"c" + k, rng.randrange(-50, 50))
+        elif r < 0.56:
+            f = (b"sadd", b"s" + k, b"m%d" % rng.randrange(10),
+                 b"m%d" % rng.randrange(10))
+        elif r < 0.64:
+            f = (b"hset", b"h" + k, b"f%d" % rng.randrange(6), b"v%d" % i)
+        elif r < 0.70:
+            f = (b"srem", b"s" + k, b"m%d" % rng.randrange(10))
+        elif r < 0.74:
+            f = (b"hdel", b"h" + k, b"f%d" % rng.randrange(6))
+        elif r < 0.78:
+            f = (b"lins", b"l" + k, b"p%04d" % i, b"val%d" % i)
+        elif r < 0.80:
+            f = (b"lremat", b"l" + k, b"p%04d" % (i - 1))
+        elif r < 0.84:
+            f = (b"delbytes", b"r" + k)
+        elif r < 0.88:
+            f = (b"delcnt", b"c" + k, 7, rng.randrange(50))
+        elif r < 0.93:
+            f = (b"delset", b"s" + k)
+        elif r < 0.96:
+            f = (b"deldict", b"h" + k)
+        elif r < 0.98:
+            f = (b"expireat", b"r" + k, u(i) + (1 << 45))
+        else:
+            f = (b"meet", b"10.9.9.%d:7%03d" % (rng.randrange(9), i % 1000))
+        frames.append(frame(prev, u(i), *f))
+        prev = u(i)
+    return frames, prev
+
+
+def drive(node, frames, max_frames=64, max_latency=999.0):
+    ap = CoalescingApplier(node, ReplicaMeta("peer:1"),
+                           max_frames=max_frames, max_latency=max_latency)
+    for f in frames:
+        ap.apply(f)
+    ap.flush()
+    return ap
+
+
+# ---------------------------------------------------------- equivalence
+
+
+def test_coalesced_equals_per_frame_cpu_engine():
+    frames, last = mixed_stream(1500)
+    n1, n2 = Node(node_id=1), Node(node_id=2)
+    a1 = drive(n1, frames, max_frames=64)
+    a2 = drive(n2, frames, max_frames=1)
+    assert n1.canonical() == n2.canonical()
+    assert a1.meta.uuid_he_sent == last == a2.meta.uuid_he_sent
+    # batch=1 is the exact per-frame path: nothing coalesced, no merges
+    assert n2.stats.repl_frames_coalesced == 0
+    assert n2.stats.merges == 0
+    assert n2.stats.repl_apply_barriers == len(frames)
+    # the coalesced node really did batch
+    assert n1.stats.repl_frames_coalesced > 0
+    assert n1.stats.repl_coalesce_flushes < n1.stats.repl_frames_coalesced
+    # same replicated-command accounting either way
+    assert n1.stats.cmds_replicated == n2.stats.cmds_replicated
+
+
+def test_coalesced_equals_per_frame_xla_engine():
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+
+    frames, last = mixed_stream(2500, seed=11)
+    n1 = Node(node_id=1, engine=TpuMergeEngine(resident=True))
+    n2 = Node(node_id=2)
+    drive(n1, frames, max_frames=128)
+    drive(n2, frames, max_frames=1)
+    assert n1.canonical() == n2.canonical()
+    # GC / tombstone accounting parity: the same horizon frees the same
+    # entries and converges to the same state
+    horizon = last + (1 << SEQ_BITS)
+    assert n1.ks.gc(horizon) == n2.ks.gc(horizon)
+    assert n1.canonical() == n2.canonical()
+
+
+def test_key_delete_rule_across_two_links():
+    """The flush-time dt rule: peer A's sadd is pending while peer B's
+    delset (a barrier on ITS OWN link) lands first — the member must end
+    tombstoned at the delete time, exactly like per-frame ordering."""
+    for batch in (64, 1):
+        node = Node(node_id=1)
+        a = CoalescingApplier(node, ReplicaMeta("a:1"), max_frames=batch,
+                              max_latency=999.0)
+        b = CoalescingApplier(node, ReplicaMeta("b:1"), max_frames=batch,
+                              max_latency=999.0)
+        a.apply(frame(0, u(1), b"sadd", b"s", b"m1"))
+        # B's stream: sadd (establishes the key), then delset LATER than
+        # A's pending add
+        b.apply(frame(0, u(2), b"sadd", b"s", b"m0"))
+        b.apply(frame(u(2), u(5), b"delset", b"s"))
+        a.flush()
+        b.flush()
+        if batch == 64:
+            state = node.canonical()
+        else:
+            assert node.canonical() == state  # same as coalesced run
+        kid = node.ks.lookup(b"s")
+        elems = {m: (at, dlt) for m, at, _an, dlt, _v
+                 in node.ks.elem_all(kid)}
+        assert elems[b"m1"] == (u(1), u(5))  # killed by the delete
+        assert elems[b"m0"] == (u(2), u(5))
+
+
+# ------------------------------------------------------------- barriers
+
+
+def test_barrier_flushes_pending_batch():
+    node = Node(node_id=1)
+    ap = CoalescingApplier(node, ReplicaMeta("p:1"), max_frames=100,
+                           max_latency=999.0)
+    ap.apply(frame(0, u(1), b"sadd", b"s1", b"m"))
+    ap.apply(frame(u(1), u(2), b"set", b"r1", b"v"))
+    assert ap.pending == 2 and node.stats.merges == 0
+    # delset on a PENDING key: must flush first, then apply per-key
+    ap.apply(frame(u(2), u(3), b"delset", b"s1"))
+    assert ap.pending == 0
+    assert node.stats.merges == 1            # the pending batch landed
+    assert node.stats.repl_apply_barriers == 1
+    assert ap.meta.uuid_he_sent == u(3)
+    kid = node.ks.lookup(b"s1")
+    assert int(node.ks.keys.dt[kid]) == u(3)
+
+
+def test_scoped_barrier_skips_flush_for_untouched_key():
+    node = Node(node_id=1)
+    ap = CoalescingApplier(node, ReplicaMeta("p:1"), max_frames=100,
+                           max_latency=999.0)
+    ap.apply(frame(0, u(1), b"sadd", b"s1", b"m"))
+    # delset for a key the batch does NOT touch: applies per-key in
+    # place, batch stays pending, watermark stays put
+    ap.apply(frame(u(1), u(2), b"delset", b"zzz"))
+    assert ap.pending == 1 and node.stats.merges == 0
+    assert node.stats.repl_apply_barriers == 1
+    assert ap.meta.uuid_he_sent == 0
+    # membership is state-free: also no flush
+    ap.apply(frame(u(2), u(3), b"meet", b"10.0.0.1:7001"))
+    assert ap.pending == 1 and node.stats.merges == 0
+    assert node.replicas.get("10.0.0.1:7001") is not None
+    ap.flush()
+    assert ap.meta.uuid_he_sent == u(3)
+
+
+# ---------------------------------------------- watermark / beacon gating
+
+
+def test_watermark_never_advances_past_unlanded_batch():
+    node = Node(node_id=1)
+    meta = ReplicaMeta("p:1")
+    ap = CoalescingApplier(node, meta, max_frames=100, max_latency=999.0)
+    for i in range(1, 6):
+        ap.apply(frame(u(i - 1) if i > 1 else 0, u(i), b"set",
+                       b"k%d" % i, b"v"))
+    assert ap.pending == 5
+    assert meta.uuid_he_sent == 0          # nothing landed yet
+    assert ap.cursor == u(5)               # but the stream cursor moved
+    # a REPLACK beacon past the pending frames is STASHED, not applied
+    ap.observe_beacon(u(9))
+    assert meta.uuid_he_sent == 0
+    ap.flush()
+    assert meta.uuid_he_sent == u(9)       # batch landed -> beacon too
+    assert node.ks.lookup(b"k5") >= 0
+    # with nothing pending, beacons advance immediately
+    ap.observe_beacon(u(12))
+    assert meta.uuid_he_sent == u(12)
+
+
+def test_dup_skip_and_gap_detection():
+    node = Node(node_id=1)
+    ap = CoalescingApplier(node, ReplicaMeta("p:1"), max_frames=100,
+                           max_latency=999.0)
+    f1 = frame(0, u(1), b"set", b"k", b"v1")
+    ap.apply(f1)
+    ap.apply(f1)  # duplicate: skipped
+    assert ap.pending == 1
+    with pytest.raises(ReplicateCommandsLost):
+        ap.apply(frame(u(7), u(8), b"set", b"k", b"v2"))
+    # the gap-free prefix landed before the teardown
+    assert ap.meta.uuid_he_sent == u(1)
+    assert node.ks.lookup(b"k") >= 0
+
+
+def test_latency_bound_flushes_without_count_bound():
+    clock = [0.0]
+    node = Node(node_id=1)
+    ap = CoalescingApplier(node, ReplicaMeta("p:1"), max_frames=1 << 30,
+                           max_latency=0.005, now=lambda: clock[0])
+    ap.apply(frame(0, u(1), b"set", b"k1", b"v"))
+    assert ap.pending == 1
+    clock[0] = 0.050  # well past the bound
+    # the bound is sampled every 32 frames — feed one sampling window
+    prev = u(1)
+    for i in range(2, 40):
+        ap.apply(frame(prev, u(i), b"set", b"k%d" % i, b"v"))
+        prev = u(i)
+    # the bound fired at the 32-frame sample point: everything up to it
+    # landed (frames after it start the next window)
+    assert node.stats.repl_coalesce_flushes == 1
+    assert ap.meta.uuid_he_sent == u(32)
+    assert ap.pending == 39 - 32
+
+
+def test_malformed_frame_falls_back_and_raises_op_error():
+    """An arity-broken frame in the middle of a run must not poison the
+    batch: every other frame lands, and the bad one raises the exact
+    op-path error at flush."""
+    from constdb_tpu.errors import WrongArity
+
+    node = Node(node_id=1)
+    ap = CoalescingApplier(node, ReplicaMeta("p:1"), max_frames=100,
+                           max_latency=999.0)
+    ap.apply(frame(0, u(1), b"sadd", b"s1", b"m1"))
+    ap.apply(frame(u(1), u(2), b"sadd", b"s2"))  # no members: WrongArity
+    ap.apply(frame(u(2), u(3), b"sadd", b"s3", b"m3"))
+    with pytest.raises(WrongArity):
+        ap.flush()
+    assert node.ks.lookup(b"s1") >= 0
+    assert node.ks.lookup(b"s3") >= 0
+    # the bad frame never advanced the watermark: redelivery re-raises
+    assert ap.meta.uuid_he_sent == 0
+
+
+# ------------------------------------------------------------ live mesh
+
+
+def test_mesh_mixed_stream_export_compare(tmp_path):
+    """2 subscribers of the same origin — one coalescing, one pinned to
+    the exact per-frame path — under a mixed write/DEL/membership
+    stream: both converge to byte-identical canonical state."""
+    async def run():
+        from constdb_tpu.server.io import start_node
+        from cluster_util import FAST
+
+        apps = []
+        for i, batch in enumerate((None, 64, 1)):
+            node = Node(node_id=i + 1, alias=f"n{i + 1}")
+            apps.append(await start_node(
+                node, host="127.0.0.1", port=0, work_dir=str(tmp_path),
+                apply_batch=batch, apply_latency=0.02, **FAST))
+        a, b, c = apps
+        cli = await Client().connect(a.advertised_addr)
+        await cli.cmd("meet", b.advertised_addr)
+        await cli.cmd("meet", c.advertised_addr)
+        await full_mesh(apps)
+        rng = random.Random(5)
+        for i in range(400):
+            r = rng.random()
+            k = "k%02d" % rng.randrange(30)
+            if r < 0.25:
+                await cli.cmd("set", "r" + k, "v%d" % i)
+            elif r < 0.45:
+                await cli.cmd("incr", "c" + k, rng.randrange(1, 9))
+            elif r < 0.62:
+                await cli.cmd("sadd", "s" + k, "m%d" % rng.randrange(8),
+                              "m%d" % rng.randrange(8))
+            elif r < 0.74:
+                await cli.cmd("hset", "h" + k, "f%d" % rng.randrange(5),
+                              "v%d" % i)
+            elif r < 0.80:
+                await cli.cmd("srem", "s" + k, "m%d" % rng.randrange(8))
+            elif r < 0.86:
+                await cli.cmd("lpush", "l" + k, "x%d" % i)
+            elif r < 0.97:
+                await cli.cmd("del", "r" + k if r < 0.90 else
+                              ("s" + k if r < 0.94 else "c" + k))
+            else:
+                await cli.cmd("meet", "10.7.7.7:7%03d" % (i % 5))
+        await converge(apps, timeout=20.0)
+        # the coalescing node really coalesced; the pinned node did not
+        assert b.node.stats.repl_frames_coalesced > 0
+        assert c.node.stats.repl_frames_coalesced == 0
+        # a lone write becomes visible without further traffic (the
+        # idle-flush rule: zero added latency for a quiet stream)
+        await cli.cmd("set", "lone-key", "lone-value")
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while True:
+            kid = b.node.ks.lookup(b"lone-key")
+            if kid >= 0 and b.node.ks.register_get(kid) == b"lone-value":
+                break
+            assert asyncio.get_running_loop().time() < deadline, \
+                "lone write did not land via idle flush"
+            await asyncio.sleep(0.02)
+        await cli.close()
+        await close_cluster(apps)
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------ bench smoke
+
+
+def test_stream_bench_smoke(tmp_path):
+    """bench.py --mode stream end-to-end on the CPU engine with a tiny
+    recorded frame log: JSON line present, oracle-verified, and the
+    frame log records + replays."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    log_path = str(tmp_path / "frames.log")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CONSTDB_BENCH_FRAMES="2000",
+               CONSTDB_BENCH_STREAM_KEYS="300",
+               CONSTDB_BENCH_STREAM_ENGINE="cpu",
+               CONSTDB_BENCH_APPLY_BATCH="128",
+               CONSTDB_AUTO_NATIVE="0")
+    for expect_replay in (False, True):
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"),
+             "--mode", "stream", "--frame-log", log_path],
+            capture_output=True, text=True, timeout=300, env=env, cwd=root)
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "stream_apply_frames_per_sec"
+        assert out["verified"] is True
+        assert out["frames"] == 2000
+        assert out["value"] > 0 and out["per_frame_baseline_fps"] > 0
+        assert "visibility_p99_ms" in out
+        assert ("replaying recorded frame log" in r.stderr) == expect_replay
+    assert os.path.exists(log_path)
