@@ -109,6 +109,12 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_APPLY_LATENCY_MS", "5",
            "max ms a coalesced replicate frame may wait before its "
            "batch is force-flushed (idle streams flush immediately)"),
+    EnvVar("CONSTDB_SERVE_BATCH", "512",
+           "max pipelined client commands the serve path plans into one "
+           "columnar merge; 1 = the exact per-command path"),
+    EnvVar("CONSTDB_SERVE_LAT_SAMPLE", "32",
+           "sample every Nth coalesced client command into the INFO "
+           "reply-latency ring (serve_lat_p50/p99_ms); 0 = off"),
 )}
 
 

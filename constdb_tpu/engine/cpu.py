@@ -12,6 +12,7 @@ import logging
 from ..crdt import semantics as S
 from ..store.keyspace import KeySpace
 from .base import ColumnarBatch, MergeStats
+from .hostbatch import HOST_MICRO_MAX
 
 log = logging.getLogger(__name__)
 
@@ -24,6 +25,17 @@ class CpuMergeEngine:
 
     def merge_many(self, store: KeySpace,
                    batches: list) -> MergeStats:
+        # op-stream micro-batches (the serve/stream coalescers' flushes)
+        # take the vectorized host strategy — bit-identical to the per-row
+        # loop below (engine/hostbatch.py docstring; differential-tested in
+        # tests/test_host_combine.py and the coalescer suites), dozens of
+        # times cheaper at a few hundred rows.  Bulk snapshot groups keep
+        # the per-row reference path: this engine IS the measured baseline
+        # and the verification oracle for those.
+        if not all(b.rows_unique_per_slot for b in batches) and \
+                sum(b.n_rows for b in batches) <= HOST_MICRO_MAX:
+            from .hostbatch import merge_host_batches
+            return merge_host_batches(store, batches)
         st = MergeStats()
         for b in batches:
             st += self.merge(store, b)

@@ -33,6 +33,12 @@ from .base import ColumnarBatch, MergeStats
 
 _I64 = np.int64
 
+# row ceiling under which the vectorized host strategy beats both the
+# per-row loop (always, past a handful of rows) and a device scatter
+# (dispatch fixed costs dominate at micro-batch scale) — shared by
+# TpuMergeEngine.HOST_SCATTER_MAX and CpuMergeEngine.merge_many
+HOST_MICRO_MAX = 1 << 15
+
 
 def _group_last(sorted_keys: np.ndarray) -> np.ndarray:
     """Indices (into the sorted array) of each group's LAST element."""
@@ -229,6 +235,79 @@ def _merge_el(store: KeySpace, rows: np.ndarray, at: np.ndarray,
             new_dt[newly].tolist(),
             list(map(store.key_bytes.__getitem__, kids)),
             list(map(store.el_member.__getitem__, rws.tolist())))
+
+
+def resolve_keys(store: KeySpace, batch: ColumnarBatch, st: MergeStats,
+                 resident: bool = False) -> np.ndarray:
+    """batch key position -> local kid (-1 on type conflict); bulk-creates
+    missing keys with the batch envelope (max-merge later is identity).
+    The ONE implementation of key resolution for both engines:
+    `TpuMergeEngine._resolve_keys` delegates here with `resident=True`
+    when it holds device mirrors, and host-only callers (engine/cpu.py
+    merge_many, the serve/stream coalescers' flushes) use the default."""
+    import logging
+
+    n = batch.n_keys
+    st.keys_seen += n
+    if n == 0:
+        return np.zeros(0, dtype=_I64)
+    n0 = store.keys.n
+    # one native batch call: intern every key; new ids ARE the new rows
+    kid_of, n_new = store.key_index.get_or_insert_batch(batch.keys)
+    if n_new:
+        # a raw op-stream batch may repeat a key: append one row per new
+        # id, values from its first occurrence (np.unique's sorted order
+        # IS insertion order — interner ids grow with first occurrence)
+        created = np.nonzero(kid_of >= n0)[0]
+        uniq_ids, first = np.unique(kid_of[created], return_index=True)
+        pos = created[first]
+        # interner ids must be exactly the next table block — checked
+        # BEFORE the append mutates the table (CHECK-THEN-MUTATE: a
+        # failure after append_block would strand half-created rows;
+        # and a real raise, because python -O strips asserts)
+        if len(uniq_ids) != n_new or int(uniq_ids[0]) != n0 or \
+                int(uniq_ids[-1]) != n0 + n_new - 1:
+            span = f"[{int(uniq_ids[0])}, {int(uniq_ids[-1])}]" \
+                if len(uniq_ids) else "[]"
+            raise RuntimeError(
+                f"key interner issued non-contiguous new ids {span} "
+                f"(n={len(uniq_ids)}) for block [{n0}, {n0 + n_new - 1}]")
+        store.keys.append_block(
+            n_new,
+            enc=batch.key_enc[pos], ct=batch.key_ct[pos], mt=0,
+            dt=batch.key_dt[pos], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
+        store.key_bytes.extend(map(batch.keys.__getitem__, pos.tolist()))
+        store.reg_val.extend([None] * n_new)
+        st.keys_created += n_new
+        if resident:
+            # created rows carry batch first-occurrence values on the
+            # host but neutral zeros on the device mirror; the batch rows
+            # merging in reconstruct them, EXCEPT for conflict-skipped
+            # duplicates — clear host values so both sides start neutral
+            store.keys.ct[uniq_ids] = 0
+            store.keys.dt[uniq_ids] = 0
+    # conflict check over ALL positions: duplicate occurrences of a key
+    # created above must also match the enc the first occurrence chose
+    bad = np.nonzero(store.keys.enc[kid_of] != batch.key_enc)[0]
+    if len(bad):
+        log = logging.getLogger(__name__)
+        for i in bad:
+            log.error("type conflict merging key %r: local=%s incoming=%s",
+                      batch.keys[i], int(store.keys.enc[kid_of[i]]),
+                      int(batch.key_enc[i]))
+        st.type_conflicts += len(bad)
+        kid_of[bad] = -1
+    return kid_of
+
+
+def merge_host_batches(store: KeySpace, batches: list) -> MergeStats:
+    """Resolve + merge a group of op-stream micro-batches entirely on the
+    host (no engine object involved).  The fast path for host-only
+    engines: one vectorized pass per batch instead of a per-row loop."""
+    st = MergeStats()
+    for b in batches:
+        merge_host_batch(store, b, resolve_keys(store, b, st), st)
+    return st
 
 
 def merge_host_batch(store: KeySpace, batch: ColumnarBatch,

@@ -44,6 +44,7 @@ from ..ops import bulk as B
 from ..ops import segment as K
 from ..store.keyspace import FAMILIES, KeySpace
 from .base import ColumnarBatch, MergeStats, has_values
+from .hostbatch import HOST_MICRO_MAX
 
 log = logging.getLogger(__name__)
 
@@ -163,7 +164,10 @@ class TpuMergeEngine:
     # this many total rows merge on HOST (engine/hostbatch.py): at that
     # scale device dispatch fixed costs dwarf the merge, and the
     # steady-state coalescer flushes such batches every few ms
-    HOST_SCATTER_MAX = 1 << 15
+    # single source of truth in engine/hostbatch.py: the CPU engine's
+    # micro routing and this ceiling must move together, or the two
+    # engines route the same batch onto different strategies
+    HOST_SCATTER_MAX = HOST_MICRO_MAX
     # win-source pool ids live in an int32 device plane; merge_many flushes
     # before staging a round that could cross this (tests lower it)
     POOL_ID_CEILING = 1 << 31
@@ -891,60 +895,13 @@ class TpuMergeEngine:
 
     def _resolve_keys(self, store: KeySpace, batch: ColumnarBatch,
                       st: MergeStats) -> np.ndarray:
-        """batch key position -> local kid (-1 on type conflict); bulk-creates
-        missing keys with the batch envelope (max-merge later is identity)."""
-        n = batch.n_keys
-        st.keys_seen += n
-        if n == 0:
-            return np.zeros(0, dtype=_I64)
-        n0 = store.keys.n
-        # one native batch call: intern every key; new ids ARE the new rows
-        kid_of, n_new = store.key_index.get_or_insert_batch(batch.keys)
-        if n_new:
-            # a raw op-stream batch may repeat a key: append one row per new
-            # id, values from its first occurrence (np.unique's sorted order
-            # IS insertion order — interner ids grow with first occurrence)
-            created = np.nonzero(kid_of >= n0)[0]
-            uniq_ids, first = np.unique(kid_of[created], return_index=True)
-            pos = created[first]
-            # interner ids must be exactly the next table block — checked
-            # BEFORE the append mutates the table (CHECK-THEN-MUTATE: a
-            # failure after append_block would strand half-created rows;
-            # and a real raise, because python -O strips asserts)
-            if len(uniq_ids) != n_new or int(uniq_ids[0]) != n0 or \
-                    int(uniq_ids[-1]) != n0 + n_new - 1:
-                span = f"[{int(uniq_ids[0])}, {int(uniq_ids[-1])}]" \
-                    if len(uniq_ids) else "[]"
-                raise RuntimeError(
-                    f"key interner issued non-contiguous new ids {span} "
-                    f"(n={len(uniq_ids)}) for block [{n0}, {n0 + n_new - 1}]")
-            store.keys.append_block(
-                n_new,
-                enc=batch.key_enc[pos], ct=batch.key_ct[pos], mt=0,
-                dt=batch.key_dt[pos], expire=0, rv_t=0, rv_node=0, cnt_sum=0)
-            rows = uniq_ids
-            store.key_bytes.extend(map(batch.keys.__getitem__, pos.tolist()))
-            store.reg_val.extend([None] * n_new)
-            st.keys_created += n_new
-            if self.resident:
-                # created rows carry batch first-occurrence values on the
-                # host but neutral zeros on the device mirror; the batch rows
-                # merging in reconstruct them, EXCEPT for conflict-skipped
-                # duplicates — clear host values so both sides start neutral
-                store.keys.ct[rows] = 0
-                store.keys.dt[rows] = 0
-
-        # conflict check over ALL positions: duplicate occurrences of a key
-        # created above must also match the enc the first occurrence chose
-        bad = np.nonzero(store.keys.enc[kid_of] != batch.key_enc)[0]
-        if len(bad):
-            for i in bad:
-                log.error("type conflict merging key %r: local=%s incoming=%s",
-                          batch.keys[i], int(store.keys.enc[kid_of[i]]),
-                          int(batch.key_enc[i]))
-            st.type_conflicts += len(bad)
-            kid_of[bad] = -1
-        return kid_of
+        """batch key position -> local kid (-1 on type conflict).  ONE
+        shared implementation with the host micro path
+        (engine/hostbatch.py resolve_keys) — `resident=True` zeroes
+        created rows' host ct/dt so host and device mirrors start
+        neutral together."""
+        from .hostbatch import resolve_keys
+        return resolve_keys(store, batch, st, resident=self.resident)
 
     # --------------------------------------------------- bulk-path plumbing
 

@@ -80,12 +80,18 @@ _NEED_MORE = _NeedMore()
 
 
 class RespParser:
-    __slots__ = ("_buf", "_pos", "max_depth")
+    __slots__ = ("_buf", "_pos", "max_depth", "_q", "_qpos")
 
     def __init__(self, max_depth: int = 32):
         self._buf = bytearray()
         self._pos = 0
         self.max_depth = max_depth
+        # already-parsed messages awaiting delivery: the native subclass
+        # fast-parses whole pipelines in one C call, and `pushback`
+        # re-queues messages a caller drained but does not own (server/io.py
+        # hands post-SYNC messages back to the replica link this way)
+        self._q: list = []
+        self._qpos = 0
 
     def feed(self, data) -> None:
         self._buf += data
@@ -117,6 +123,66 @@ class RespParser:
     def next_msg(self) -> Optional[Msg]:
         """One complete message, or None if more bytes are needed.
         Raises InvalidRequestMsg on malformed input."""
+        q = self._q
+        if self._qpos < len(q):
+            m = q[self._qpos]
+            self._qpos += 1
+            if self._qpos >= len(q):
+                q.clear()
+                self._qpos = 0
+            return m
+        return self._parse_one()
+
+    def take_queued(self) -> list:
+        """Pop every already-parsed message out of the delivery queue
+        without touching the byte buffer.  The connection loop's error
+        path uses this to salvage the clean prefix a failed drain()
+        stashed (see drain) before writing the protocol error."""
+        q = self._q
+        out = q[self._qpos:] if self._qpos < len(q) else []
+        q.clear()
+        self._qpos = 0
+        return out
+
+    def drain(self) -> list:
+        """Every complete message currently buffered, in arrival order
+        (the serve path plans a whole pipelined chunk at once —
+        server/io.py).  Equivalent to looping next_msg() until None, but
+        the native subclass hands the whole run over in one C call.
+        Raises InvalidRequestMsg on malformed input; messages parsed
+        before the bad frame stay queued for the error path."""
+        out = self.take_queued()
+        try:
+            while True:
+                m = self._parse_one()
+                if m is None:
+                    return out
+                out.append(m)
+                if self._q:
+                    out.extend(self.take_queued())
+        except InvalidRequestMsg:
+            # stash the clean prefix: the caller's error path can still
+            # execute/reply the messages that parsed before the bad frame
+            # (take_queued) instead of silently dropping them
+            self._q = out
+            self._qpos = 0
+            raise
+
+    def pushback(self, msgs: list) -> None:
+        """Re-queue already-drained messages at the FRONT of the delivery
+        order (they re-emerge from next_msg()/drain() before anything
+        still in the byte buffer).  Used when a drained chunk turns out
+        to straddle an ownership boundary — e.g. a SYNC upgrade hands the
+        connection (and every message after the SYNC) to the replica
+        link.  Note take_raw() reads the BYTE buffer and ignores this
+        queue; raw snapshot runs never mix with pushed-back messages."""
+        if not msgs:
+            return
+        rest = self.take_queued()
+        self._q = list(msgs) + rest
+        self._qpos = 0
+
+    def _parse_one(self) -> Optional[Msg]:
         buf = self._buf
         pos = self._pos
         blen = len(buf)
@@ -264,25 +330,12 @@ class NativeRespParser(RespParser):
     under the single-writer loop.
     """
 
-    __slots__ = ("_q", "_qpos")
+    __slots__ = ()
 
-    def __init__(self, max_depth: int = 32):
-        super().__init__(max_depth)
-        self._q: list = []
-        self._qpos = 0
-
-    def next_msg(self) -> Optional[Msg]:
-        q = self._q
-        if self._qpos < len(q):
-            m = q[self._qpos]
-            self._qpos += 1
-            if self._qpos >= len(q):
-                q.clear()
-                self._qpos = 0
-            return m
+    def _parse_one(self) -> Optional[Msg]:
         ext = _ext()
         if ext is None:
-            return super().next_msg()
+            return super()._parse_one()
         try:
             msgs, new_pos, fallback = ext.resp_parse(
                 self._buf, self._pos, Arr, Bulk, Int, Simple, Err, NIL)
@@ -291,11 +344,14 @@ class NativeRespParser(RespParser):
         self._pos = new_pos
         self._compact()
         if msgs:
-            self._q = msgs
-            self._qpos = 1
+            if len(msgs) > 1:
+                # only called with the delivery queue empty (next_msg /
+                # drain pop it first), so the overflow can take it over
+                self._q = msgs
+                self._qpos = 1
             return msgs[0]
         if fallback:
-            return super().next_msg()
+            return super()._parse_one()
         return None
 
 

@@ -1081,6 +1081,252 @@ def _enc_delcnt(bb, recs) -> None:
             bb.n_rows += 1
 
 
+# ====================================================================
+# serve planners — the client-path coalescing seam (server/serve.py).
+# Pipelined client chunks are planned instead of executed per message:
+# each planner below translates ONE client command into (a) its
+# replication rewrite — buffered for the columnar GROUP encoders above
+# and for repl_log.push_many — and (b) its reply, computed from the
+# landed store plus the pending run's tracked deltas (which is exactly
+# the state the per-command path would have seen, because the run lands
+# before anything else can read it: reads and non-plannable commands
+# are ordered barriers that flush first, and the whole chunk runs
+# synchronously on the single-writer loop).  Only commands whose
+# handler is a pure pointwise CRDT write with a reply derivable from
+# (pre-state, args) are plannable; everything else — reads, DEL and the
+# other read-modify rewrites, expiry, membership, admin — executes on
+# the exact per-command path as a barrier.
+# ====================================================================
+
+SERVE_PLANNERS: dict[bytes, Callable] = {}
+
+# Flush-time group encoders for the serve path: `fn(bb, recs, nodeid)`
+# over the compact per-command records the planners buffered.  Unlike
+# the replication COLUMNAR_ENCODERS (which parse raw wire frames at
+# flush), these receive arguments the planner ALREADY coerced during
+# validation — flush is pure C-speed list comprehension, no re-parse,
+# and nothing here can raise on a planner-built record.  Row layouts
+# are identical to the replication encoders', with one deliberate
+# difference: element adds carry dt_check=False — a client write's
+# fresh HLC uuid is strictly newer than any landed key-delete time (the
+# clock has observed every landed write), and barriers flush before
+# anything can raise a pending key's dt, so the flush-time key-delete
+# rule is provably inert and its batched dt lookup is skipped.
+SERVE_ENCODERS: dict[bytes, Callable] = {}
+
+
+def _senc_set(bb, recs, nodeid):
+    uuids = [r[1] for r in recs]
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_BYTES, uuids)
+    bb.reg_run(ki0, uuids, [nodeid] * len(recs), [r[2] for r in recs])
+
+
+def _senc_cntset(bb, recs, nodeid):
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_COUNTER,
+                      [r[1] for r in recs])
+    bb.cnt_rows.extend((ki0 + i, nodeid, r[2], r[1], 0, S.NEUTRAL_T)
+                       for i, r in enumerate(recs))
+    bb.n_rows += len(recs)
+
+
+def _senc_elem_adds(enc: int, with_vals: bool):
+    def enc_fn(bb, recs, nodeid):
+        ki0 = bb.add_keys([r[0] for r in recs], enc, [r[1] for r in recs])
+        el = bb.el_rows
+        n = 0
+        for i, r in enumerate(recs):
+            el.append((ki0 + i, r[2], r[3] if with_vals else None,
+                       r[1], nodeid, 0, False))
+            n += len(r[2])
+        bb.n_rows += n
+        if with_vals:
+            bb._el_has_vals = True
+    return enc_fn
+
+
+def _senc_elem_rems(enc: int):
+    def enc_fn(bb, recs, nodeid):
+        ki0 = bb.add_keys([r[0] for r in recs], enc, [r[1] for r in recs])
+        el = bb.el_rows
+        n = 0
+        for i, r in enumerate(recs):
+            el.append((ki0 + i, r[2], None, 0, 0, r[1], False))
+            n += len(r[2])
+        bb.n_rows += n
+    return enc_fn
+
+
+SERVE_ENCODERS[b"set"] = _senc_set
+SERVE_ENCODERS[b"cntset"] = _senc_cntset
+SERVE_ENCODERS[b"sadd"] = _senc_elem_adds(S.ENC_SET, with_vals=False)
+SERVE_ENCODERS[b"hset"] = _senc_elem_adds(S.ENC_DICT, with_vals=True)
+SERVE_ENCODERS[b"srem"] = _senc_elem_rems(S.ENC_SET)
+SERVE_ENCODERS[b"hdel"] = _senc_elem_rems(S.ENC_DICT)
+
+# Reads that observe exactly the key in their first argument (and touch
+# no global state — not the repl_log, not membership, not stats).  With
+# a run pending, such a read is a NON-FLUSHING barrier when its key has
+# no pending rows: it commutes with every buffered write, so it may
+# execute per-command in place while the run keeps filling — the serve
+# twin of the replication coalescer's KEY_SCOPED_BARRIERS.  Anything
+# else non-plannable flushes first (writes also push the repl_log,
+# whose uuids must stay ordered with the pending run's).
+SERVE_KEY_SCOPED_READS = frozenset(
+    (b"get", b"smembers", b"hget", b"hgetall", b"lrange", b"llen",
+     b"ttl", b"desc", b"mvget"))
+
+_INT0 = Int(0)
+
+
+def serve_plan(name: str):
+    """Register `fn(coal, items) -> Msg | None` as the serve-path planner
+    for the client command `name` (`items` = the raw client frame,
+    `[name, args...]`; `coal` = the connection's ServeCoalescer).  A
+    planner either buffers the command's replication rewrite into the
+    pending run and returns the reply, or returns None to DEMOTE the
+    command to the exact per-command path (arity/coercion errors, type
+    conflicts — node.execute raises the exact op error there).
+
+    Contract (the planner twin of the encoders' parse-then-mutate rule):
+    every demotion happens BEFORE the first mutation of coalescer state
+    or the node HLC — a demoted command re-executes on the per-command
+    path, which must mint the next uuid itself and see the store exactly
+    as if the planner had never looked."""
+    def deco(fn):
+        cmd = COMMANDS[name.encode()]
+        assert cmd.is_write and not (cmd.flags & CMD_REPL_ONLY), name
+        SERVE_PLANNERS[cmd.name] = fn
+        return fn
+    return deco
+
+
+@serve_plan("set")
+def _plan_set(coal, items):
+    # op twin: get_or_create + register_set (LWW) + replicate verbatim.
+    # The win test runs against the pending run's register state when the
+    # key was already written this run, else the landed (rv_t, rv_node) —
+    # a fresh client uuid beats both in practice (the HLC has observed
+    # every landed write), but the comparison stays exact regardless.
+    if len(items) < 3:
+        return None
+    try:
+        key = as_bytes(items[1])
+        val = as_bytes(items[2])
+    except CstError:
+        return None
+    kid = coal.resolve_key(key, S.ENC_BYTES)
+    if kid is coal.CONFLICT:
+        return None
+    uuid = coal.tick()
+    st = coal.regs.get(key)
+    if st is None:
+        st = (int(coal.ks.keys.rv_t[kid]), int(coal.ks.keys.rv_node[kid])) \
+            if kid >= 0 else (0, 0)
+    won = not S.lww_wins(st[0], st[1], uuid, coal.nodeid)
+    if won:
+        coal.regs[key] = (uuid, coal.nodeid)
+    coal.add(b"set", (key, uuid, val), items[1:])
+    return OK if won else _INT0
+
+
+def _plan_counter_step(coal, items, sign):
+    # op twin: _counter_step — bump our slot's lifetime total, reply the
+    # new visible sum, replicate the ABSOLUTE total as `cntset`.  Both
+    # numbers need the pre-run state once per key (landed sum + our
+    # slot's landed total); later steps in the run are dict arithmetic.
+    if len(items) < 2:
+        return None
+    try:
+        key = as_bytes(items[1])
+        delta = sign if len(items) < 3 else sign * as_int(items[2])
+    except CstError:
+        return None
+    kid = coal.resolve_key(key, S.ENC_COUNTER)
+    if kid is coal.CONFLICT:
+        return None
+    uuid = coal.tick()
+    st = coal.cnts.get(key)
+    if st is None:
+        ks = coal.ks
+        st = [ks.counter_sum(kid),
+              ks.counter_slot_total(kid, coal.nodeid)] if kid >= 0 \
+            else [0, 0]
+        coal.cnts[key] = st
+    st[0] += delta
+    st[1] += delta
+    coal.add(b"cntset", (key, uuid, st[1]), [items[1], Int(st[1])])
+    return Int(st[0])
+
+
+@serve_plan("incr")
+def _plan_incr(coal, items):
+    return _plan_counter_step(coal, items, 1)
+
+
+@serve_plan("decr")
+def _plan_decr(coal, items):
+    return _plan_counter_step(coal, items, -1)
+
+
+def _plan_elem_update(coal, items, name, enc, add):
+    # op twin: sadd/srem — the reply counts members whose VISIBILITY
+    # flipped (elem_add/elem_rem return values), evaluated against the
+    # landed element rows overlaid with the run's tracked flips.  A
+    # fresh client uuid always wins the add-side LWW and the del-side
+    # max, so visibility after the op is simply `add`.
+    if len(items) < 3:
+        return None
+    try:
+        key = as_bytes(items[1])
+        members = [as_bytes(m) for m in items[2:]]
+    except CstError:
+        return None
+    kid = coal.resolve_key(key, enc)
+    if kid is coal.CONFLICT:
+        return None
+    uuid = coal.tick()
+    cnt = coal.count_elem_flips(key, kid, members, add)
+    coal.add(name, (key, uuid, members), items[1:])
+    return Int(cnt)
+
+
+@serve_plan("sadd")
+def _plan_sadd(coal, items):
+    return _plan_elem_update(coal, items, b"sadd", S.ENC_SET, True)
+
+
+@serve_plan("srem")
+def _plan_srem(coal, items):
+    return _plan_elem_update(coal, items, b"srem", S.ENC_SET, False)
+
+
+@serve_plan("hdel")
+def _plan_hdel(coal, items):
+    return _plan_elem_update(coal, items, b"hdel", S.ENC_DICT, False)
+
+
+@serve_plan("hset")
+def _plan_hset(coal, items):
+    # op twin: hset — reply counts fields that became visible; values
+    # ride the add-side LWW (overwriting a live field counts 0).
+    n = len(items)
+    if n < 4 or n & 1:
+        return None  # key + (field, value) pairs — WrongArity otherwise
+    try:
+        key = as_bytes(items[1])
+        fields = [as_bytes(f) for f in items[2::2]]
+        vals = [as_bytes(v) for v in items[3::2]]
+    except CstError:
+        return None
+    kid = coal.resolve_key(key, S.ENC_DICT)
+    if kid is coal.CONFLICT:
+        return None
+    uuid = coal.tick()
+    cnt = coal.count_elem_flips(key, kid, fields, True)
+    coal.add(b"hset", (key, uuid, fields, vals), items[1:])
+    return Int(cnt)
+
+
 # membership + observability commands register themselves against this table
 from ..replica import commands as _replica_commands  # noqa: E402,F401
 from . import info as _info_commands  # noqa: E402,F401

@@ -93,6 +93,18 @@ def _section_stats(node, out):
     out.append(("repl_frames_coalesced", st.repl_frames_coalesced))
     out.append(("repl_coalesce_flushes", st.repl_coalesce_flushes))
     out.append(("repl_apply_barriers", st.repl_apply_barriers))
+    # client-serving coalescing (server/serve.py), mirroring the repl_*
+    # trio above; the latency percentiles come from the sampled
+    # plan→land ring (CONSTDB_SERVE_LAT_SAMPLE)
+    out.append(("serve_msgs_coalesced", st.serve_msgs_coalesced))
+    out.append(("serve_flushes", st.serve_flushes))
+    out.append(("serve_barriers", st.serve_barriers))
+    if st.serve_lat:
+        lat_ms = np.fromiter(st.serve_lat, dtype=np.float64) * 1000.0
+        out.append(("serve_lat_p50_ms",
+                    round(float(np.percentile(lat_ms, 50)), 3)))
+        out.append(("serve_lat_p99_ms",
+                    round(float(np.percentile(lat_ms, 99)), 3)))
     out.append(("merge_batches", st.merges))
     out.append(("merge_rows", st.merge_rows))
     out.append(("merge_seconds_total", round(st.merge_secs, 6)))

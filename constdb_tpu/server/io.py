@@ -49,7 +49,8 @@ class ServerApp:
                  ingest_shards: int = 0,
                  ingest_shard_min_bytes: int = 64 << 20,
                  apply_batch: Optional[int] = None,
-                 apply_latency: Optional[float] = None):
+                 apply_latency: Optional[float] = None,
+                 serve_batch: Optional[int] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -91,6 +92,13 @@ class ServerApp:
         # node to the exact per-frame path.
         self.apply_batch = apply_batch
         self.apply_latency = apply_latency
+        # client-path coalescing (server/serve.py): max pipelined
+        # commands planned into one columnar micro-merge.  None = the
+        # CONSTDB_SERVE_BATCH env default; <= 1 pins every connection to
+        # the exact per-command path (no coalescer is ever constructed).
+        from ..conf import env_int
+        self.serve_batch = env_int("CONSTDB_SERVE_BATCH", 512) \
+            if serve_batch is None else serve_batch
         # peers silent beyond this stop pinning the GC horizon
         self.gc_peer_retention = gc_peer_retention
         node.replicas.gc_peer_retention_ms = int(gc_peer_retention * 1000)
@@ -228,6 +236,13 @@ class ServerApp:
         parser = make_parser()
         out = bytearray()
         upgraded = False
+        coal = None
+        if self.serve_batch > 1:
+            # pipelined chunks are PLANNED instead of executed
+            # per message (server/serve.py); serve_batch <= 1
+            # (CONSTDB_SERVE_BATCH=1) keeps the exact per-command loop
+            from .serve import ServeCoalescer
+            coal = ServeCoalescer(self.node, max_run=self.serve_batch)
         try:
             while True:
                 data = await reader.read(_READ_CHUNK)
@@ -235,26 +250,78 @@ class ServerApp:
                     break
                 self.node.stats.net_in_bytes += len(data)
                 parser.feed(data)
-                while (msg := parser.next_msg()) is not None:
-                    if self._is_sync(msg):
-                        self._upgrade_to_replica(msg, reader, writer, parser)
-                        upgraded = True
-                        break
-                    reply = self.node.execute(msg)
-                    if not isinstance(reply, NoReply):
-                        encode_into(out, reply)
+                if coal is None:
+                    while (msg := parser.next_msg()) is not None:
+                        if self._is_sync(msg):
+                            # replies for commands pipelined BEFORE the
+                            # SYNC must reach the client before the
+                            # handshake reply takes over the stream
+                            out = self._flush_out(writer, out)
+                            self._upgrade_to_replica(msg, reader, writer,
+                                                     parser)
+                            upgraded = True
+                            break
+                        reply = self.node.execute(msg)
+                        if not isinstance(reply, NoReply):
+                            encode_into(out, reply)
+                else:
+                    msgs = parser.drain()
+                    for i, msg in enumerate(msgs):
+                        if self._is_sync(msg):
+                            # messages after the SYNC belong to the
+                            # replica link's stream — hand them back
+                            # before the link adopts the parser
+                            parser.pushback(msgs[i + 1:])
+                            if i:
+                                coal.run_chunk(msgs[:i], out)
+                            out = self._flush_out(writer, out)
+                            self._upgrade_to_replica(msg, reader, writer,
+                                                     parser)
+                            upgraded = True
+                            break
+                    else:
+                        if msgs:
+                            coal.run_chunk(msgs, out)
                 if upgraded:
                     return  # connection now owned by the replica link
                 if out:
-                    self.node.stats.net_out_bytes += len(out)
-                    writer.write(bytes(out))
-                    out.clear()
+                    out = self._flush_out(writer, out)
                     await writer.drain()
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             pass
         except CstError as e:
+            # a malformed frame mid-pipeline: replies already encoded in
+            # `out` for earlier completed commands must still reach the
+            # client (dropping them desyncs its pipeline accounting), and
+            # messages that parsed cleanly before the bad frame still
+            # execute (the parser stashed them — take_queued)
             try:
-                writer.write(encode_msg_err(e))
+                salvaged = parser.take_queued()
+                sync_at = next((i for i, m in enumerate(salvaged)
+                                if self._is_sync(m)), -1)
+                if sync_at >= 0:
+                    # a SYNC parsed clean before the bad frame: execute
+                    # the prefix, hand the rest back, and upgrade — the
+                    # malformed bytes stay in the parser and surface on
+                    # the link's stream (the per-command loop's behavior)
+                    head, syn = salvaged[:sync_at], salvaged[sync_at]
+                    parser.pushback(salvaged[sync_at + 1:])
+                    salvaged = head
+                if salvaged:
+                    if coal is not None:
+                        coal.run_chunk(salvaged, out)
+                    else:
+                        for msg in salvaged:
+                            reply = self.node.execute(msg)
+                            if not isinstance(reply, NoReply):
+                                encode_into(out, reply)
+                if sync_at >= 0:
+                    out = self._flush_out(writer, out)
+                    self._upgrade_to_replica(syn, reader, writer, parser)
+                    upgraded = True
+                    return
+                encode_into(out, Err(e.resp_error()))
+                out = self._flush_out(writer, out)
                 await writer.drain()
             except (ConnectionError, OSError):
                 pass
@@ -264,6 +331,19 @@ class ServerApp:
             # an upgraded connection is owned by its replica link now
             if not upgraded and not writer.is_closing():
                 writer.close()
+
+    def _flush_out(self, writer, out: bytearray) -> bytearray:
+        """Queue accumulated replies on the transport and return a fresh
+        buffer.  Buffer SWAP instead of bytes(out): ownership moves to
+        the transport (which copies only what it cannot send
+        immediately) — no reply-buffer copy per chunk.  Also used before
+        a SYNC upgrade takes the stream over, so pipelined-before-SYNC
+        replies are not dropped."""
+        if out:
+            self.node.stats.net_out_bytes += len(out)
+            writer.write(out)
+            out = bytearray()
+        return out
 
     @staticmethod
     def _is_sync(msg) -> bool:
@@ -333,12 +413,6 @@ class ServerApp:
 def encode_msg_arr(items) -> bytes:
     out = bytearray()
     encode_into(out, Arr(items))
-    return bytes(out)
-
-
-def encode_msg_err(e: CstError) -> bytes:
-    out = bytearray()
-    encode_into(out, Err(e.resp_error()))
     return bytes(out)
 
 

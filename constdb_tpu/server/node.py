@@ -9,6 +9,7 @@ IO concurrency lives in server/io.py, bulk merge compute in engine/.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -41,6 +42,15 @@ class NodeStats:
     repl_frames_coalesced: int = 0
     repl_coalesce_flushes: int = 0
     repl_apply_barriers: int = 0
+    # client-serving coalescing (server/serve.py): pipelined client
+    # commands folded into columnar micro-batches, batches landed,
+    # commands that acted as ordered barriers (reads / non-plannable
+    # writes / admin inside a coalesced chunk), and a sampled ring of
+    # plan→land reply latencies (seconds) surfaced as p50/p99 in INFO
+    serve_msgs_coalesced: int = 0
+    serve_flushes: int = 0
+    serve_barriers: int = 0
+    serve_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
     merges: int = 0
     merge_rows: int = 0
     merge_secs: float = 0.0
@@ -144,14 +154,22 @@ class Node:
         if app is not None and getattr(app, "shared_dump", None) is not None:
             app.shared_dump.invalidate()
 
-    def merge_batches(self, batches: list) -> None:
+    def merge_batches(self, batches: list, logged: bool = False) -> None:
         """Merge a GROUP of columnar batches in one engine call when the
         engine supports it (engine/tpu.py merge_many reduces aligned groups
         in one fused [R, N] device pass, and unaligned groups still share
-        one state roundtrip per family); per-batch merges otherwise."""
+        one state roundtrip per family); per-batch merges otherwise.
+
+        A SINGLE batch also routes through merge_many when its rows may
+        repeat per slot (a serve/stream coalescer flush): that is where
+        both engines pick the vectorized host micro-strategy
+        (engine/hostbatch.py) — the per-batch `merge` entry point is the
+        CPU engine's per-row REFERENCE path, dozens of times slower at
+        op-stream scale."""
         if not batches:
             return
-        if len(batches) == 1 or not hasattr(self.engine, "merge_many"):
+        if not hasattr(self.engine, "merge_many") or \
+                (len(batches) == 1 and batches[0].rows_unique_per_slot):
             for b in batches:
                 self.merge_batch(b)
             return
@@ -161,10 +179,18 @@ class Node:
         self.stats.merge_secs += time.perf_counter() - t0
         self.stats.merges += 1
         self.stats.merge_rows += sum(b.n_rows for b in batches)
-        x = self.stats.extra
-        x["group_merges"] = x.get("group_merges", 0) + 1
-        x["group_merge_batches"] = x.get("group_merge_batches", 0) + len(batches)
-        self._dump_stale()
+        if len(batches) > 1:
+            x = self.stats.extra
+            x["group_merges"] = x.get("group_merges", 0) + 1
+            x["group_merge_batches"] = \
+                x.get("group_merge_batches", 0) + len(batches)
+        if not logged:
+            # `logged` batches (the serve coalescer's runs) are appended
+            # to the repl_log in full, so a cached full-sync dump plus a
+            # log tail still covers them — only UNLOGGED bulk merges must
+            # force the next peer onto a fresh dump (persist/share.py
+            # reuse rule)
+            self._dump_stale()
 
     def merge_stream_batch(self, builder, frames: int) -> None:
         """Land one coalesced replication micro-batch (the steady-state
@@ -178,6 +204,18 @@ class Node:
         self.merge_batches([builder.finalize()])
         self.stats.repl_frames_coalesced += frames
         self.stats.repl_coalesce_flushes += 1
+
+    def merge_serve_batch(self, builder, msgs: int) -> None:
+        """Land one coalesced client-serving micro-batch (the pipelined
+        RESP path, server/serve.py) through the same engine seam the
+        replication coalescer rides.  Same flush-before-finalize
+        discipline as merge_stream_batch: `builder.finalize()` reads
+        LIVE host columns.  The run is fully repl-logged by the caller,
+        so logged=True keeps the shared full-sync dump reusable."""
+        self.ensure_flushed()
+        self.merge_batches([builder.finalize()], logged=True)
+        self.stats.serve_msgs_coalesced += msgs
+        self.stats.serve_flushes += 1
 
     def reset_for_full_resync(self, keep_link=None) -> None:
         """Wipe local CRDT state and rejoin as a fresh node (the receive

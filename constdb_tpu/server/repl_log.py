@@ -18,7 +18,7 @@ from bisect import bisect_left, bisect_right
 from collections import deque
 from typing import Optional
 
-from ..resp.message import Arr, Msg, msg_size
+from ..resp.message import Arr, Bulk, Msg, msg_size
 
 
 class ReplEntry:
@@ -71,6 +71,43 @@ class ReplLog:
         while self._bytes > self.cap and len(self._entries) > 1:
             ev = self._entries.popleft()
             self._uuids.popleft()
+            self._bytes -= ev.size
+            self.evicted_up_to = ev.uuid
+
+    def push_many(self, cmds: list) -> None:
+        """Append a planned run of `(uuid, name, args)` tuples in one pass
+        (the serve coalescer's flush — server/serve.py).  Semantically
+        identical to looping `push` (pinned by tests/test_serve_coalesce),
+        but the ring makes ONE eviction sweep at the end instead of one
+        per entry, and the hot-loop attribute churn collapses to locals.
+        Uuids must be strictly increasing, like every push."""
+        if not cmds:
+            return
+        entries = self._entries
+        uuids = self._uuids
+        prev = self.last_uuid
+        added = 0
+        for uuid, name, args in cmds:
+            if uuid <= prev:
+                raise ValueError(
+                    f"repl_log uuids must be increasing: {uuid} <= {prev}")
+            size = len(name)
+            for a in args:
+                # Bulk is ~every argument; dodge the getattr probe
+                if type(a) is Bulk:
+                    size += len(a.val)
+                else:
+                    v = getattr(a, "val", None)
+                    size += len(v) if type(v) is bytes else msg_size(a)
+            entries.append(ReplEntry(uuid, prev, name, args, size))
+            uuids.append(uuid)
+            added += size
+            prev = uuid
+        self._bytes += added
+        self.last_uuid = prev
+        while self._bytes > self.cap and len(entries) > 1:
+            ev = entries.popleft()
+            uuids.popleft()
             self._bytes -= ev.size
             self.evicted_up_to = ev.uuid
 

@@ -1,0 +1,487 @@
+"""Coalesced client serving: pipelined RESP chunks ride the merge engine.
+
+The connection loop (server/io.py) used to execute every client command
+one at a time through the full dispatch stack — the same per-message
+Python shape PR 4 eliminated on the replication intake.  Under pipelined
+load a single read chunk carries dozens of commands; this module plans
+the chunk instead: contiguous runs of group-encodable write commands
+(`server/commands.py SERVE_PLANNERS`) are translated into their
+replication rewrites, group-encoded into ONE ColumnarBatch by the same
+COLUMNAR_ENCODERS the replication coalescer uses, and landed through
+`node.merge_serve_batch` (vectorized host micro-merge,
+engine/hostbatch.py).  The run's repl_log entries append in one pass
+(`ReplLog.push_many`).
+
+Ordering discipline (docs/INVARIANTS.md "Client-serving coalescing"):
+
+  * replies are produced strictly in request order.  Planned replies are
+    computed at plan time from the landed store overlaid with the
+    pending run's tracked per-key deltas — byte-identical to what the
+    per-command path would have replied, because the whole chunk runs
+    synchronously on the single-writer loop (nothing can interleave) and
+    every command that could OBSERVE pending rows is a barrier.
+  * reads, non-plannable writes, and admin commands are ordered
+    BARRIERS: the pending run flushes (lands + logs) first, then the
+    command executes on the exact per-command path.  Read-your-writes
+    within a pipeline is therefore free, and the reply socket write
+    already sits at end-of-chunk, after the covering flush.  Two
+    refinements keep barriers from fragmenting runs: a key-scoped READ
+    of a key with no pending rows commutes with the whole run and
+    executes WITHOUT flushing it (SERVE_KEY_SCOPED_READS), and a
+    barrier invalidates only the cached state it could actually have
+    changed — the key in its first argument (_invalidate_after) — so
+    the chunk's bulk-seeded probe caches (_preprobe) survive.
+  * a chunk that yields a single message takes the per-command path
+    untouched — a lone command pays ZERO added latency and no
+    micro-merge overhead.  `CONSTDB_SERVE_BATCH=1` pins every
+    connection to the exact per-command path (server/io.py never
+    constructs a coalescer).
+  * the run NEVER spans chunks: replies must reach the socket at
+    end-of-chunk, so the chunk epilogue always flushes.  Between chunks
+    the loop runs (peer streams, other clients), so all per-chunk state
+    caches reset at chunk entry.
+
+Exactness notes (why planned == per-command, byte for byte):
+  * every plannable command's local apply equals applying its own
+    replication rewrite — and PR 4 established that the rewrites'
+    columnar GROUP encoding through the merge engine is byte-identical
+    to the per-key op path (replica/coalesce.py module docstring).
+  * replies: `set` wins its LWW against any landed state (the HLC has
+    observed every landed write, so a fresh client uuid is strictly
+    newer) and against earlier pending writes (smaller uuids) — the
+    planner still runs the exact comparison.  Counter replies derive
+    from one landed-state probe per key per run plus tracked deltas;
+    element replies from one landed-row probe per (key, member) plus
+    tracked visibility flips.
+  * uuid parity: planners mint one HLC write-tick per planned command,
+    demotions mint none — the uuid sequence is identical to the
+    per-command path's, which makes a coalesced node's canonical export
+    byte-identical to a CONSTDB_SERVE_BATCH=1 node's under the same
+    deterministic workload (tests/test_serve_coalesce.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..resp.codec import encode_into
+from ..resp.message import Arr, Bulk, NoReply
+from ..replica.coalesce import BatchBuilder
+from ..crdt import semantics as S
+from ..store.keyspace import KeySpace
+from .commands import (CMD_CTRL, CMD_READONLY, COMMANDS, SERVE_ENCODERS,
+                       SERVE_KEY_SCOPED_READS, SERVE_PLANNERS)
+from .events import EVENT_REPLICATED
+
+_I64 = np.int64
+
+# pre-probe extraction tables (_preprobe): which argument positions of a
+# plannable command name state the planners will ask for
+_PP_REG = frozenset((b"set",))
+_PP_CNT = frozenset((b"incr", b"decr"))
+_PP_EL = {b"sadd": (S.ENC_SET, 1), b"srem": (S.ENC_SET, 1),
+          b"hset": (S.ENC_DICT, 2), b"hdel": (S.ENC_DICT, 1)}
+_PP_ANY = _PP_REG | _PP_CNT | frozenset(_PP_EL)
+# below this many plannable commands the batch calls cost more than the
+# per-command probes they replace
+_PREPROBE_MIN = 16
+
+# demotion sentinel returned by ServeCoalescer.resolve_key on a type
+# conflict: the command re-executes per-command and raises the exact
+# op-path error (planners compare with `is`)
+CONFLICT = object()
+
+
+class ServeCoalescer:
+    """Per-connection planner driving pipelined client chunks into the
+    node (see module docstring for the discipline)."""
+
+    CONFLICT = CONFLICT
+
+    __slots__ = ("node", "max_run", "nodeid", "ks", "regs", "cnts", "els",
+                 "_keys", "_pending_keys", "_buf", "_log", "_pending",
+                 "_planned", "_lat_pending", "_sample_every", "_now")
+
+    def __init__(self, node, max_run: int = 512,
+                 sample_every: int | None = None,
+                 now=time.monotonic) -> None:
+        from ..conf import env_int
+        self.node = node
+        self.max_run = max_run
+        self.nodeid = node.node_id
+        self.ks = node.ks
+        # per-chunk overlay caches: landed-state probes (seeded in bulk
+        # by _preprobe) overlaid with the pending run's own writes.
+        # Reset at chunk entry; a mid-chunk barrier invalidates only the
+        # key it touched (_invalidate_after) — everything else it could
+        # not have changed stays warm.
+        self._keys: dict = {}   # key -> (kid, enc); kid -1 = run-created
+        self.regs: dict = {}    # key -> (rv_t, rv_node)
+        self.cnts: dict = {}    # key -> [visible_sum, my_slot_total]
+        self.els: dict = {}     # key -> {member -> visible?}
+        # the pending run
+        self._pending_keys: set = set()  # keys with un-landed rows
+        self._buf: dict = {}    # rewrite name -> encoder recs
+        self._log: list = []    # (uuid, name, args) for push_many
+        self._pending = 0
+        self._planned = 0
+        self._lat_pending: list = []
+        self._sample_every = env_int("CONSTDB_SERVE_LAT_SAMPLE", 32) \
+            if sample_every is None else sample_every
+        self._now = now
+
+    # -------------------------------------------------------------- chunk
+
+    def run_chunk(self, msgs: list, out: bytearray) -> None:
+        """Plan and execute one drained chunk of client messages,
+        appending every reply to `out` in request order.  The pending
+        run always lands before this returns."""
+        self._reset_caches()
+        if len(msgs) == 1:
+            # lone command: the exact per-command path, zero overhead
+            # (no invalidation needed — the next chunk resets anyway)
+            self._exec(msgs[0], out, count_barrier=False,
+                       invalidate=False)
+            return
+        plan = [self._planner_of(m) for m in msgs]
+        n = len(msgs)
+        n_plannable = sum(f is not None for f in plan)
+        if n_plannable >= _PREPROBE_MIN:
+            self._preprobe(msgs, plan)
+        max_run = self.max_run
+        for i, msg in enumerate(msgs):
+            fn = plan[i]
+            isolated = False
+            # a plannable command opens a run only when it has company
+            # (an open run, or a plannable successor) — an isolated
+            # write between barriers is cheaper per-command than as a
+            # one-row micro-merge
+            if fn is not None:
+                if self._pending or \
+                        (i + 1 < n and plan[i + 1] is not None):
+                    reply = fn(self, msg.items)
+                    if reply is not None:
+                        encode_into(out, reply)
+                        if self._pending >= max_run:
+                            self.flush()
+                        continue
+                    # else: demoted — a real barrier (exact op error)
+                else:
+                    isolated = True  # per-command by CHOICE, not a barrier
+            if self._pending and not self._scoped_read_commutes(msg):
+                self.flush()
+            self._exec(msg, out, count_barrier=not isolated)
+        if self._pending:
+            self.flush()
+
+    @staticmethod
+    def _planner_of(msg):
+        if type(msg) is not Arr or not msg.items:
+            return None
+        head = msg.items[0]
+        if type(head) is not Bulk:
+            return None
+        name = head.val
+        fn = SERVE_PLANNERS.get(name)
+        if fn is None and name not in COMMANDS:
+            # mirror the dispatch table's lazy lowercase fallback
+            fn = SERVE_PLANNERS.get(name.lower())
+        return fn
+
+    def _preprobe(self, msgs: list, plan: list) -> None:
+        """Seed the run caches for a whole chunk with BATCHED index
+        probes: one native key lookup for every plannable command's key,
+        one counter-slot batch, one member-interner batch, one element
+        combo batch — replacing the per-command (and per-member) hash
+        probes the planners would otherwise pay.  Seeds are exactly the
+        values the first per-command probe would read (the store cannot
+        change between here and the plans — the chunk runs synchronously
+        and everything mutation-capable resets the caches), so planner
+        behavior is byte-identical with or without this pass.  Commands
+        whose arguments do not parse are simply not seeded — their
+        planner demotes them as usual."""
+        node = self.node
+        if getattr(node.engine, "needs_flush", False):
+            node.ensure_flushed()
+        ks = self.ks
+        reg_keys: list = []
+        cnt_keys: list = []
+        el_cmds: list = []   # (key, want_enc, member item step, items)
+        for i, fn in enumerate(plan):
+            if fn is None:
+                continue
+            items = msgs[i].items
+            if len(items) < 2:
+                continue
+            k = items[1]
+            if type(k) is not Bulk:
+                continue
+            nm = items[0].val
+            if nm not in _PP_ANY:
+                nm = nm.lower()
+            if nm in _PP_REG:
+                reg_keys.append(k.val)
+            elif nm in _PP_CNT:
+                cnt_keys.append(k.val)
+            else:
+                ent = _PP_EL.get(nm)
+                if ent is None:
+                    continue
+                # member extraction is deferred until the key batch shows
+                # the key exists with the right encoding — new keys (and
+                # demotion-bound conflicts) never pay it
+                el_cmds.append((k.val, ent[0], ent[1], items))
+        all_keys = reg_keys + cnt_keys + [e[0] for e in el_cmds]
+        if not all_keys:
+            return
+        kids = ks.key_index.lookup_batch(all_keys).tolist()
+        enc_col = ks.keys.enc
+        keys_cache = self._keys
+        pos = 0
+        if reg_keys:
+            regs = self.regs
+            rv_t, rv_n = ks.keys.rv_t, ks.keys.rv_node
+            for key in reg_keys:
+                kid = kids[pos]
+                pos += 1
+                if kid >= 0 and key not in keys_cache:
+                    e = int(enc_col[kid])
+                    keys_cache[key] = (kid, e)
+                    if e == S.ENC_BYTES:
+                        regs[key] = (int(rv_t[kid]), int(rv_n[kid]))
+        if cnt_keys:
+            cnts = self.cnts
+            probe: list = []
+            for key in cnt_keys:
+                kid = kids[pos]
+                pos += 1
+                if kid >= 0 and key not in keys_cache:
+                    e = int(enc_col[kid])
+                    keys_cache[key] = (kid, e)
+                    if e == S.ENC_COUNTER and key not in cnts:
+                        probe.append((key, kid))
+            if probe:
+                kid_arr = np.fromiter((p[1] for p in probe), dtype=_I64,
+                                      count=len(probe))
+                rows = ks.cnt_rows_lookup(ks.rank_of(self.nodeid), kid_arr)
+                vals = np.where(rows >= 0, ks.cnt.val[rows], 0).tolist()
+                sums = ks.keys.cnt_sum[kid_arr].tolist()
+                for (key, _kid), sm, tot in zip(probe, sums, vals):
+                    cnts[key] = [sm, tot]
+        if el_cmds:
+            els = self.els
+            flat_kids: list = []
+            flat_members: list = []
+            seed: list = []  # per-key member dict aligned w/ flat_members
+            for key, want, step, items in el_cmds:
+                kid = kids[pos]
+                pos += 1
+                if kid < 0:
+                    continue
+                if key not in keys_cache:
+                    keys_cache[key] = (kid, int(enc_col[kid]))
+                if keys_cache[key][1] != want:
+                    continue  # the planner demotes this command
+                d = els.get(key)
+                if d is None:
+                    d = els[key] = {}
+                for m in items[2::step]:
+                    if type(m) is Bulk:
+                        flat_kids.append(kid)
+                        flat_members.append(m.val)
+                        seed.append(d)
+            if flat_members:
+                mids = ks.member_index.lookup_batch(flat_members)
+                combos = (np.fromiter(flat_kids, dtype=_I64,
+                                      count=len(flat_kids))
+                          << KeySpace.MEMBER_BITS) | mids
+                rows = ks.el_index.lookup_batch(combos)
+                rows[mids < 0] = -1
+                hit = rows >= 0
+                alive = np.zeros(len(rows), dtype=bool)
+                if hit.any():
+                    hr = rows[hit]
+                    alive[hit] = ks.el.add_t[hr] >= ks.el.del_t[hr]
+                for d, m, a in zip(seed, flat_members, alive.tolist()):
+                    if m not in d:
+                        d[m] = a
+
+    def _reset_caches(self) -> None:
+        self._keys.clear()
+        self.regs.clear()
+        self.cnts.clear()
+        self.els.clear()
+        self.ks = self.node.ks
+        self.nodeid = self.node.node_id
+
+    def _scoped_read_commutes(self, msg) -> bool:
+        """True iff `msg` is a key-scoped read whose key has no pending
+        rows (see commands.SERVE_KEY_SCOPED_READS) — it then commutes
+        with the whole pending run and executes without flushing it."""
+        if type(msg) is not Arr or len(msg.items) < 2:
+            return False
+        head = msg.items[0]
+        if type(head) is not Bulk:
+            return False
+        name = head.val
+        if name not in SERVE_KEY_SCOPED_READS and \
+                name.lower() not in SERVE_KEY_SCOPED_READS:
+            return False
+        key = msg.items[1]
+        return type(key) is Bulk and key.val not in self._pending_keys
+
+    def _exec(self, msg, out: bytearray, count_barrier: bool = True,
+              invalidate: bool = True) -> None:
+        """Exact per-command execution inside a chunk.  `count_barrier`
+        keeps the INFO stat to its documented meaning (reads,
+        non-plannable writes, demotions, admin) — an isolated plannable
+        write executed per-command by CHOICE is not a barrier, but its
+        mutation still invalidates its key's cached probes."""
+        node = self.node
+        reply = node.execute(msg)
+        if not isinstance(reply, NoReply):
+            encode_into(out, reply)
+        if count_barrier:
+            node.stats.serve_barriers += 1
+        if invalidate:
+            self._invalidate_after(msg)
+
+    def _invalidate_after(self, msg) -> None:
+        """Drop exactly the cached state a just-executed barrier could
+        have changed.  Every registered command's keyspace effects are
+        confined to the key in its FIRST argument (data commands; the
+        differential suite would catch a violation) — commands with
+        empty `families` (membership) and READONLY commands touch no
+        cached state at all (a read's lazy-expiry dt bump affects none
+        of the cached planes).  Anything unclassifiable drops the whole
+        cache."""
+        node = self.node
+        self.nodeid = node.node_id
+        self.ks = node.ks
+        items = msg.items if type(msg) is Arr else None
+        if not items:
+            return
+        head = items[0]
+        name = head.val if type(head) is Bulk else None
+        cmd = COMMANDS.get(name) if name is not None else None
+        if cmd is None and name is not None:
+            cmd = COMMANDS.get(name.lower())
+        if cmd is None:
+            return  # unknown command: Err reply, nothing executed
+        if cmd.flags & CMD_CTRL:
+            # control commands take subcommands, not keys (NODE ID even
+            # changes the identity the counter overlays are tracked
+            # under) — drop everything rather than mis-scope
+            self._reset_caches()
+            return
+        if cmd.flags & CMD_READONLY or not cmd.families:
+            return
+        if len(items) > 1 and type(items[1]) is Bulk:
+            key = items[1].val
+            self._keys.pop(key, None)
+            self.regs.pop(key, None)
+            self.cnts.pop(key, None)
+            self.els.pop(key, None)
+            return
+        self._reset_caches()
+
+    # ------------------------------------------------------ planner surface
+
+    def tick(self) -> int:
+        return self.node.hlc.tick(True)
+
+    def resolve_key(self, key: bytes, enc: int):
+        """kid for an existing key, -1 for a key this run (or this batch)
+        creates, CONFLICT on an encoding mismatch (the planner demotes —
+        the per-command path raises the exact InvalidType)."""
+        ent = self._keys.get(key)
+        if ent is not None:
+            kid, e = ent
+            return kid if e == enc else CONFLICT
+        node = self.node
+        if getattr(node.engine, "needs_flush", False):
+            node.ensure_flushed()
+        ks = self.ks
+        kid = ks.lookup(key)
+        if kid >= 0:
+            e = ks.enc_of(kid)
+            self._keys[key] = (kid, e)
+            return kid if e == enc else CONFLICT
+        self._keys[key] = (-1, enc)
+        return -1
+
+    def count_elem_flips(self, key: bytes, kid: int, members: list,
+                         add: bool) -> int:
+        """How many of `members` flip visibility under this add/remove —
+        the sadd/srem/hset/hdel reply — against landed rows overlaid
+        with the run's pending flips."""
+        d = self.els.get(key)
+        if d is None:
+            d = self.els[key] = {}
+        ks = self.ks
+        el = ks.el
+        cnt = 0
+        for m in members:
+            alive = d.get(m)
+            if alive is None:
+                if kid >= 0:
+                    row = ks.el_row(kid, m)
+                    alive = row >= 0 and S.elem_alive(
+                        int(el.add_t[row]), int(el.del_t[row]))
+                else:
+                    alive = False
+            if alive != add:
+                cnt += 1
+            d[m] = add
+        return cnt
+
+    def add(self, name: bytes, rec: tuple, args: list) -> None:
+        """Commit one planned command: buffer its pre-parsed record
+        (`rec[0]` = key, `rec[1]` = uuid — see commands.SERVE_ENCODERS
+        for the per-command tails) for the flush-time group encoders,
+        queue its repl_log entry, account it."""
+        buf = self._buf
+        recs = buf.get(name)
+        if recs is None:
+            recs = buf[name] = []
+        recs.append(rec)
+        self._pending_keys.add(rec[0])
+        self._log.append((rec[1], name, args))
+        self._pending += 1
+        self.node.stats.cmds_processed += 1
+        samp = self._sample_every
+        if samp and self._planned % samp == 0:
+            self._lat_pending.append(self._now())
+        self._planned += 1
+
+    # ---------------------------------------------------------------- land
+
+    def flush(self) -> None:
+        """Land the pending run: group-encode into one ColumnarBatch,
+        merge through the engine seam, append the run to the repl_log in
+        one pass, wake the pushers once."""
+        buf, self._buf = self._buf, {}
+        n, self._pending = self._pending, 0
+        if not n:
+            return
+        self._pending_keys.clear()
+        log, self._log = self._log, []
+        node = self.node
+        bb = BatchBuilder(node.ks)
+        nodeid = self.nodeid
+        for name, recs in buf.items():
+            # planner-built records are pre-parsed and well-formed by
+            # construction (demotion happens at plan time) — encoding is
+            # pure list comprehension and cannot reject
+            SERVE_ENCODERS[name](bb, recs, nodeid)
+        node.merge_serve_batch(bb, n)
+        node.repl_log.push_many(log)
+        node.events.trigger(EVENT_REPLICATED, log[-1][0])
+        lat = self._lat_pending
+        if lat:
+            now = self._now()
+            ring = node.stats.serve_lat
+            ring.extend(now - t for t in lat)
+            lat.clear()

@@ -409,6 +409,24 @@ class KeySpace:
             self.cnt_rank_live[rank] = self.cnt_rank_live.get(rank, 0) + 1
         return row
 
+    def counter_slot_total(self, kid: int, node: int) -> int:
+        """Read-only probe of one (key, node) slot's lifetime total (0 for
+        an unwritten slot).  The serve coalescer plans INCR rewrites from
+        this without materializing the slot row (`_cnt_row` would) — the
+        planned CNTSET batch row creates it when the run lands."""
+        rank = self.rank_of(node)
+        h = self.cnt_rank_hash.get(rank)
+        if h is not None:
+            row = h.get(kid, -1)
+        else:
+            row = -1
+            ent = self.cnt_rank_rows.get(rank)
+            if ent is not None:
+                base, arr = ent
+                if base <= kid < base + len(arr):
+                    row = int(arr[kid - base])
+        return int(self.cnt.val[row]) if row >= 0 else 0
+
     def _sync_cnt_lists(self) -> None:
         n = self.cnt.n
         if self._cnt_synced < n:

@@ -1,0 +1,155 @@
+"""Property/fuzz tests for resp/codec.py: random message trees round-trip
+through encode_into → parser (native and pure-Python), partial frames
+never advance the cursor, malformed input raises without consuming a
+clean prefix, and the drain/pushback/take_queued queue discipline holds.
+"""
+
+import random
+
+import pytest
+
+from constdb_tpu.errors import InvalidRequestMsg
+from constdb_tpu.resp.codec import (NativeRespParser, RespParser, encode_into,
+                                    encode_msg)
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, NIL, Simple
+
+PARSERS = (RespParser, NativeRespParser)  # native degrades to pure w/o ext
+
+
+def rand_msg(rng: random.Random, depth: int = 0):
+    """A random message tree.  Simple/Err payloads exclude CR/LF (the
+    encoder is not responsible for escaping line frames — no real reply
+    contains them); Bulk payloads are arbitrary binary."""
+    r = rng.random()
+    if depth < 3 and r < 0.25:
+        return Arr([rand_msg(rng, depth + 1)
+                    for _ in range(rng.randrange(0, 6))])
+    if r < 0.45:
+        return Bulk(bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(0, 40))))
+    if r < 0.65:
+        return Int(rng.choice((0, 1, -1, 7, 1023, 1024, -(1 << 40),
+                               (1 << 62), rng.randrange(-10**6, 10**6))))
+    if r < 0.80:
+        return Simple(bytes(rng.choice(b"abcXYZ 09_-") for _ in range(8)))
+    if r < 0.92:
+        return Err(b"ERR " + bytes(rng.choice(b"abcdef") for _ in range(6)))
+    return NIL
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_roundtrip_random_trees(parser_cls):
+    rng = random.Random(1234)
+    msgs = [rand_msg(rng) for _ in range(400)]
+    wire = bytearray()
+    for m in msgs:
+        encode_into(wire, m)
+    # feed in random-sized slices so messages straddle feed boundaries
+    parser = parser_cls()
+    got = []
+    pos = 0
+    wire = bytes(wire)
+    while pos < len(wire) or len(got) < len(msgs):
+        step = rng.randrange(1, 64)
+        parser.feed(wire[pos:pos + step])
+        pos += step
+        while (m := parser.next_msg()) is not None:
+            got.append(m)
+    assert got == msgs
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_roundtrip_drain(parser_cls):
+    rng = random.Random(77)
+    msgs = [rand_msg(rng) for _ in range(200)]
+    parser = parser_cls()
+    parser.feed(b"".join(encode_msg(m) for m in msgs))
+    assert parser.drain() == msgs
+    assert parser.drain() == []
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_truncated_frames_never_advance_cursor(parser_cls):
+    """Every proper prefix of an encoded message parses to None and
+    leaves the whole prefix buffered (the cursor stays at the message
+    start); feeding the remainder then yields the exact message."""
+    rng = random.Random(5)
+    samples = [rand_msg(rng) for _ in range(40)]
+    # include the shapes with tricky internal framing explicitly
+    samples += [Arr([Bulk(b"set"), Bulk(b"k"), Bulk(b"v" * 30)]),
+                Arr([Int(7), Arr([Bulk(b"x"), NIL]), Simple(b"OK")]),
+                Bulk(b""), Arr([])]
+    for m in samples:
+        wire = encode_msg(m)
+        for cut in range(len(wire)):
+            parser = parser_cls()
+            parser.feed(wire[:cut])
+            assert parser.next_msg() is None, (m, cut)
+            assert parser.buffered == cut, (m, cut)
+            parser.feed(wire[cut:])
+            assert parser.next_msg() == m, (m, cut)
+            assert parser.buffered == 0
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+@pytest.mark.parametrize("bad", (
+    b"!bogus\r\n",                      # unknown type byte
+    b"$-2\r\n",                         # negative non-nil bulk length
+    b"*-2\r\n",                         # negative non-nil array length
+    b":12x\r\n",                        # non-integer int line
+    b"$x\r\n",                          # non-integer bulk length
+    b"*1\r\n$3\r\nabcXY",               # bulk missing terminating CRLF
+    b"$2000000000000\r\n",              # bulk too large
+))
+def test_malformed_raises_and_keeps_clean_prefix(parser_cls, bad):
+    """Malformed input raises InvalidRequestMsg; a complete message in
+    front of the bad frame is still delivered first (next_msg) or
+    salvaged into the queue (drain + take_queued) — the cursor never
+    skips past or consumes a clean message."""
+    good = Arr([Bulk(b"set"), Bulk(b"k"), Bulk(b"v")])
+    parser = parser_cls()
+    parser.feed(encode_msg(good) + bad)
+    assert parser.next_msg() == good
+    with pytest.raises(InvalidRequestMsg):
+        while parser.next_msg() is not None:
+            pass
+    # drain path: the clean prefix is stashed for the error path
+    parser = parser_cls()
+    parser.feed(encode_msg(good) + bad)
+    with pytest.raises(InvalidRequestMsg):
+        parser.drain()
+    assert parser.take_queued() == [good]
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_pushback_order(parser_cls):
+    msgs = [Arr([Bulk(b"cmd%d" % i)]) for i in range(6)]
+    parser = parser_cls()
+    parser.feed(b"".join(encode_msg(m) for m in msgs[:4]))
+    drained = parser.drain()
+    assert drained == msgs[:4]
+    # push the tail back, feed two more: pushed-back messages re-emerge
+    # FIRST, then the buffer's
+    parser.pushback(drained[2:])
+    parser.feed(b"".join(encode_msg(m) for m in msgs[4:]))
+    assert parser.drain() == msgs[2:]
+    # pushback before a partial message in the buffer
+    parser.pushback([msgs[0]])
+    half = encode_msg(msgs[1])
+    parser.feed(half[:5])
+    assert parser.next_msg() == msgs[0]
+    assert parser.next_msg() is None
+    parser.feed(half[5:])
+    assert parser.next_msg() == msgs[1]
+
+
+def test_parsers_agree_on_random_trees():
+    """The native parser (when the extension is built) and the pure
+    parser produce identical message objects for identical bytes."""
+    rng = random.Random(99)
+    msgs = [rand_msg(rng) for _ in range(300)]
+    wire = b"".join(encode_msg(m) for m in msgs)
+    a, b = RespParser(), NativeRespParser()
+    a.feed(wire)
+    b.feed(wire)
+    assert a.drain() == b.drain() == msgs
