@@ -481,6 +481,121 @@ class ForkCaptureRule(Rule):
                                     "plane payloads cross the boundary")
 
 
+class KeyConfinedRule(Rule):
+    """KEY-CONFINED: every command registered for coalescing
+    (SERVE_PLANNERS via @serve_plan, COLUMNAR_ENCODERS via @columnar)
+    must be statically first-key-confined.
+
+    Three subsystems silently rely on the convention that a data
+    command's keyspace effects are confined to the key in its FIRST
+    argument: PR 5's barrier scoping (a barrier invalidates only its
+    first-arg key's cached probes), the replication coalescer's
+    key-scoped barrier commutes, and PR 10's shard routing (the whole
+    command executes inside the worker owning `crc32(items[1]) % N`).
+    A handler that resolves a key it did not take as its first argument
+    would silently corrupt all three.  The check: the handler's first
+    `args.next_bytes()` binding is THE key — every keyspace key
+    resolution (`lookup` / `query` / `get_or_create` / `create_key`)
+    must take exactly that name as its first argument, and a handler
+    with no such binding cannot be proven confined at all.  One level
+    of helper delegation (`incr` → `_counter_step(node, ctx, args, 1)`)
+    is followed."""
+
+    name = "KEY-CONFINED"
+    hint = ("derive the key from the handler's FIRST args.next_bytes() "
+            "and resolve only that name — or keep the command off the "
+            "coalescing tables (it stays an exact per-command barrier)")
+
+    KEY_RESOLVERS = {"lookup", "query", "get_or_create", "create_key"}
+    COALESCE_DECOS = {"serve_plan", "columnar"}
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "server")
+
+    @staticmethod
+    def _deco_str_arg(deco: ast.AST, names: set) -> str:
+        if isinstance(deco, ast.Call) and \
+                dotted(deco.func).rsplit(".", 1)[-1] in names and \
+                deco.args and isinstance(deco.args[0], ast.Constant) and \
+                isinstance(deco.args[0].value, str):
+            return deco.args[0].value
+        return ""
+
+    def check(self, ctx: FileContext):
+        coalesced: set[str] = set()
+        handlers: dict[str, tuple] = {}   # cmd name -> (qualname, fn)
+        module_fns: dict[str, tuple] = {}  # fn name -> (qualname, fn)
+        for qual, fn, _a, _c in ctx.functions:
+            if "." not in qual:
+                module_fns[qual] = (qual, fn)
+            for deco in getattr(fn, "decorator_list", ()):
+                got = self._deco_str_arg(deco, self.COALESCE_DECOS)
+                if got:
+                    coalesced.add(got)
+                got = self._deco_str_arg(deco, {"register"})
+                if got:
+                    handlers[got] = (qual, fn)
+        for cmd in sorted(coalesced):
+            ent = handlers.get(cmd)
+            if ent is None:
+                continue  # registered elsewhere; runtime assert covers it
+            yield from self._check_fn(ctx, cmd, *ent, module_fns, hops=2)
+
+    def _check_fn(self, ctx: FileContext, cmd: str, qual: str, fn: ast.AST,
+                  module_fns: dict, hops: int):
+        key_var = None
+        nodes = sorted(own_nodes(fn),
+                       key=lambda n: getattr(n, "lineno", 0))
+        for node in nodes:
+            if key_var is None and isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    dotted(node.value.func) == "args.next_bytes" and \
+                    node.targets and isinstance(node.targets[0], ast.Name):
+                key_var = node.targets[0].id
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in self.KEY_RESOLVERS and node.args:
+                a0 = node.args[0]
+                if key_var is None:
+                    yield self.finding(
+                        ctx, node, qual, cmd,
+                        f"coalesced command {cmd!r} resolves a key via "
+                        f".{f.attr}(...) before any args.next_bytes() "
+                        "binding — first-key confinement is not "
+                        "statically derivable")
+                elif not (isinstance(a0, ast.Name) and a0.id == key_var):
+                    yield self.finding(
+                        ctx, node, qual, cmd,
+                        f"coalesced command {cmd!r} resolves "
+                        f"{ast.unparse(a0)!r} "
+                        f"via .{f.attr}(...) but its first-argument key "
+                        f"binding is {key_var!r} — the shard router and "
+                        "barrier scoping both assume first-key "
+                        "confinement")
+        if key_var is not None or hops <= 0:
+            return
+        # no key binding in this body: follow one delegation hop — a
+        # call passing `args` through to a module-level helper
+        for node in nodes:
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in module_fns and \
+                    any(isinstance(a, ast.Name) and a.id == "args"
+                        for a in node.args):
+                dq, dfn = module_fns[node.func.id]
+                yield from self._check_fn(ctx, cmd, dq, dfn, module_fns,
+                                          hops - 1)
+                return
+        yield self.finding(
+            ctx, fn, qual, cmd,
+            f"coalesced command {cmd!r} has no args.next_bytes() key "
+            "binding and no args-delegating helper — first-key "
+            "confinement is not statically derivable")
+
+
 ALL_RULES: list[Rule] = [
     AsyncBlockRule(),
     StagePureRule(),
@@ -489,4 +604,5 @@ ALL_RULES: list[Rule] = [
     ShmLifecycleRule(),
     BareExceptRule(),
     ForkCaptureRule(),
+    KeyConfinedRule(),
 ]

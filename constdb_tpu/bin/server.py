@@ -63,39 +63,37 @@ def daemonize(cfg: Config) -> str:
 async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
     """Periodic background dump (fork-free; see persist/snapshot.py)."""
     from ..engine.base import batch_from_keyspace
-    from ..persist.snapshot import SnapshotWriter, batch_chunks
-    import io as _io
-    import os
+    from ..persist.snapshot import write_snapshot_file
 
     while True:
         await asyncio.sleep(cfg.snapshot_interval)
         node = app.node
-        node.ensure_flushed()  # device-resident merge state → host first
-        capture = batch_from_keyspace(node.ks)  # consistent: on the loop
-        meta = NodeMeta(node_id=node.node_id, alias=node.alias,
-                        addr=app.advertised_addr,
-                        repl_last_uuid=node.repl_log.last_uuid)
-        records = node.replicas.records()
-        path = cfg.snapshot_path
-
-        def write() -> None:
-            tmp = path + ".tmp.%d" % os.getpid()
-            with open(tmp, "wb") as f:
-                w = SnapshotWriter(
-                    f, compress_level=cfg.snapshot_compress_level)
-                w.write_node(meta)
-                w.write_replicas(records)
-                for chunk in batch_chunks(capture, cfg.snapshot_chunk_keys):
-                    w.write_chunk(chunk)
-                w.finish()
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-
+        # RuntimeError: a sharded node's dump awaits serve-pool worker
+        # exports, and a failed worker surfaces as one — it must not
+        # kill the cron (the node would silently never snapshot again)
         try:
-            await asyncio.to_thread(write)
-            log.info("background snapshot written to %s", path)
-        except OSError as e:
+            if node.serve_plane is not None:
+                # shard-per-core node: the workers hold the state —
+                # dump their consolidated exports (landed watermark: a
+                # dump may not claim coverage of minted-but-in-flight
+                # writes)
+                await _dump_plane_snapshot(app, cfg)
+            else:
+                node.ensure_flushed()  # device-resident merge → host
+                capture = batch_from_keyspace(node.ks)  # on the loop
+                meta = NodeMeta(node_id=node.node_id, alias=node.alias,
+                                addr=app.advertised_addr,
+                                repl_last_uuid=node.repl_log.last_uuid)
+                records = node.replicas.records()
+                await asyncio.to_thread(
+                    write_snapshot_file, cfg.snapshot_path, meta,
+                    records, [capture],
+                    chunk_keys=cfg.snapshot_chunk_keys,
+                    compress_level=cfg.snapshot_compress_level,
+                    fsync=True)
+            log.info("background snapshot written to %s",
+                     cfg.snapshot_path)
+        except (OSError, RuntimeError) as e:
             log.error("background snapshot failed: %s", e)
 
 
@@ -114,7 +112,8 @@ async def amain(cfg: Config) -> None:
         tcp_backlog=cfg.tcp_backlog,
         gc_peer_retention=float(cfg.gc_peer_retention),
         ingest_shards=cfg.ingest_shards,
-        ingest_shard_min_bytes=cfg.ingest_shard_min_bytes)
+        ingest_shard_min_bytes=cfg.ingest_shard_min_bytes,
+        serve_shards=cfg.serve_shards or None)
     log.info("constdb-tpu node %d (engine=%s) serving on %s",
              node.node_id, node.engine.name, app.advertised_addr)
 
@@ -130,16 +129,38 @@ async def amain(cfg: Config) -> None:
         t.cancel()
     if cfg.snapshot_path:
         # final synchronous dump so a clean restart resumes warm
-        node.ensure_flushed()  # device-resident merge state → host first
-        dump_keyspace(cfg.snapshot_path, node.ks,
-                      NodeMeta(node_id=node.node_id, alias=node.alias,
-                               addr=app.advertised_addr,
-                               repl_last_uuid=node.repl_log.last_uuid),
-                      node.replicas.records(),
-                      chunk_keys=cfg.snapshot_chunk_keys,
-                      compress_level=cfg.snapshot_compress_level)
+        if node.serve_plane is not None:
+            # shard-per-core node: consolidate the worker shards — the
+            # parent keyspace is empty by design (server/serve_shards.py)
+            await _dump_plane_snapshot(app, cfg)
+        else:
+            node.ensure_flushed()  # device-resident merge state → host
+            dump_keyspace(cfg.snapshot_path, node.ks,
+                          NodeMeta(node_id=node.node_id, alias=node.alias,
+                                   addr=app.advertised_addr,
+                                   repl_last_uuid=node.repl_log.last_uuid),
+                          node.replicas.records(),
+                          chunk_keys=cfg.snapshot_chunk_keys,
+                          compress_level=cfg.snapshot_compress_level)
         log.info("final snapshot written to %s", cfg.snapshot_path)
     await app.close()
+
+
+async def _dump_plane_snapshot(app: ServerApp, cfg: Config) -> None:
+    """Whole-state dump of a sharded serving node: worker exports,
+    landed watermark (the same rules as snapshot_cron / share.py)."""
+    from ..persist.snapshot import write_snapshot_file
+
+    node = app.node
+    repl_last = node.repl_log.landed_last_uuid
+    captures = await node.serve_plane.export_batches()
+    meta = NodeMeta(node_id=node.node_id, alias=node.alias,
+                    addr=app.advertised_addr, repl_last_uuid=repl_last)
+    await asyncio.to_thread(
+        write_snapshot_file, cfg.snapshot_path, meta,
+        node.replicas.records(), captures,
+        chunk_keys=cfg.snapshot_chunk_keys,
+        compress_level=cfg.snapshot_compress_level, fsync=True)
 
 
 def main(argv=None) -> None:

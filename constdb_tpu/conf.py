@@ -115,6 +115,9 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_SERVE_LAT_SAMPLE", "32",
            "sample every Nth coalesced client command into the INFO "
            "reply-latency ring (serve_lat_p50/p99_ms); 0 = off"),
+    EnvVar("CONSTDB_SERVE_SHARDS", "1",
+           "serve worker processes, each owning a keyspace shard + "
+           "engine + repl-log segment; 1 = the exact single-loop path"),
 )}
 
 
@@ -199,6 +202,11 @@ class Config:
     ingest_shard_min_bytes: int = 64 << 20  # snapshots below this take the
     #                         plain single-keyspace path (worker spawn
     #                         costs more than it saves on small syncs)
+    serve_shards: int = 0  # shard-per-core serving (server/serve_shards.py):
+    #                        N worker processes each owning a keyspace shard
+    #                        + engine + repl-log segment, the event loop
+    #                        routing by key hash.  0 = the CONSTDB_SERVE_SHARDS
+    #                        env default (1); 1 = the exact single-loop path.
     # a peer silent for longer than this stops pinning the GC tombstone
     # horizon.  0 (default) = never exclude — the reference's behavior,
     # where one dead peer pins tombstone collection mesh-wide forever

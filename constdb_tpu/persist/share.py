@@ -29,7 +29,7 @@ from dataclasses import dataclass
 from typing import Optional, TYPE_CHECKING
 
 from ..engine.base import batch_from_keyspace
-from .snapshot import NodeMeta, SnapshotWriter, batch_chunks
+from .snapshot import NodeMeta, write_snapshot_file
 
 if TYPE_CHECKING:
     from ..server.io import ServerApp
@@ -69,34 +69,34 @@ class SharedDump:
 
     async def _dump(self) -> Dump:
         app, node = self.app, self.app.node
-        node.ensure_flushed()  # device-resident merge state → host first
-        capture = batch_from_keyspace(node.ks)  # consistent: on the loop
-        repl_last = node.repl_log.last_uuid
+        plane = node.serve_plane
+        if plane is not None:
+            # shard-per-core node: the workers hold the state.  The
+            # LANDED watermark (fences included — after a reset the
+            # segments are empty but the fence is the resume floor) is
+            # captured BEFORE the exports; ops landing during the
+            # export are also in the merged repl_log above it, so the
+            # peer re-applies them over state that already includes
+            # them (idempotent merges, the redelivery class
+            # replica/coalesce.py documents).
+            repl_last = node.repl_log.landed_last_uuid
+            captures = await plane.export_batches()
+        else:
+            node.ensure_flushed()  # device-resident merge state → host
+            captures = [batch_from_keyspace(node.ks)]  # on the loop
+            repl_last = node.repl_log.last_uuid
         meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                         addr=app.advertised_addr, repl_last_uuid=repl_last)
         records = node.replicas.records()
         path = os.path.join(app.work_dir, f"fullsync.{node.node_id}.snapshot")
-        chunk_keys = app.snapshot_chunk_keys
-
-        level = getattr(app, "snapshot_compress_level", 1)
-
-        def write() -> int:
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                # the full-sync stream sends this very file, so the column
-                # compression rides the wire end-to-end (conf
-                # snapshot_compress_level; contrast reference
-                # src/conn/writer.rs:92-112, which streams raw)
-                w = SnapshotWriter(f, compress_level=level)
-                w.write_node(meta)
-                w.write_replicas(records)
-                for chunk in batch_chunks(capture, chunk_keys):
-                    w.write_chunk(chunk)
-                w.finish()
-            os.replace(tmp, path)
-            return os.path.getsize(path)
-
-        size = await asyncio.to_thread(write)
+        # the full-sync stream sends this very file, so the column
+        # compression rides the wire end-to-end (conf
+        # snapshot_compress_level; contrast reference
+        # src/conn/writer.rs:92-112, which streams raw)
+        size = await asyncio.to_thread(
+            write_snapshot_file, path, meta, records, captures,
+            chunk_keys=app.snapshot_chunk_keys,
+            compress_level=getattr(app, "snapshot_compress_level", 1))
         self.dumps_taken += 1
         dump = Dump(path, repl_last, size)
         self._current = dump

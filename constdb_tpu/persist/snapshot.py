@@ -589,6 +589,72 @@ def dump_keyspace(path: str, ks, meta: NodeMeta,
     return os.path.getsize(path)
 
 
+def write_snapshot_file(path: str, meta: NodeMeta,
+                        records: Iterable[ReplicaRecord],
+                        captures: Iterable[ColumnarBatch],
+                        chunk_keys: int = 1 << 16,
+                        compress_level: int = 1,
+                        fsync: bool = False) -> int:
+    """Atomic snapshot dump of pre-captured columnar state: the ONE
+    tmp-file + SnapshotWriter + replace recipe every dump site shares
+    (persist/share.py full-sync dumps, bin/server.py background and
+    shutdown dumps — including the sharded-node variants, whose
+    `captures` are the per-shard worker exports).  Blocking file IO:
+    call from a worker thread when on the event loop.  Returns the file
+    size."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            w = SnapshotWriter(f, compress_level=compress_level)
+            w.write_node(meta)
+            w.write_replicas(records)
+            for part in captures:
+                for chunk in batch_chunks(part, chunk_keys):
+                    w.write_chunk(chunk)
+            w.finish()
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return os.path.getsize(path)
+
+
+class SectionDemux:
+    """Split a snapshot stream into its three section kinds: `batches()`
+    yields the data sections in file order while the node meta and
+    replica records accumulate on the instance — the demux every
+    snapshot CONSUMER shares (plain/sharded/plane full-sync applies in
+    replica/link.py, the sharded boot restore in server/io.py).  Meta
+    and replica rows are only safely readable after the generator is
+    exhausted; deferring their adoption until then is load-bearing for
+    the apply sites (recorded pull watermarks are only backed by state
+    once every chunk has merged)."""
+
+    __slots__ = ("_f", "_raw", "meta", "replica_rows")
+
+    def __init__(self, f: IO[bytes], raw_batches: bool = False):
+        self._f = f
+        self._raw = raw_batches
+        self.meta: Optional[NodeMeta] = None
+        self.replica_rows: List[ReplicaRecord] = []
+
+    def batches(self) -> Iterator:
+        for kind, payload in SnapshotLoader(self._f,
+                                            raw_batches=self._raw):
+            if kind == "node":
+                self.meta = payload
+            elif kind == "replicas":
+                self.replica_rows.extend(payload)
+            else:
+                yield payload
+
+
 def load_snapshot(path: str, ks, engine=None
                   ) -> Tuple[NodeMeta, List[ReplicaRecord]]:
     """Stream a snapshot file into a keyspace through a MergeEngine

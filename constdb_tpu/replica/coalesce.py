@@ -285,6 +285,16 @@ class CoalescingApplier:
         """Frames received but not yet landed in the store."""
         return self._frames
 
+    # async twins of apply/flush: the pull loop awaits these so one code
+    # path drives both this applier and the shard-routing one (which
+    # genuinely awaits worker acks — server/serve_shards.py ShardApplier)
+
+    async def aapply(self, items: list) -> None:
+        self.apply(items)
+
+    async def aflush(self) -> None:
+        self.flush()
+
     # --------------------------------------------------------------- intake
 
     def apply(self, items: list) -> None:

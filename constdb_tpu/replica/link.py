@@ -40,7 +40,7 @@ import random
 from typing import Optional, TYPE_CHECKING
 
 from ..errors import CstError, ReplicateCommandsLost
-from ..persist.snapshot import SnapshotLoader, batch_chunks
+from ..persist.snapshot import SectionDemux, batch_chunks
 from ..resp.codec import RespParser, encode_msg, make_parser
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
 from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
@@ -417,17 +417,26 @@ class ReplicaLink:
         IDLE (no complete frame left in the parser) before blocking on
         the socket — a lone write lands with zero added latency, and
         batches only form when frames actually queue up."""
-        from .coalesce import CoalescingApplier
-        applier = CoalescingApplier(
-            self.node, self.meta,
-            max_frames=getattr(self.app, "apply_batch", None),
-            max_latency=getattr(self.app, "apply_latency", None),
-            now=asyncio.get_running_loop().time)
+        if self.node.serve_plane is not None:
+            # shard-per-core node: intake stays here, frames route to
+            # the worker owning their key (server/serve_shards.py)
+            applier = self.node.serve_plane.make_applier(
+                self.meta,
+                max_frames=getattr(self.app, "apply_batch", None),
+                max_latency=getattr(self.app, "apply_latency", None),
+                now=asyncio.get_running_loop().time)
+        else:
+            from .coalesce import CoalescingApplier
+            applier = CoalescingApplier(
+                self.node, self.meta,
+                max_frames=getattr(self.app, "apply_batch", None),
+                max_latency=getattr(self.app, "apply_latency", None),
+                now=asyncio.get_running_loop().time)
         while True:
             msg = parser.next_msg()
             if msg is None:
                 if applier.pending:
-                    applier.flush()  # stream idle: land now
+                    await applier.aflush()  # stream idle: land now
                 data = await reader.read(_READ_CHUNK)
                 if not data:
                     raise ConnectionError("EOF")
@@ -440,7 +449,7 @@ class ReplicaLink:
                 raise CstError(f"unexpected frame from {self.meta.addr}: {msg!r}")
             kind = as_bytes(items[0]).lower()
             if kind == REPLICATE:
-                applier.apply(items)
+                await applier.aapply(items)
             elif kind == REPLACK:
                 uuid = as_int(items[1])
                 if uuid > self.meta.uuid_i_acked:
@@ -457,8 +466,8 @@ class ReplicaLink:
                     # still pending (watermark-after-land).
                     applier.observe_beacon(as_int(items[3]))
             elif kind == FULLSYNC:
-                applier.flush()  # barrier: snapshot handling moves the
-                #                  watermark out-of-band
+                await applier.aflush()  # barrier: snapshot handling
+                #                         moves the watermark out-of-band
                 await self._receive_snapshot(
                     reader, parser, size=as_int(items[1]),
                     repl_last=as_int(items[2]),
@@ -509,12 +518,19 @@ class ReplicaLink:
             log.warning("peer %s demands a state-clearing resync (we were "
                         "excluded from its GC horizon past the repl_log "
                         "window); wiping local state", self.meta.addr)
-            node.reset_for_full_resync(keep_link=self)
+            if node.serve_plane is not None:
+                await node.serve_plane.reset_for_resync(keep_link=self)
+            else:
+                node.reset_for_full_resync(keep_link=self)
             # THIS stream stays valid: the snapshot below + the gap-free
             # frames that follow it re-establish our pull position
             self._epoch = node.reset_epoch
-        shards = self.app.snapshot_ingest_shards(size)
-        if shards > 1:
+        if node.serve_plane is not None:
+            # shard-per-core node: sections fan out to the serve workers
+            # by key hash (server/serve_shards.py) — they ARE the store
+            applied_rows, replica_rows = \
+                await self._apply_snapshot_via_plane(path)
+        elif (shards := self.app.snapshot_ingest_shards(size)) > 1:
             log.info("sharded snapshot ingest from %s: %d bytes over %d "
                      "shard workers", self.meta.addr, size, shards)
             applied_rows, replica_rows = \
@@ -615,36 +631,47 @@ class ReplicaLink:
         await apply_group()
         return applied_rows
 
+    async def _apply_snapshot_via_plane(self, path: str):
+        """Snapshot apply on a shard-per-core serving node: decoded
+        sections fan out to the serve workers by key hash
+        (ServeShardPlane.ingest_batches awaits per section, so the loop
+        stays live), node/replica sections are handled exactly like the
+        plain path."""
+        plane = self.node.serve_plane
+        f = await asyncio.get_running_loop().run_in_executor(
+            None, open, path, "rb")
+        demux = SectionDemux(f)
+        try:
+            applied_rows = await plane.ingest_batches(demux.batches())
+        finally:
+            f.close()
+        self._adopt_peer_id(demux)
+        return applied_rows, demux.replica_rows
+
+    def _adopt_peer_id(self, demux: SectionDemux) -> None:
+        """Backfill the peer's node id from its snapshot meta (a peer
+        met by address only identifies itself here)."""
+        if demux.meta is not None and demux.meta.node_id \
+                and not self.meta.node_id:
+            self.meta.node_id = demux.meta.node_id
+
     async def _apply_snapshot_plain(self, path: str):
-        """Single-keyspace snapshot apply (the default path)."""
-        replica_rows: list = []
+        """Single-keyspace snapshot apply (the default path).  Replica
+        records are held until the WHOLE snapshot is applied —
+        merge_records adopts the recorded pull watermarks, which are
+        only backed by state once every chunk has merged (SectionDemux
+        defers them until its generator is exhausted)."""
         # spill-file open off-loop (ASYNC-BLOCK); section reads stay
         # inline — they are small page-cache slices between awaits
         f = await asyncio.get_running_loop().run_in_executor(
             None, open, path, "rb")
-
-        def batch_sections():
-            for kind, payload in SnapshotLoader(f):
-                if kind == "node":
-                    if payload.node_id and not self.meta.node_id:
-                        self.meta.node_id = payload.node_id
-                elif kind == "replicas":
-                    # held until the WHOLE snapshot is applied:
-                    # merge_records adopts the recorded pull
-                    # watermarks, which are only backed by state once
-                    # every chunk has merged — adopting mid-stream
-                    # would let a crash or a corrupt-chunk abort leave
-                    # watermarks pointing past ops the local keyspace
-                    # never received
-                    replica_rows.extend(payload)
-                else:
-                    yield payload
-
+        demux = SectionDemux(f)
         try:
-            applied_rows = await self._apply_batches(batch_sections())
+            applied_rows = await self._apply_batches(demux.batches())
         finally:
             f.close()
-        return applied_rows, replica_rows
+        self._adopt_peer_id(demux)
+        return applied_rows, demux.replica_rows
 
     async def _apply_snapshot_sharded(self, path: str, shards: int):
         """Process-parallel snapshot apply (store/sharded_keyspace.py):
@@ -672,21 +699,18 @@ class ReplicaLink:
             # spill-file open off-loop, like every other blocking step of
             # this path (submit/flush/export below)
             f = await loop.run_in_executor(None, open, path, "rb")
+            demux = SectionDemux(f, raw_batches=True)
             try:
-                for kind, payload in SnapshotLoader(f, raw_batches=True):
-                    if kind == "node":
-                        if payload.node_id and not self.meta.node_id:
-                            self.meta.node_id = payload.node_id
-                    elif kind == "replicas":
-                        replica_rows.extend(payload)
-                    else:
-                        # submit can block on the pool's bounded in-flight
-                        # window — run it off-loop so pulls/acks keep
-                        # flowing while completions land
-                        await loop.run_in_executor(None, sks.submit_raw,
-                                                   payload)
+                for payload in demux.batches():
+                    # submit can block on the pool's bounded in-flight
+                    # window — run it off-loop so pulls/acks keep
+                    # flowing while completions land
+                    await loop.run_in_executor(None, sks.submit_raw,
+                                               payload)
             finally:
                 f.close()
+            self._adopt_peer_id(demux)
+            replica_rows = demux.replica_rows
             await loop.run_in_executor(None, sks.flush)
             # consolidation rides the SAME adaptive grouped-apply cadence
             # as the plain path — a whole-shard export through a slow

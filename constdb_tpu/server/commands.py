@@ -141,8 +141,15 @@ class ExecCtx:
         self.client = client
 
 
-def execute(node: "Node", req, client=None) -> Msg:
-    """Client-path dispatch (reference Cmd::exec, src/cmd.rs:43-53)."""
+def execute(node: "Node", req, client=None, uuid=None) -> Msg:
+    """Client-path dispatch (reference Cmd::exec, src/cmd.rs:43-53).
+
+    `uuid`: a pre-minted HLC uuid for this command (shard-per-core
+    serving, server/serve_shards.py — the PARENT process is the clock
+    authority and mints at route time with the same tick(is_write)
+    discipline this function applies, so the uuid a worker receives is
+    exactly the one a single-loop node would have minted here).  None =
+    mint locally (the default, and the only path on shards=1)."""
     items = req.items if isinstance(req, Arr) else list(req)
     if not items:
         return Err(b"empty command")
@@ -165,7 +172,8 @@ def execute(node: "Node", req, client=None) -> Msg:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
     node.ensure_flushed()  # device-resident merge results become readable
-    uuid = node.hlc.tick(cmd.is_write)
+    if uuid is None:
+        uuid = node.hlc.tick(cmd.is_write)
     ctx = ExecCtx(uuid, node.node_id, False, client)
     args = ArgIter(items[1:], name)
     try:
@@ -1099,6 +1107,29 @@ def _enc_delcnt(bb, recs) -> None:
 # ====================================================================
 
 SERVE_PLANNERS: dict[bytes, Callable] = {}
+
+# --------------------------------------------------------------------
+# shard routing classification (server/serve_shards.py).  Every DATA
+# command's keyspace effects are confined to the key in its FIRST
+# argument — the convention PR 5's barrier scoping already relies on
+# and the KEY-CONFINED lint rule (constdb_tpu/analysis/rules.py) pins
+# statically for the planner/encoder families.  Commands that touch
+# GLOBAL state instead (membership, admin/CTRL, observability) execute
+# on the parent's ordered barrier plane.  `PLANE_COMMANDS` lists the
+# keyless non-CTRL commands structurally indistinguishable from data
+# commands (their `families` default to ALL); `shard_routable` is the
+# one classifier both the client router and the replication-apply
+# router consult.
+# --------------------------------------------------------------------
+
+PLANE_COMMANDS = frozenset((b"info", b"replicas", b"meet", b"forget"))
+
+
+def shard_routable(cmd: Command) -> bool:
+    """True iff this command executes inside the shard worker owning
+    its first-argument key; False = ordered barrier plane (parent)."""
+    return not (cmd.flags & CMD_CTRL) and bool(cmd.families) \
+        and cmd.name not in PLANE_COMMANDS
 
 # Flush-time group encoders for the serve path: `fn(bb, recs, nodeid)`
 # over the compact per-command records the planners buffered.  Unlike

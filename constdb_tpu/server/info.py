@@ -173,6 +173,20 @@ def _section_replication(node, out):
 
 
 def _section_keyspace(node, out):
+    plane = getattr(node, "serve_plane", None)
+    if plane is not None:
+        # shard-per-core node: the serve workers hold the keyspace; the
+        # per-shard gauges come from the latest worker acks (slightly
+        # stale by at most one in-flight chunk), so imbalance across the
+        # shard map is observable without a worker round-trip
+        x = node.stats.extra
+        per = [x.get(f"serve_shard{i}_keys", 0)
+               for i in range(plane.n_shards)]
+        out.append(("keys", sum(per)))
+        out.append(("serve_shards", plane.n_shards))
+        for i, n in enumerate(per):
+            out.append((f"shard{i}_keys", n))
+        return
     ks = node.ks
     n = ks.keys.n
     out.append(("keys", n))

@@ -50,7 +50,8 @@ class ServerApp:
                  ingest_shard_min_bytes: int = 64 << 20,
                  apply_batch: Optional[int] = None,
                  apply_latency: Optional[float] = None,
-                 serve_batch: Optional[int] = None):
+                 serve_batch: Optional[int] = None,
+                 serve_shards: Optional[int] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -99,6 +100,21 @@ class ServerApp:
         from ..conf import env_int
         self.serve_batch = env_int("CONSTDB_SERVE_BATCH", 512) \
             if serve_batch is None else serve_batch
+        # shard-per-core serving (server/serve_shards.py): N worker
+        # processes each owning a keyspace shard + engine + repl-log
+        # segment, with this loop as the router/clock authority.  1 (the
+        # default) never constructs the plane — the exact single-loop
+        # path, byte for byte.
+        self.serve_shards = env_int("CONSTDB_SERVE_SHARDS", 1) \
+            if serve_shards is None else serve_shards
+        self.serve_plane = None
+        # awaited by start() AFTER the serve plane is up but BEFORE the
+        # listener opens — the sharded boot restore (start_node) runs
+        # here so a reconnecting peer can never observe the un-fenced
+        # merged repl_log (can_resume_from(cursor) on empty segments
+        # would grant a PARTSYNC that silently omits every restored
+        # key), and early clients never read half-restored shards
+        self._boot_restore = None
         # peers silent beyond this stop pinning the GC horizon
         self.gc_peer_retention = gc_peer_retention
         node.replicas.gc_peer_retention_ms = int(gc_peer_retention * 1000)
@@ -136,10 +152,25 @@ class ServerApp:
             self.node.node_id = _random.SystemRandom().randrange(1, 1 << 31)
             log.info("auto-assigned node_id %d", self.node.node_id)
         self.node.stats.start_time = time.time()
+        if self.serve_shards > 1:
+            # spawn the shard workers BEFORE the listener opens (they
+            # need the final node_id — workers stamp it into writes)
+            from ..conf import env_str
+            from .serve_shards import ServeShardPlane
+            spec = env_str("CONSTDB_SHARD_ENGINE") or "cpu"
+            self.serve_plane = ServeShardPlane(self, self.serve_shards,
+                                               engine_spec=spec)
+            await self.serve_plane.start()
+        # bind (resolving an ephemeral port — advertised_addr is live
+        # from here) but do NOT accept yet: the boot restore below must
+        # land its watermark fences first
         self._server = await asyncio.start_server(
             self._on_connection, self.host, self.port,
-            backlog=self.tcp_backlog)
+            backlog=self.tcp_backlog, start_serving=False)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self._boot_restore is not None:
+            await self._boot_restore()
+        await self._server.start_serving()
         self._cron_task = asyncio.create_task(self._cron())
         # reconnect links for membership restored from a snapshot
         for m in self.node.replicas.live_peers():
@@ -176,6 +207,8 @@ class ServerApp:
         for m in list(self.node.replicas.peers.values()):
             if isinstance(m.link, ReplicaLink):
                 await m.link.stop()
+        if self.serve_plane is not None:
+            await self.serve_plane.close()
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -202,7 +235,10 @@ class ServerApp:
                 due = now - last_gc >= self.gc_interval
                 early = woke and now - last_gc >= self.gc_interval / 4
                 if due or early:
-                    self.node.gc()
+                    if self.serve_plane is not None:
+                        await self.serve_plane.gc(self.node.gc_horizon())
+                    else:
+                        self.node.gc()
                     last_gc = now
         finally:
             consumer.close()
@@ -236,11 +272,14 @@ class ServerApp:
         parser = make_parser()
         out = bytearray()
         upgraded = False
+        plane = self.serve_plane
         coal = None
-        if self.serve_batch > 1:
+        if plane is None and self.serve_batch > 1:
             # pipelined chunks are PLANNED instead of executed
             # per message (server/serve.py); serve_batch <= 1
-            # (CONSTDB_SERVE_BATCH=1) keeps the exact per-command loop
+            # (CONSTDB_SERVE_BATCH=1) keeps the exact per-command loop.
+            # With a serve PLANE active the chunk is ROUTED instead
+            # (server/serve_shards.py) — the workers own the coalescers.
             from .serve import ServeCoalescer
             coal = ServeCoalescer(self.node, max_run=self.serve_batch)
         try:
@@ -250,7 +289,7 @@ class ServerApp:
                     break
                 self.node.stats.net_in_bytes += len(data)
                 parser.feed(data)
-                if coal is None:
+                if coal is None and plane is None:
                     while (msg := parser.next_msg()) is not None:
                         if self._is_sync(msg):
                             # replies for commands pipelined BEFORE the
@@ -273,7 +312,8 @@ class ServerApp:
                             # before the link adopts the parser
                             parser.pushback(msgs[i + 1:])
                             if i:
-                                coal.run_chunk(msgs[:i], out)
+                                await self._run_chunk(plane, coal,
+                                                      msgs[:i], out)
                             out = self._flush_out(writer, out)
                             self._upgrade_to_replica(msg, reader, writer,
                                                      parser)
@@ -281,7 +321,7 @@ class ServerApp:
                             break
                     else:
                         if msgs:
-                            coal.run_chunk(msgs, out)
+                            await self._run_chunk(plane, coal, msgs, out)
                 if upgraded:
                     return  # connection now owned by the replica link
                 if out:
@@ -308,8 +348,8 @@ class ServerApp:
                     parser.pushback(salvaged[sync_at + 1:])
                     salvaged = head
                 if salvaged:
-                    if coal is not None:
-                        coal.run_chunk(salvaged, out)
+                    if coal is not None or plane is not None:
+                        await self._run_chunk(plane, coal, salvaged, out)
                     else:
                         for msg in salvaged:
                             reply = self.node.execute(msg)
@@ -331,6 +371,16 @@ class ServerApp:
             # an upgraded connection is owned by its replica link now
             if not upgraded and not writer.is_closing():
                 writer.close()
+
+    async def _run_chunk(self, plane, coal, msgs: list,
+                         out: bytearray) -> None:
+        """One drained pipelined chunk, through whichever machinery this
+        node runs: the shard-routing plane (serve_shards > 1) or the
+        in-loop coalescer (serve_batch > 1)."""
+        if plane is not None:
+            await plane.run_chunk(msgs, out)
+        else:
+            coal.run_chunk(msgs, out)
 
     def _flush_out(self, writer, out: bytearray) -> bytearray:
         """Queue accumulated replies on the transport and return a fresh
@@ -420,6 +470,53 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
     """Convenience: build + start a ServerApp (optionally restoring the
     boot snapshot — a capability the reference lacks, SURVEY.md §5.4)."""
     app = ServerApp(node, **kwargs)
+    if app.serve_shards > 1:
+        # shard-per-core node: workers ARE the store, so the boot
+        # snapshot fans out to them — which requires the plane up first
+        # (start()).  The snapshot's node identity is pre-scanned so the
+        # workers spawn with the RESTORED node_id; the data ingest +
+        # watermark fences run as start()'s boot-restore hook, after the
+        # plane is up but BEFORE the listener opens — the same
+        # fence-before-serving order the plain path below enforces, for
+        # the same reason (see its comment: an un-fenced log grants
+        # divergent PARTSYNCs).
+        from ..persist.snapshot import SectionDemux, SnapshotLoader
+        loop = asyncio.get_event_loop()
+        restore = app.snapshot_path and os.path.exists(app.snapshot_path)
+        if restore:
+            if not node.node_id:
+                f = await loop.run_in_executor(None, open,
+                                               app.snapshot_path, "rb")
+                try:
+                    for kind, payload in SnapshotLoader(f):
+                        if kind == "node":
+                            if payload.node_id:
+                                node.node_id = payload.node_id
+                            break
+                finally:
+                    f.close()
+
+            async def restore_into_plane() -> None:
+                f = await loop.run_in_executor(None, open,
+                                               app.snapshot_path, "rb")
+                demux = SectionDemux(f)
+                try:
+                    await app.serve_plane.ingest_batches(demux.batches())
+                finally:
+                    f.close()
+                if demux.meta is not None:
+                    node.hlc.observe(demux.meta.repl_last_uuid)
+                    node.repl_log.last_uuid = demux.meta.repl_last_uuid
+                    node.repl_log.evicted_up_to = demux.meta.repl_last_uuid
+                    node.replicas.merge_records(
+                        demux.replica_rows, my_addr=app.advertised_addr,
+                        adopt_watermarks=True)
+                    log.info("restored snapshot %s into %d serve shards",
+                             app.snapshot_path, app.serve_shards)
+
+            app._boot_restore = restore_into_plane
+        await app.start()
+        return app
     if app.snapshot_path and os.path.exists(app.snapshot_path):
         from ..persist.snapshot import load_snapshot
         meta, records = load_snapshot(app.snapshot_path, node.ks,

@@ -82,6 +82,12 @@ class Node:
         self.reset_epoch = 0
         # the ServerApp driving this node's IO, when one exists
         self.app = None
+        # the shard-per-core serving plane (server/serve_shards.py) when
+        # CONSTDB_SERVE_SHARDS > 1; None = the exact single-loop path.
+        # With a plane active this node's ks/engine hold NO data — every
+        # data command executes inside the shard worker owning its key,
+        # and self.repl_log is the plane's MergedReplLog view.
+        self.serve_plane = None
 
     def _make_keyspace(self) -> KeySpace:
         """Fresh keyspace with the node's event wiring (shared by boot and
@@ -93,10 +99,12 @@ class Node:
 
     # ------------------------------------------------------------ execution
 
-    def execute(self, req, client=None):
-        """One client command, fully (parse → run → replicate)."""
+    def execute(self, req, client=None, uuid=None):
+        """One client command, fully (parse → run → replicate).  `uuid`:
+        a pre-minted HLC uuid (shard-per-core serving — the routing
+        parent is the clock authority; see commands.execute)."""
         from .commands import execute
-        return execute(self, req, client)
+        return execute(self, req, client, uuid=uuid)
 
     def apply_replicated(self, name: bytes, args: list, origin_nodeid: int,
                          uuid: int):
@@ -251,6 +259,13 @@ class Node:
         # server/io.py start_node).
         self.repl_log.last_uuid = fence
         self.repl_log.evicted_up_to = fence
+        self._kick_peers_after_wipe(keep_link)
+
+    def _kick_peers_after_wipe(self, keep_link=None) -> None:
+        """Post-wipe peer bookkeeping shared by the single-loop reset
+        above and the serve plane's reset (server/serve_shards.py):
+        epoch bump (stale-beacon fence), watermark zeroing, and a kick
+        for every other live connection."""
         self.reset_epoch += 1
         if self.replicas is not None:
             for m in self.replicas.peers.values():

@@ -135,3 +135,132 @@ class ReplLog:
         """The stored command as a RESP array (REPLLOG AT reply)."""
         from ..resp.message import Bulk
         return Arr([Bulk(e.name), *e.args])
+
+
+class MergedReplLog:
+    """One HLC-ordered view over per-shard repl-log SEGMENTS (the
+    shard-per-core serving plane, server/serve_shards.py).
+
+    Each serve worker owns a keyspace shard; its locally-executed writes
+    append to that shard's segment (mirrored parent-side in ack order,
+    so every segment's uuids are strictly increasing).  Uuids are minted
+    centrally by the parent HLC at ROUTE time, so the sorted union of
+    the segments is exactly the uuid sequence a single-loop node would
+    have produced — the push loop merge-sorts the segments back into
+    one stream and the replication protocol (watermarks, REPLACK
+    beacons, partial-resync decisions) is unchanged on the wire.
+
+    Emission gating: an entry is VISIBLE only below the floor — the
+    smallest write uuid minted but not yet landed (acked) by its shard
+    worker.  A later ack can never introduce an entry below the floor
+    (workers land their routed commands in mint order), so the merged
+    stream is strictly increasing by construction; `pending_high` keeps
+    `last_uuid` covering in-flight writes so the push loop never
+    declares the stream drained (and never sends a REPLACK beacon the
+    peer could fast-forward over un-landed ops).
+
+    The parent's own barrier-plane writes (MEET/FORGET and any other
+    loop-executed command) land synchronously in `self.local` — segment
+    index n_shards — through the normal `push` entry point."""
+
+    def __init__(self, n_shards: int, cap_bytes: int = ReplLog.DEFAULT_CAP):
+        self.cap = cap_bytes
+        self.segments = [ReplLog(cap_bytes) for _ in range(n_shards + 1)]
+        self.local = self.segments[n_shards]
+        # plane callbacks, installed by ServeShardPlane: floor() -> the
+        # smallest minted-but-unlanded write uuid (None = nothing in
+        # flight); pending_high() -> the NEWEST such uuid (0 = none)
+        self.floor = lambda: None
+        self.pending_high = lambda: 0
+        # watermark fences (boot-restore / reset_for_full_resync set
+        # these through the same attribute names ReplLog exposes)
+        self._fence_last = 0
+        self._fence_evicted = 0
+
+    # ----------------------------------------------------- ReplLog surface
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.segments)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(s.total_bytes for s in self.segments)
+
+    @property
+    def first_uuid(self) -> int:
+        firsts = [s.first_uuid for s in self.segments if len(s)]
+        return min(firsts) if firsts else 0
+
+    @property
+    def landed_last_uuid(self) -> int:
+        """Newest uuid actually LANDED in a segment (or fenced): what a
+        full-sync dump may record as its watermark — unlike `last_uuid`
+        it excludes minted-but-in-flight writes, whose effects are not
+        yet in any exportable state."""
+        return max(max(s.last_uuid for s in self.segments),
+                   self._fence_last)
+
+    @property
+    def last_uuid(self) -> int:
+        """Newest uuid this node has COMMITTED to its stream: landed
+        entries, fences, and minted-but-in-flight writes (the push loop
+        must not consider the stream drained below those)."""
+        return max(self.landed_last_uuid, self.pending_high())
+
+    @last_uuid.setter
+    def last_uuid(self, uuid: int) -> None:
+        self._fence_last = uuid
+
+    @property
+    def evicted_up_to(self) -> int:
+        """A resume below ANY segment's eviction horizon is gappy in the
+        merged stream, so the merged horizon is the max."""
+        return max(max(s.evicted_up_to for s in self.segments),
+                   self._fence_evicted)
+
+    @evicted_up_to.setter
+    def evicted_up_to(self, uuid: int) -> None:
+        self._fence_evicted = uuid
+
+    def push(self, uuid: int, name: bytes, args: list) -> None:
+        """Barrier-plane write (executed on the parent loop)."""
+        self.local.push(uuid, name, args)
+
+    def can_resume_from(self, uuid: int) -> bool:
+        return uuid >= self.evicted_up_to
+
+    def _visible(self, uuid: int) -> bool:
+        f = self.floor()
+        return f is None or uuid < f
+
+    def next_after(self, uuid: int) -> Optional[ReplEntry]:
+        """Merge-sort step: the smallest VISIBLE uuid > `uuid` across
+        all segments.  `prev_uuid` stays the per-segment chain — in the
+        merged stream a segment's prev is always <= the merged cursor
+        (it was emitted earlier), so the peer's gap check only fires on
+        true eviction gaps, exactly as on a single-segment stream."""
+        best: Optional[ReplEntry] = None
+        for s in self.segments:
+            e = s.next_after(uuid)
+            if e is not None and (best is None or e.uuid < best.uuid):
+                best = e
+        if best is not None and not self._visible(best.uuid):
+            return None
+        return best
+
+    def at(self, uuid: int) -> Optional[ReplEntry]:
+        for s in self.segments:
+            e = s.at(uuid)
+            if e is not None:
+                return e
+        return None
+
+    def uuids(self) -> list[int]:
+        out: list[int] = []
+        for s in self.segments:
+            out.extend(s.uuids())
+        out.sort()
+        return out
+
+    def entry_as_msg(self, e: ReplEntry) -> Msg:
+        return Arr([Bulk(e.name), *e.args])

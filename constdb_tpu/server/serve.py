@@ -102,7 +102,8 @@ class ServeCoalescer:
 
     __slots__ = ("node", "max_run", "nodeid", "ks", "regs", "cnts", "els",
                  "_keys", "_pending_keys", "_buf", "_log", "_pending",
-                 "_planned", "_lat_pending", "_sample_every", "_now")
+                 "_planned", "_lat_pending", "_sample_every", "_now",
+                 "_cur_uuid")
 
     def __init__(self, node, max_run: int = 512,
                  sample_every: int | None = None,
@@ -131,19 +132,38 @@ class ServeCoalescer:
         self._sample_every = env_int("CONSTDB_SERVE_LAT_SAMPLE", 32) \
             if sample_every is None else sample_every
         self._now = now
+        # pre-minted HLC uuid for the command currently being planned
+        # (shard-per-core serving: the parent process is the clock
+        # authority and mints at route time — see run_chunk `uuids`).
+        # None = mint locally via node.hlc (the shards=1 path).
+        self._cur_uuid = None
 
     # -------------------------------------------------------------- chunk
 
-    def run_chunk(self, msgs: list, out: bytearray) -> None:
+    def run_chunk(self, msgs: list, out: bytearray, uuids: list = None,
+                  spans: list = None) -> None:
         """Plan and execute one drained chunk of client messages,
         appending every reply to `out` in request order.  The pending
-        run always lands before this returns."""
+        run always lands before this returns.
+
+        `uuids`: pre-minted HLC uuids, one per message, assigned by the
+        shard-routing parent (server/serve_shards.py) with the exact
+        tick(is_write) discipline the local paths apply — planners and
+        demoted per-command executions consume the message's assigned
+        uuid instead of ticking.  `spans`: when given, receives
+        `len(out)` after each message — the parent slices per-command
+        replies out for in-order reassembly across shards."""
         self._reset_caches()
         if len(msgs) == 1:
             # lone command: the exact per-command path, zero overhead
             # (no invalidation needed — the next chunk resets anyway)
+            if uuids is not None:
+                self._cur_uuid = uuids[0]
             self._exec(msgs[0], out, count_barrier=False,
                        invalidate=False)
+            self._cur_uuid = None
+            if spans is not None:
+                spans.append(len(out))
             return
         plan = [self._planner_of(m) for m in msgs]
         n = len(msgs)
@@ -152,6 +172,8 @@ class ServeCoalescer:
             self._preprobe(msgs, plan)
         max_run = self.max_run
         for i, msg in enumerate(msgs):
+            if uuids is not None:
+                self._cur_uuid = uuids[i]
             fn = plan[i]
             isolated = False
             # a plannable command opens a run only when it has company
@@ -164,6 +186,8 @@ class ServeCoalescer:
                     reply = fn(self, msg.items)
                     if reply is not None:
                         encode_into(out, reply)
+                        if spans is not None:
+                            spans.append(len(out))
                         if self._pending >= max_run:
                             self.flush()
                         continue
@@ -173,6 +197,9 @@ class ServeCoalescer:
             if self._pending and not self._scoped_read_commutes(msg):
                 self.flush()
             self._exec(msg, out, count_barrier=not isolated)
+            if spans is not None:
+                spans.append(len(out))
+        self._cur_uuid = None
         if self._pending:
             self.flush()
 
@@ -340,7 +367,7 @@ class ServeCoalescer:
         write executed per-command by CHOICE is not a barrier, but its
         mutation still invalidates its key's cached probes."""
         node = self.node
-        reply = node.execute(msg)
+        reply = node.execute(msg, uuid=self._cur_uuid)
         if not isinstance(reply, NoReply):
             encode_into(out, reply)
         if count_barrier:
@@ -390,6 +417,8 @@ class ServeCoalescer:
     # ------------------------------------------------------ planner surface
 
     def tick(self) -> int:
+        if self._cur_uuid is not None:
+            return self._cur_uuid
         return self.node.hlc.tick(True)
 
     def resolve_key(self, key: bytes, enc: int):

@@ -1,0 +1,504 @@
+"""Shard-per-core serving plane: route the client path across host cores.
+
+PR 2 built the hash-sharded keyspace and a forkserver worker pool as a
+snapshot-ingest accelerator; this module turns that machinery into the
+SERVING architecture.  With `CONSTDB_SERVE_SHARDS=N` (N > 1) a node runs
+N serve workers (parallel/serve_pool.py), each owning one keyspace shard
++ merge engine + repl-log segment, and the event loop becomes a ROUTER:
+
+  * **key-hash routing** — every first-key-confined command (all data
+    commands; the KEY-CONFINED lint rule pins the convention) executes
+    entirely inside the worker owning `crc32(key) % N`, through the same
+    ServeCoalescer machinery PR 5 built.  Pipelined chunks ship as one
+    sub-chunk per shard, so the per-command pipe cost amortizes exactly
+    like the per-command merge cost did.
+  * **central clock** — the parent mints EVERY uuid at route time with
+    the same `tick(is_write)` discipline `commands.execute` applies, in
+    request order.  The uuid stream is therefore byte-identical to the
+    single-loop path's, which is what makes the multi-shard differential
+    suite able to demand byte-identical replies, exports, and merged
+    repl logs (tests/test_serve_shards.py).
+  * **ordered barrier plane** — cross-shard commands (admin/CTRL,
+    membership, INFO, SYNC upgrades) quiesce the chunk's outstanding
+    sub-chunks, then execute on the parent loop, exactly mirroring the
+    intra-connection barrier semantics PR 5 pinned.
+  * **merge-sorted peer stream** — each worker's locally-executed writes
+    mirror into that shard's parent-side repl-log segment as acks land;
+    `MergedReplLog` (server/repl_log.py) merge-sorts the segments back
+    into one HLC-ordered stream, gated below the FLOOR (the smallest
+    minted-but-unlanded write uuid) so emission order is strictly
+    increasing.  Watermarks, REPLACK beacons, and the partial-resync
+    decision are unchanged on the wire — an unmodified peer replicates
+    from a sharded node without knowing it is sharded.
+
+`CONSTDB_SERVE_SHARDS=1` (the default) never constructs this plane —
+the node runs the exact PR 5 single-loop path, byte for byte.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ..errors import ReplicateCommandsLost
+from ..resp.codec import encode_into
+from ..resp.message import Arr, Bulk, NoReply, as_bytes, as_int
+from ..store.sharded_keyspace import MAX_SHARDS, shard_of
+from .commands import (CMD_CTRL, CMD_REPL_ONLY, COMMANDS,
+                       STATE_FREE_BARRIERS, shard_routable)
+from .events import EVENT_DELETED, EVENT_REPLICATED
+from .repl_log import MergedReplLog
+
+log = logging.getLogger(__name__)
+
+_STAT_GAUGES = (("msgs", "msgs"), ("flushes", "flushes"),
+                ("barriers", "barriers"), ("keys", "keys"))
+
+
+class _Sub:
+    """One shard's slice of the pre-barrier run being classified."""
+
+    __slots__ = ("msgs", "uuids", "idxs", "token")
+
+    def __init__(self) -> None:
+        self.msgs: list = []
+        self.uuids: list = []
+        self.idxs: list = []
+        self.token: Optional[int] = None
+
+
+class ServeShardPlane:
+    """Parent-side router + authority for a shard-per-core serving node
+    (see module docstring)."""
+
+    def __init__(self, app, n_shards: int, engine_spec: str = "cpu"):
+        if not 2 <= n_shards <= MAX_SHARDS:
+            raise ValueError(f"serve_shards must be in [2, {MAX_SHARDS}]")
+        self.app = app
+        self.node = app.node
+        self.n_shards = n_shards
+        self.engine_spec = engine_spec
+        self.pool = None
+        self.merged = MergedReplLog(n_shards,
+                                    cap_bytes=self.node.repl_log.cap)
+        self.merged.floor = self._floor
+        self.merged.pending_high = self._pending_high
+        # minted-but-unlanded write uuid windows: token -> [wmin, wmax].
+        # Opened at MINT time (before any await can let the push loop
+        # emit a newer entry), closed by the serve-ack callback AFTER
+        # the worker's log entries mirrored into the segment.
+        self._inflight: dict[int, list] = {}
+        self._next_token = 0
+        self._last_stats = [dict() for _ in range(n_shards)]
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        from ..parallel.serve_pool import ServeShardPool
+        node = self.node
+        self.pool = ServeShardPool(self.n_shards,
+                                   engine_spec=self.engine_spec,
+                                   node_id=node.node_id, alias=node.alias,
+                                   serve_batch=self.app.serve_batch)
+        node.serve_plane = self
+        node.repl_log = self.merged
+        x = node.stats.extra
+        x["serve_shards"] = self.n_shards
+        x["serve_shard_map"] = f"crc32(key)%{self.n_shards}"
+        x.setdefault("serve_xshard_barriers", 0)
+        log.info("serve plane up: %d shard workers (engine=%s)",
+                 self.n_shards, self.engine_spec)
+
+    async def close(self) -> None:
+        if self.pool is not None:
+            await self.pool.close()
+
+    # ------------------------------------------------------- floor windows
+
+    def _floor(self) -> Optional[int]:
+        if not self._inflight:
+            return None
+        return min(w[0] for w in self._inflight.values())
+
+    def _pending_high(self) -> int:
+        if not self._inflight:
+            return 0
+        return max(w[1] for w in self._inflight.values())
+
+    def _open_window(self, uuid: int) -> int:
+        tok = self._next_token
+        self._next_token += 1
+        self._inflight[tok] = [uuid, uuid]
+        return tok
+
+    # ------------------------------------------------------------- routing
+
+    async def run_chunk(self, msgs: list, out: bytearray) -> None:
+        """Plan, route, and execute one drained chunk of client
+        messages, appending every reply to `out` in request order."""
+        node = self.node
+        n = len(msgs)
+        if not n:
+            return
+        replies: list = [b""] * n
+        subs: dict[int, _Sub] = {}
+        futs: list = []       # (future, idxs) of dispatched sub-chunks
+        opened: set = set()   # window tokens opened by this chunk
+        dispatched: set = set()
+        lone = n == 1
+
+        def dispatch() -> None:
+            # synchronous by design: no suspension point may separate
+            # uuid minting from the pipe write (parallel/serve_pool.py)
+            for shard, sub in subs.items():
+                payload = bytearray()
+                for m in sub.msgs:
+                    encode_into(payload, m)
+                fut = self.pool.submit(
+                    shard, ("serve", bytes(payload), sub.uuids,
+                            len(sub.msgs)))
+                if sub.token is not None:
+                    dispatched.add(sub.token)
+                fut.add_done_callback(
+                    lambda f, s=shard, t=sub.token:
+                        self._on_serve_ack(s, t, f))
+                futs.append((fut, sub.idxs))
+            subs.clear()
+
+        async def quiesce() -> None:
+            dispatch()
+            for fut, idxs in futs:
+                res = await fut
+                sout, spans = res[0], res[1]
+                prev = 0
+                for j, idx in enumerate(idxs):
+                    replies[idx] = sout[prev:spans[j]]
+                    prev = spans[j]
+            futs.clear()
+
+        try:
+            for i, msg in enumerate(msgs):
+                routed = False
+                items = msg.items if type(msg) is Arr else None
+                cmd = None
+                if items:
+                    head = items[0]
+                    name = head.val if type(head) is Bulk else None
+                    if name is not None:
+                        cmd = COMMANDS.get(name) or COMMANDS.get(name.lower())
+                if cmd is not None and shard_routable(cmd) and \
+                        not (cmd.flags & CMD_REPL_ONLY) and len(items) > 1:
+                    try:
+                        key = as_bytes(items[1])
+                    except Exception:
+                        key = None  # execute() raises the exact op error
+                    if key is not None:
+                        shard = shard_of(key, self.n_shards)
+                        uuid = node.hlc.tick(cmd.is_write)
+                        sub = subs.get(shard)
+                        if sub is None:
+                            sub = subs[shard] = _Sub()
+                        if cmd.is_write:
+                            if sub.token is None:
+                                sub.token = self._open_window(uuid)
+                                opened.add(sub.token)
+                            else:
+                                self._inflight[sub.token][1] = uuid
+                        sub.msgs.append(msg)
+                        sub.uuids.append(uuid)
+                        sub.idxs.append(i)
+                        routed = True
+                if routed:
+                    continue
+                # ordered barrier plane: land this chunk's outstanding
+                # routed commands, then execute on the parent loop
+                had_outstanding = bool(subs) or bool(futs)
+                await quiesce()
+                if had_outstanding:
+                    node.stats.extra["serve_xshard_barriers"] = \
+                        node.stats.extra.get("serve_xshard_barriers", 0) + 1
+                reply = node.execute(msg)
+                if not lone:
+                    node.stats.serve_barriers += 1
+                if not isinstance(reply, NoReply):
+                    buf = bytearray()
+                    encode_into(buf, reply)
+                    replies[i] = bytes(buf)
+                if cmd is not None and cmd.flags & CMD_CTRL:
+                    # CTRL can change the node identity the workers
+                    # stamp into writes (NODE ID) — resync them
+                    await self.pool.call_all("ident", node.node_id,
+                                             node.alias)
+            await quiesce()
+        finally:
+            for tok in opened - dispatched:
+                self._inflight.pop(tok, None)
+        for r in replies:
+            out += r
+
+    def _on_serve_ack(self, shard: int, token: Optional[int], fut) -> None:
+        """Reply-order callback (FIFO per shard): mirror the worker's
+        log entries into this shard's segment, then release the floor
+        window, then wake the pushers — that order is what keeps the
+        merged stream strictly increasing."""
+        if fut.cancelled() or fut.exception() is not None:
+            # the worker failed mid-chunk: its entries may be missing,
+            # so the window stays HELD — the peer stream stalls on this
+            # shard instead of silently skipping ops (the awaiting
+            # connection sees the raised error)
+            log.error("serve worker %d chunk failed; holding repl floor: "
+                      "%s", shard,
+                      None if fut.cancelled() else fut.exception())
+            return
+        _out, _spans, entries, deleted, stats = fut.result()
+        node = self.node
+        if entries:
+            self.merged.segments[shard].push_many(entries)
+        if token is not None:
+            self._inflight.pop(token, None)
+        if entries:
+            node.events.trigger(EVENT_REPLICATED, entries[-1][0])
+        if deleted:
+            node.events.trigger(EVENT_DELETED)
+        self._fold_stats(shard, stats)
+
+    def _fold_stats(self, shard: int, stats: dict) -> None:
+        node = self.node
+        last = self._last_stats[shard]
+        st = node.stats
+        st.cmds_processed += stats["cmds"] - last.get("cmds", 0)
+        st.cmds_replicated += stats["repl"] - last.get("repl", 0)
+        st.serve_msgs_coalesced += stats["msgs"] - last.get("msgs", 0)
+        st.serve_flushes += stats["flushes"] - last.get("flushes", 0)
+        st.serve_barriers += stats["barriers"] - last.get("barriers", 0)
+        st.repl_apply_barriers += \
+            stats["apply_barriers"] - last.get("apply_barriers", 0)
+        if stats.get("lat"):
+            st.serve_lat.extend(stats["lat"])
+        self._last_stats[shard] = stats
+        x = st.extra
+        for ext, key in _STAT_GAUGES:
+            x[f"serve_shard{shard}_{ext}"] = stats[key]
+
+    # -------------------------------------------------- replication (pull)
+
+    def make_applier(self, meta, max_frames=None, max_latency=None,
+                     now=time.monotonic) -> "ShardApplier":
+        return ShardApplier(self, meta, max_frames=max_frames,
+                            max_latency=max_latency, now=now)
+
+    # -------------------------------------------------------- bulk / reads
+
+    async def ingest_batches(self, batches) -> int:
+        """Fan decoded snapshot batches out to the shard workers by key
+        hash (the receive side of a full sync).  Awaits per batch, so
+        the loop stays live between groups; returns rows applied."""
+        from ..persist.snapshot import _encode_batch
+        from ..store.sharded_keyspace import extract_shard, shard_ids
+        applied = 0
+        x = self.node.stats.extra
+        try:
+            for b in batches:
+                sids = shard_ids(b.keys, self.n_shards)
+                dsids = shard_ids(b.del_keys, self.n_shards) \
+                    if b.del_keys else None
+                futs = []
+                for s in range(self.n_shards):
+                    sub = extract_shard(b, sids, dsids, s)
+                    if sub.n_rows or sub.del_keys:
+                        payload = bytes(_encode_batch(sub))
+                        futs.append((s, self.pool.submit(
+                            s, ("merge", payload))))
+                for s, f in futs:
+                    rows, nkeys = await f
+                    applied += rows
+                    x[f"serve_shard{s}_keys"] = nkeys
+        finally:
+            # even a PARTIAL ingest invalidates the shared full-sync
+            # dump: bulk-merged rows bypass the repl_log, so a cached
+            # dump plus a log tail would silently omit them (the plain
+            # path invalidates per merge_batches call)
+            self.node._dump_stale()
+        return applied
+
+    async def export_batches(self) -> list:
+        """Whole-state columnar export of every shard (quiesced +
+        flushed) — the full-sync dump feed (persist/share.py)."""
+        from ..persist.snapshot import _decode_batch
+        payloads = await self.pool.call_all("export")
+        return [_decode_batch(p) for p in payloads]
+
+    async def canonical(self, keys=None) -> dict:
+        if keys is None:
+            parts = await self.pool.call_all("canonical", None)
+        else:
+            per: list[list] = [[] for _ in range(self.n_shards)]
+            for k in keys:
+                per[shard_of(k, self.n_shards)].append(k)
+            futs = [self.pool.submit(s, ("canonical", per[s]))
+                    for s in range(self.n_shards) if per[s]]
+            parts = list(await asyncio.gather(*futs))
+        out: dict = {}
+        for p in parts:
+            out.update(p)
+        return out
+
+    async def state_bytes_per_shard(self) -> list:
+        return await self.pool.call_all("state_bytes")
+
+    async def gc(self, horizon: int) -> int:
+        freed = sum(await self.pool.call_all("gc", horizon))
+        self.node.stats.gc_freed += freed
+        return freed
+
+    async def reset_for_resync(self, keep_link=None) -> None:
+        """The plane twin of Node.reset_for_full_resync: quiesce, wipe
+        every shard worker, fence fresh segments at the pre-wipe
+        watermark, and kick every other live peer connection."""
+        node = self.node
+        await self.pool.barrier()
+        fence = max(self.merged.last_uuid, node.hlc.current)
+        await self.pool.call_all("reset")
+        merged = MergedReplLog(self.n_shards, cap_bytes=self.merged.cap)
+        merged.floor = self._floor
+        merged.pending_high = self._pending_high
+        merged.last_uuid = fence
+        merged.evicted_up_to = fence
+        self.merged = merged
+        node.repl_log = merged
+        self._inflight.clear()
+        node._kick_peers_after_wipe(keep_link)
+
+
+class ShardApplier:
+    """Peer-stream applier for a sharded node: intake (dup-skip / gap /
+    cursor) stays on the parent loop, frames route to the worker owning
+    their key and apply there on the exact per-key op path — cross-shard
+    parallelism replaces in-shard coalescing.  Watermark discipline is
+    identical to replica/coalesce.py: `meta.uuid_he_sent` advances only
+    after the covering worker acks land, beacons are stashed while
+    frames are pending, and membership frames apply in place (they never
+    touch the keyspace)."""
+
+    needs_flush_async = True
+
+    __slots__ = ("plane", "node", "meta", "max_frames", "max_latency",
+                 "_now", "cursor", "_epoch", "_bufs", "_counts", "_frames",
+                 "_first_ts", "_pending_beacon")
+
+    def __init__(self, plane: ServeShardPlane, meta, max_frames=None,
+                 max_latency=None, now=time.monotonic) -> None:
+        from ..conf import env_float, env_int
+        self.plane = plane
+        self.node = plane.node
+        self.meta = meta
+        self.max_frames = env_int("CONSTDB_APPLY_BATCH", 512) \
+            if max_frames is None else max_frames
+        self.max_latency = (env_float("CONSTDB_APPLY_LATENCY_MS", 5.0)
+                            / 1000.0) if max_latency is None else max_latency
+        self._now = now
+        self.cursor = meta.uuid_he_sent
+        self._epoch = plane.node.reset_epoch
+        self._bufs = [bytearray() for _ in range(plane.n_shards)]
+        self._counts = [0] * plane.n_shards
+        self._frames = 0
+        self._first_ts = 0.0
+        self._pending_beacon = 0
+
+    @property
+    def pending(self) -> int:
+        return self._frames
+
+    async def aapply(self, items: list) -> None:
+        uuid = as_int(items[3])
+        if uuid <= self.cursor:
+            return  # duplicate (reconnect overlap)
+        if as_int(items[2]) > self.cursor:
+            await self.aflush()
+            raise ReplicateCommandsLost(
+                f"{self.meta.addr}: gap {self.cursor} -> "
+                f"{as_int(items[2])}")
+        name = as_bytes(items[4])
+        cmd = COMMANDS.get(name) or COMMANDS.get(name.lower())
+        if cmd is None or not shard_routable(cmd) or len(items) < 6:
+            # membership applies in place (never touches the keyspace);
+            # anything else unroutable lands what we have first, then
+            # takes the exact per-key path on the parent (raising the
+            # exact op error for unknown/malformed frames)
+            if self._frames and name not in STATE_FREE_BARRIERS:
+                await self.aflush()
+            node = self.node
+            node.stats.repl_apply_barriers += 1
+            node.apply_replicated(name, items[5:], as_int(items[1]), uuid)
+            self.cursor = uuid
+            if not self._frames:
+                self._advance(uuid)
+            return
+        shard = shard_of(as_bytes(items[5]), self.plane.n_shards)
+        if not self._frames:
+            self._first_ts = self._now()
+        encode_into(self._bufs[shard], Arr(items))
+        self._counts[shard] += 1
+        f = self._frames + 1
+        self._frames = f
+        self.cursor = uuid
+        if f >= self.max_frames or \
+                (not f & 31 and
+                 self._now() - self._first_ts >= self.max_latency):
+            await self.aflush()
+
+    def observe_beacon(self, beacon: int) -> None:
+        if self._frames:
+            if beacon > max(self.cursor, self._pending_beacon):
+                self._pending_beacon = beacon
+                self.node.hlc.observe(beacon)
+        elif beacon > self.meta.uuid_he_sent:
+            self.meta.uuid_he_sent = beacon
+            if beacon > self.cursor:
+                self.cursor = beacon
+            self.node.hlc.observe(beacon)
+
+    def resync(self) -> None:
+        self.cursor = self.meta.uuid_he_sent
+        self._pending_beacon = 0
+        self._epoch = self.node.reset_epoch
+
+    async def aflush(self) -> None:
+        frames, self._frames = self._frames, 0
+        if not frames:
+            return
+        bufs = self._bufs
+        counts = self._counts
+        self._bufs = [bytearray() for _ in range(self.plane.n_shards)]
+        self._counts = [0] * self.plane.n_shards
+        node = self.node
+        if node.reset_epoch != self._epoch:
+            # a state wipe landed between intake and flush: these frames
+            # describe pre-wipe state — drop them (replica/coalesce.py)
+            self._pending_beacon = 0
+            return
+        pool = self.plane.pool
+        futs = []
+        for s in range(self.plane.n_shards):
+            if counts[s]:
+                futs.append((s, pool.submit(
+                    s, ("apply", bytes(bufs[s]), counts[s]))))
+        for s, f in futs:
+            entries, deleted, stats = await f
+            if entries:  # leftover tap from an earlier worker error
+                self.plane.merged.segments[s].push_many(entries)
+            if deleted:
+                node.events.trigger(EVENT_DELETED)
+            self.plane._fold_stats(s, stats)
+        node.hlc.observe(self.cursor)
+        self._advance(self.cursor)
+
+    def _advance(self, uuid: int) -> None:
+        beacon, self._pending_beacon = self._pending_beacon, 0
+        w = max(uuid, beacon)
+        if w > self.meta.uuid_he_sent:
+            self.meta.uuid_he_sent = w
+        if beacon > self.cursor:
+            self.cursor = beacon

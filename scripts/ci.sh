@@ -31,6 +31,24 @@ if total > MAX_BASELINED:
 EOF
 
 echo
+echo "== serve-shards smoke (bench --mode serve --serve-shards 2) =="
+# tiny oracle-verified run of the shard-per-core serving plane over
+# real sockets: reply streams + visible-value export of every shard
+# count must match the shards=1 leg (the differential suite proper runs
+# inside tier-1 — tests/test_serve_shards.py)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_SERVE_OPS=3000 CONSTDB_BENCH_SERVE_CONNS=2 \
+CONSTDB_BENCH_SERVE_REPS=1 \
+    timeout -k 10 300 python bench.py --mode serve --serve-shards 2 \
+    > /tmp/_ci_serve_shards.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_serve_shards.json"))
+assert out["verified"], "serve-shards smoke failed oracle verification"
+print("serve-shards smoke verified:",
+      [(leg["serve_shards"], leg["rps"]) for leg in out["serve_shards_curve"]])
+EOF
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

@@ -76,6 +76,11 @@ def test_corpus_expectations(corpus_findings):
     assert {f.token for f in fc} == \
         {"lambda", "closure_worker", "self.run_shard", "self.engine",
          "engine"}
+    # KEY-CONFINED: second-arg key + underivable key; the clean command
+    # and the delegating helper stay silent
+    kc = by["KEY-CONFINED"]
+    assert {f.token for f in kc} == {"badswap", "nokey"}
+    assert not any("good" in f.qualname for f in kc)
 
 
 def test_findings_have_location_and_hint(corpus_findings):
