@@ -118,6 +118,18 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_SERVE_SHARDS", "1",
            "serve worker processes, each owning a keyspace shard + "
            "engine + repl-log segment; 1 = the exact single-loop path"),
+    EnvVar("CONSTDB_DELTA_SYNC", "1",
+           "digest-driven partial resync on the replication push path; "
+           "0 = always ship full snapshots"),
+    EnvVar("CONSTDB_DELTA_MAX_DIVERGENCE", "0.5",
+           "digest bucket-mismatch fraction past which a delta resync "
+           "demotes to a full snapshot"),
+    EnvVar("CONSTDB_DELTA_BUCKET_KEYS", "8",
+           "target keys per digest leaf bucket (finer buckets localize "
+           "divergence; 8 bytes of digest per bucket)"),
+    EnvVar("CONSTDB_DELTA_STAMP_MIN", "4096",
+           "min keys in the divergent buckets before the per-key stamp "
+           "refinement round runs (below it, whole buckets stream)"),
 )}
 
 

@@ -137,40 +137,83 @@ class MergeEngine(Protocol):
     def flush(self, store: KeySpace) -> None: ...
 
 
-def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True) -> ColumnarBatch:
+def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True,
+                        key_sel: Optional[np.ndarray] = None) -> ColumnarBatch:
     """Dump a keyspace's full logical state as a batch (snapshot body /
-    merge-test vehicle).  GC-freed element rows are excluded."""
+    merge-test vehicle).  GC-freed element rows are excluded.
+
+    `key_sel`: restrict the dump to these key rows (int64 kid array) —
+    the range-scoped delta export the digest anti-entropy streams for
+    divergent buckets (store/digest.py export_bucket_batch).  Counter
+    and element rows of unselected keys are dropped and the survivors
+    re-pointed at batch-local key positions.  `key_deletes` are NOT
+    key-rows and ride unfiltered when `include_deletes` (scoped callers
+    filter them by bucket themselves)."""
     b = ColumnarBatch()
     b.rows_unique_per_slot = True  # a state dump has one row per slot
     n = ks.keys.n
-    b.keys = list(ks.key_bytes)
-    b.key_enc = ks.keys.enc.copy()
-    b.key_ct = ks.keys.ct.copy()
-    b.key_mt = ks.keys.mt.copy()
-    b.key_dt = ks.keys.dt.copy()
-    b.key_expire = ks.keys.expire.copy()
-    b.reg_val = list(ks.reg_val)
-    b.reg_t = ks.keys.rv_t.copy()
-    b.reg_node = ks.keys.rv_node.copy()
+    if key_sel is None:
+        b.keys = list(ks.key_bytes)
+        b.key_enc = ks.keys.enc.copy()
+        b.key_ct = ks.keys.ct.copy()
+        b.key_mt = ks.keys.mt.copy()
+        b.key_dt = ks.keys.dt.copy()
+        b.key_expire = ks.keys.expire.copy()
+        b.reg_val = list(ks.reg_val)
+        b.reg_t = ks.keys.rv_t.copy()
+        b.reg_node = ks.keys.rv_node.copy()
 
-    b.cnt_ki = ks.cnt.kid.copy()
-    b.cnt_node = ks.cnt.node.copy()
-    b.cnt_val = ks.cnt.val.copy()
-    b.cnt_uuid = ks.cnt.uuid.copy()
-    b.cnt_base = ks.cnt.base.copy()
-    b.cnt_base_t = ks.cnt.base_t.copy()
+        b.cnt_ki = ks.cnt.kid.copy()
+        b.cnt_node = ks.cnt.node.copy()
+        b.cnt_val = ks.cnt.val.copy()
+        b.cnt_uuid = ks.cnt.uuid.copy()
+        b.cnt_base = ks.cnt.base.copy()
+        b.cnt_base_t = ks.cnt.base_t.copy()
 
-    live = ks.el.kid >= 0
-    b.el_ki = ks.el.kid[live].copy()
-    b.el_add_t = ks.el.add_t[live].copy()
-    b.el_add_node = ks.el.add_node[live].copy()
-    b.el_del_t = ks.el.del_t[live].copy()
-    rows = np.nonzero(live)[0]
-    b.el_member = [ks.el_member[r] for r in rows]
-    b.el_val = [ks.el_val[r] for r in rows]
+        live = ks.el.kid >= 0
+        b.el_ki = ks.el.kid[live].copy()
+        b.el_add_t = ks.el.add_t[live].copy()
+        b.el_add_node = ks.el.add_node[live].copy()
+        b.el_del_t = ks.el.del_t[live].copy()
+        rows = np.nonzero(live)[0]
+        b.el_member = [ks.el_member[r] for r in rows]
+        b.el_val = [ks.el_val[r] for r in rows]
+        assert n == len(b.keys)
+    else:
+        sel = np.asarray(key_sel, dtype=_I64)
+        idx = sel.tolist()
+        b.keys = [ks.key_bytes[i] for i in idx]
+        b.key_enc = np.ascontiguousarray(ks.keys.enc[sel])
+        b.key_ct = np.ascontiguousarray(ks.keys.ct[sel])
+        b.key_mt = np.ascontiguousarray(ks.keys.mt[sel])
+        b.key_dt = np.ascontiguousarray(ks.keys.dt[sel])
+        b.key_expire = np.ascontiguousarray(ks.keys.expire[sel])
+        b.reg_val = [ks.reg_val[i] for i in idx]
+        b.reg_t = np.ascontiguousarray(ks.keys.rv_t[sel])
+        b.reg_node = np.ascontiguousarray(ks.keys.rv_node[sel])
+
+        posmap = np.full(n, -1, dtype=_I64)
+        posmap[sel] = np.arange(len(sel), dtype=_I64)
+        if ks.cnt.n:
+            cm = np.nonzero(posmap[ks.cnt.kid] >= 0)[0]
+            b.cnt_ki = posmap[ks.cnt.kid[cm]]
+            b.cnt_node = np.ascontiguousarray(ks.cnt.node[cm])
+            b.cnt_val = np.ascontiguousarray(ks.cnt.val[cm])
+            b.cnt_uuid = np.ascontiguousarray(ks.cnt.uuid[cm])
+            b.cnt_base = np.ascontiguousarray(ks.cnt.base[cm])
+            b.cnt_base_t = np.ascontiguousarray(ks.cnt.base_t[cm])
+        if ks.el.n:
+            ekid = ks.el.kid
+            em = np.nonzero((ekid >= 0) & (posmap[ekid] >= 0))[0]
+            b.el_ki = posmap[ekid[em]]
+            b.el_add_t = np.ascontiguousarray(ks.el.add_t[em])
+            b.el_add_node = np.ascontiguousarray(ks.el.add_node[em])
+            b.el_del_t = np.ascontiguousarray(ks.el.del_t[em])
+            rows = em.tolist()
+            b.el_member = [ks.el_member[r] for r in rows]
+            b.el_val = [ks.el_val[r] for r in rows]
 
     if include_deletes and ks.key_deletes:
         b.del_keys = list(ks.key_deletes.keys())
         b.del_t = np.fromiter(ks.key_deletes.values(), dtype=_I64, count=len(ks.key_deletes))
-    assert n == len(b.keys)
     return b

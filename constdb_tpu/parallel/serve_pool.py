@@ -102,6 +102,8 @@ def _serve_worker_main(conn, shard: int, n_shards: int, engine_spec: str,
     import os
 
     os.environ.update(env)
+    import numpy as np
+
     from ..engine.base import batch_from_keyspace
     from ..persist.snapshot import _decode_batch, _encode_batch
     from ..resp.codec import make_parser
@@ -185,6 +187,33 @@ def _serve_worker_main(conn, shard: int, n_shards: int, engine_spec: str,
                 node.ensure_flushed()
                 conn.send(("ok", bytes(_encode_batch(
                     batch_from_keyspace(node.ks)))))
+            elif cmd == "digest":
+                # anti-entropy digest of THIS shard's keys (the crc32
+                # partition is layout-invariant, so the parent SUMS the
+                # workers' matrices — store/digest.py sum_matrices)
+                from ..store.digest import state_digest_matrix
+                node.ensure_flushed()
+                conn.send(("ok", state_digest_matrix(
+                    node.ks, msg[1], msg[2]).astype("<u8").tobytes()))
+            elif cmd == "n_keys":
+                # live key count (delta-sync leaf sizing): the serving
+                # stat gauges can be zero on a node whose state arrived
+                # purely via the replication stream, so the plane asks
+                # the workers directly
+                node.ensure_flushed()
+                conn.send(("ok", node.ks.n_keys()))
+            elif cmd == "digest_export":
+                # encoded BATCH chunks of the masked buckets' state —
+                # the delta-sync stream's payload (replica/link.py
+                # _send_delta writes them via write_chunk_raw)
+                from ..persist.snapshot import batch_chunks
+                from ..store.digest import export_bucket_batch
+                _, fanout, leaves, mask_bytes, chunk_keys = msg
+                node.ensure_flushed()
+                mask = np.frombuffer(mask_bytes, dtype=bool)
+                b = export_bucket_batch(node.ks, fanout, leaves, mask)
+                conn.send(("ok", [bytes(_encode_batch(c))
+                                  for c in batch_chunks(b, chunk_keys)]))
             elif cmd == "memory":
                 node.ensure_flushed()
                 conn.send(("ok", node.ks.memory_report()))

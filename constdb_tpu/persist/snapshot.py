@@ -451,6 +451,13 @@ class SnapshotWriter:
     def write_chunk(self, batch: ColumnarBatch) -> None:
         self._section(SEC_BATCH, _encode_batch(batch))
 
+    def write_chunk_raw(self, payload: bytes) -> None:
+        """A BATCH section from an already-encoded (uncompressed) batch
+        payload — the delta-sync path writes shard workers' bucket
+        exports without a decode/re-encode round trip
+        (server/serve_shards.py export_bucket_payloads)."""
+        self._section(SEC_BATCH, bytearray(payload))
+
     def finish(self) -> None:
         """End marker + digest.  The digest covers the marker, so dropping
         trailing sections can't go unnoticed."""
@@ -599,9 +606,12 @@ def write_snapshot_file(path: str, meta: NodeMeta,
     tmp-file + SnapshotWriter + replace recipe every dump site shares
     (persist/share.py full-sync dumps, bin/server.py background and
     shutdown dumps — including the sharded-node variants, whose
-    `captures` are the per-shard worker exports).  Blocking file IO:
-    call from a worker thread when on the event loop.  Returns the file
-    size."""
+    `captures` are the per-shard worker exports — and the delta-sync
+    bucket exports, replica/link.py _send_delta).  A capture may be a
+    ColumnarBatch (chunked + encoded here) or pre-encoded section bytes
+    (written as-is — shard workers encode their own bucket exports).
+    Blocking file IO: call from a worker thread when on the event loop.
+    Returns the file size."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
@@ -609,6 +619,9 @@ def write_snapshot_file(path: str, meta: NodeMeta,
             w.write_node(meta)
             w.write_replicas(records)
             for part in captures:
+                if isinstance(part, (bytes, bytearray)):
+                    w.write_chunk_raw(part)
+                    continue
                 for chunk in batch_chunks(part, chunk_keys):
                     w.write_chunk(chunk)
             w.finish()

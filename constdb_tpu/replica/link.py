@@ -16,13 +16,32 @@ Wire protocol (RESP frames on one TCP stream, symmetric after handshake):
     *[partsync]
     *[replicate, origin_nodeid, prev_uuid, uuid, cmd, args...]
     *[replack, uuid, now_ms]
+  delta anti-entropy (both peers advertise CAP_DELTA_SYNC; pusher-driven):
+    *[digest, token, 0, fanout, leaves, rollup]       per-shard rollups
+    *[digestack, token, 0, shard_ids]                 puller's mismatches
+    *[digest, token, 1, fanout, leaves, shard_ids, leaf_digests]
+    *[digestack, token, 1, bucket_ids]
+    *[deltasync, size, repl_last_uuid, n_buckets] + `size` bytes — a
+      snapshot-FORMAT stream holding only the divergent buckets' state
 
 Sync decision (reference push.rs:91-111): partial iff the peer's resume
 uuid is still gap-free in my repl_log; re-checked every round AND before
-every frame, so a pusher that falls off its own ring mid-stream re-sends a
-full snapshot on the SAME connection instead of shipping a gapped frame
-and paying a teardown + redial (the reference leaves this case as a TODO —
+every frame, so a pusher that falls off its own ring mid-stream recovers on
+the SAME connection instead of shipping a gapped frame and paying a
+teardown + redial (the reference leaves this case as a TODO —
 pull.rs:167-172; regression-tested in tests/test_link_pushloop.py).
+
+Off-ring recovery is digest-driven when both peers allow it (`_send_delta`,
+store/digest.py): instead of re-shipping the whole keyspace, pusher and
+puller exchange a two-level digest over the crc32 shard partition —
+per-shard rollups first, per-key-range leaf digests for shards that
+mismatch — and only the divergent buckets stream, as a snapshot-format
+delta applied through the same coalesced merge path.  Resync cost becomes
+O(divergence) instead of O(keyspace).  The full snapshot remains the
+fallback for: peers without CAP_DELTA_SYNC (they get the exact pre-delta
+byte stream), state-clearing resyncs (needs_full → FULLSYNC reset), excess
+divergence (CONSTDB_DELTA_MAX_DIVERGENCE), and any failed/timed-out
+negotiation.
 
 Connection ownership: one link per peer address.  The link dials when it
 has no live connection; an inbound SYNC for the same address *adopts* its
@@ -38,6 +57,8 @@ import logging
 import os
 import random
 from typing import Optional, TYPE_CHECKING
+
+import numpy as np
 
 from ..errors import CstError, ReplicateCommandsLost
 from ..persist.snapshot import SectionDemux, batch_chunks
@@ -57,6 +78,9 @@ FULLSYNC = b"fullsync"
 PARTSYNC = b"partsync"
 REPLICATE = b"replicate"
 REPLACK = b"replack"
+DIGEST = b"digest"
+DIGESTACK = b"digestack"
+DELTASYNC = b"deltasync"
 
 # Handshake capability bits: items[6] of BOTH sync frames (dialer and
 # reply).  A pre-capability peer sends 6-item frames and parses as 0 —
@@ -64,7 +88,21 @@ REPLACK = b"replack"
 # 5: the FULLSYNC reset flag silently downgraded on mixed-version
 # meshes, recreating exactly the resurrection scenario it prevents).
 CAP_FULLSYNC_RESET = 1   # honors FULLSYNC's 4th (state-wipe) field
-MY_CAPS = CAP_FULLSYNC_RESET
+CAP_DELTA_SYNC = 2       # answers digest frames / applies deltasync
+MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC
+
+
+def my_caps(app) -> int:
+    """The capability bitmask this node advertises in SYNC handshakes.
+    CONSTDB_DELTA_SYNC=0 removes CAP_DELTA_SYNC so the kill switch
+    disables BOTH legs: we never initiate deltas (push-loop gate) and
+    conforming peers never ask us digest questions (no capability), so
+    the node pays no responder-side digest folds either."""
+    caps = MY_CAPS
+    if not getattr(app, "delta_sync", True):
+        caps &= ~CAP_DELTA_SYNC
+    return caps
+
 
 _READ_CHUNK = 1 << 16
 
@@ -88,6 +126,21 @@ class ReplicaLink:
         # capability bits the peer advertised in the live connection's
         # handshake (0 = pre-capability peer / no connection yet)
         self._peer_caps = 0
+        # digest negotiation plumbing: the push loop initiates rounds and
+        # awaits DIGESTACK replies, which arrive on the PULL loop — the
+        # queue bridges them (fresh per connection, so a dead stream's
+        # late acks can never answer a new round's question); the cache
+        # pins the puller-side matrix across a round's two levels so both
+        # comparisons see ONE consistent state cut
+        self._digest_acks: Optional[asyncio.Queue] = None
+        self._digest_cache = None
+        self._delta_token = 0
+        # held by _stream_file for a whole raw payload window: the pull
+        # loop answers the peer's digest questions on the SAME writer,
+        # and a whole-frame write is only atomic BETWEEN frames — a
+        # DIGESTACK landing inside a FULLSYNC/DELTASYNC byte window
+        # would corrupt the peer's spill download
+        self._stream_lock = asyncio.Lock()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -157,7 +210,7 @@ class ReplicaLink:
                 Bulk(SYNC), Int(0), Int(self.node.node_id),
                 Bulk(self.node.alias.encode()),
                 Bulk(self.app.advertised_addr.encode()),
-                Int(self.meta.uuid_he_sent), Int(MY_CAPS)])))
+                Int(self.meta.uuid_he_sent), Int(my_caps(self.app))])))
             await writer.drain()
             parser = make_parser()
             msg = await _read_msg(reader, parser,
@@ -222,6 +275,8 @@ class ReplicaLink:
     def _install(self, reader, writer, parser, peer_resume: int) -> None:
         self.meta.last_seen_ms = now_ms()
         self._epoch = self.node.reset_epoch
+        self._digest_acks = asyncio.Queue()
+        self._digest_cache = None
         old_task, old_writer = self._serve_task, self._writer
         self._writer = writer
         self._serve_task = asyncio.create_task(
@@ -236,7 +291,7 @@ class ReplicaLink:
     async def _serve(self, reader, writer, parser, peer_resume: int) -> None:
         push = asyncio.create_task(self._push_loop(writer, peer_resume))
         try:
-            await self._pull_loop(reader, parser)
+            await self._pull_loop(reader, writer, parser)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             log.debug("link %s dropped: %s", self.meta.addr, e)
         except ReplicateCommandsLost as e:
@@ -310,8 +365,34 @@ class ReplicaLink:
                                 x.get("fullsync_reset_refused", 0) + 1
                             writer.close()
                             return
-                        cursor = await self._send_snapshot(
-                            writer, reset=reset)
+                        # digest-driven partial resync where it is sound:
+                        # an ordinary off-ring catch-up (incl. the
+                        # mid-stream ring-falloff recovery, which re-enters
+                        # this decision) against a CAP_DELTA_SYNC peer.
+                        # A state-CLEARING resync must stay a full
+                        # snapshot — the peer wipes first, so there is no
+                        # surviving state to diff against.  _send_delta
+                        # returns None when the negotiation demotes
+                        # (threshold, timeout, malformed reply) and the
+                        # exact full-sync path runs instead.
+                        cursor = None
+                        if not reset and \
+                                (self._peer_caps & CAP_DELTA_SYNC) and \
+                                getattr(self.app, "delta_sync", True):
+                            cursor = await self._send_delta(writer)
+                            if cursor is None:
+                                # EVERY demotion exit counts — threshold,
+                                # timeout, malformed reply — so INFO's
+                                # repl_delta_demotions matches the
+                                # invariant doc and a silently failing
+                                # delta path is visible next to the
+                                # climbing repl_full_syncs
+                                x = node.stats.extra
+                                x["repl_delta_demotions"] = \
+                                    x.get("repl_delta_demotions", 0) + 1
+                        if cursor is None:
+                            cursor = await self._send_snapshot(
+                                writer, reset=reset)
                     synced = True
                     meta.needs_full = False
 
@@ -380,37 +461,278 @@ class ReplicaLink:
         loop's new send cursor (the repl_log gap above it streams next,
         which `can_resume_from` guarantees is still present)."""
         dump = await self.app.shared_dump.acquire()
-        self.node.stats.extra["full_syncs_sent"] = \
-            self.node.stats.extra.get("full_syncs_sent", 0) + 1
-        # open + reads off-loop: a full-resync burst on a loaded disk
-        # must not hiccup every client (ASYNC-BLOCK; the writes are
-        # socket-buffered and drain() yields between pieces).  The FIRST
-        # piece is read BEFORE the FULLSYNC header goes out so the
-        # stream never shows a header with zero payload bytes behind it
-        # — the pre-executor code had no such window (header + first
-        # read happened in one task step) and the wire contract keeps it
+        self.node.stats.repl_full_syncs += 1
+        await self._stream_file(writer, dump.path, encode_msg(Arr([
+            Bulk(FULLSYNC), Int(dump.size), Int(dump.repl_last),
+            Int(1 if reset else 0)])))
+        return dump.repl_last
+
+    async def _stream_file(self, writer, path: str, header: bytes) -> None:
+        """`header` + the file's bytes to the socket in fixed-size
+        pieces (the FULLSYNC and DELTASYNC transports share this).
+        Open + reads off-loop: a resync burst on a loaded disk must not
+        hiccup every client (ASYNC-BLOCK; the writes are socket-buffered
+        and drain() yields between pieces).  The FIRST piece is read
+        BEFORE the header goes out so the stream never shows a header
+        with zero payload bytes behind it — the pre-executor code had no
+        such window (header + first read happened in one task step) and
+        the wire contract keeps it."""
         loop = asyncio.get_running_loop()
-        f = await loop.run_in_executor(None, open, dump.path, "rb")
+        f = await loop.run_in_executor(None, open, path, "rb")
         try:
-            piece = await loop.run_in_executor(None, f.read, _READ_CHUNK)
-            self._write(writer, encode_msg(Arr([
-                Bulk(FULLSYNC), Int(dump.size), Int(dump.repl_last),
-                Int(1 if reset else 0)])))
-            while piece:
-                self._write(writer, piece)
-                await writer.drain()
-                piece = await loop.run_in_executor(None, f.read, _READ_CHUNK)
+            async with self._stream_lock:
+                piece = await loop.run_in_executor(None, f.read,
+                                                   _READ_CHUNK)
+                self._write(writer, header)
+                while piece:
+                    self._write(writer, piece)
+                    await writer.drain()
+                    piece = await loop.run_in_executor(None, f.read,
+                                                       _READ_CHUNK)
         finally:
             f.close()
-        return dump.repl_last
+
+    # ---------------------------------------------------- delta anti-entropy
+
+    async def _local_digest(self, fanout: int, leaves: int) -> np.ndarray:
+        """This node's (fanout, leaves) state digest matrix
+        (store/digest.py): plane-aware — a shard-per-core node sums its
+        workers' matrices (their keys partition the keyspace), a plain
+        node folds its own keyspace after an engine flush."""
+        node = self.node
+        if node.serve_plane is not None:
+            return await node.serve_plane.state_digest(fanout, leaves)
+        node.ensure_flushed()
+        from ..store.digest import state_digest_matrix
+        # the FIRST digest on a long-lived store pays the per-item
+        # Python crc32 backlog over every key and member — seconds at
+        # north-star scale, which on-loop would stall serving and
+        # REPLACK heartbeats past the peer's ack deadline.  Warm the
+        # caches off-loop; rows landing mid-warm are picked up by the
+        # (now tiny) incremental sync inside the fold below.
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, node.ks.warm_digest_caches)
+        node.ensure_flushed()  # re-land anything that arrived mid-warm
+        return state_digest_matrix(node.ks, fanout, leaves)
+
+    async def _await_digest_ack(self, token: int, level: int
+                                ) -> Optional[bytes]:
+        """Next DIGESTACK payload for (token, level), bridged over from
+        the pull loop; None on timeout/malformed (the caller demotes to
+        a full snapshot).  Acks from abandoned rounds are discarded."""
+        q = self._digest_acks
+        if q is None:
+            return None
+        timeout = getattr(self.app, "handshake_timeout", 10.0)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            left = deadline - loop.time()
+            if left <= 0:
+                return None
+            try:
+                items = await asyncio.wait_for(q.get(), left)
+            except asyncio.TimeoutError:
+                return None
+            try:
+                if as_int(items[1]) == token and as_int(items[2]) == level:
+                    return as_bytes(items[3])
+            except (CstError, IndexError):
+                return None
+
+    async def _refine_keys(self, writer, token: int, fanout: int,
+                           leaves: int, mask: np.ndarray):
+        """Level-2 refinement: exchange per-crc content stamps for the
+        divergent buckets so only keys that actually differ stream —
+        the whole-bucket export ships every innocent bystander sharing
+        a bucket with a divergent key (~bucket_keys-1 per hit), which
+        at the default grain is most of the delta payload.  Returns the
+        delta batch, or None to fall back to the whole-bucket export
+        (timeout / malformed reply — still a valid delta, just fatter)."""
+        from ..store.digest import (KeyStampTable, bucket_key_sel,
+                                    masked_key_count)
+        node = self.node
+        st = node.stats
+        sel = bucket_key_sel(node.ks, fanout, leaves, mask)
+        if masked_key_count(node.ks, fanout, leaves, mask,
+                            key_sel=sel) < \
+                getattr(self.app, "delta_stamp_min", 4096):
+            # the stamp exchange costs ~12B per listed key; below this
+            # scale the whole-bucket export is already small enough that
+            # another round can't pay for itself (and may cost MORE than
+            # the bytes it saves — pinned by the e2e resync-beats-full
+            # assertion at tiny stores).  Gate on the cheap bucket-math
+            # count BEFORE building the stamp table: its _key_accum hash
+            # pass is O(keyspace), which the common small-divergence
+            # delta would pay only to throw away.
+            return None
+        table = KeyStampTable(node.ks, fanout, leaves, mask, key_sel=sel)
+        st.repl_digest_rounds += 1
+        self._write(writer, encode_msg(Arr([
+            Bulk(DIGEST), Int(token), Int(2), Int(fanout), Int(leaves),
+            Bulk(table.crcs.astype("<u4").tobytes()),
+            Bulk(table.stamps.astype("<u8").tobytes())])))
+        await writer.drain()
+        ack = await self._await_digest_ack(token, 2)
+        if ack is None:
+            log.warning("delta sync %s: no usable key-stamp reply; "
+                        "falling back to the whole-bucket delta",
+                        self.meta.addr)
+            return None
+        idx = np.frombuffer(ack, dtype="<i4")
+        if len(idx) and (int(idx.min()) < 0 or
+                         int(idx.max()) >= len(table.crcs)):
+            log.warning("delta sync %s: out-of-range key-stamp reply; "
+                        "falling back to the whole-bucket delta",
+                        self.meta.addr)
+            return None
+        log.debug("delta sync %s: %d/%d stamped keys diverged",
+                  self.meta.addr, len(idx), len(table.crcs))
+        return table.export_batch(node.ks, idx.astype(np.int64))
+
+    async def _send_delta(self, writer) -> Optional[int]:
+        """Digest-driven partial resync (the tentpole of the delta
+        anti-entropy protocol — see the module header).  Two rounds:
+        per-shard rollups, then leaf digests for mismatching shards;
+        the divergent buckets stream as a snapshot-format delta file.
+        Returns the new send cursor (the delta's watermark), or None
+        when the negotiation demoted to a full snapshot."""
+        from ..persist.snapshot import NodeMeta, write_snapshot_file
+        from ..store.digest import DIGEST_FANOUT, leaves_for
+        node = self.node
+        app = self.app
+        st = node.stats
+        meta = self.meta
+        if self._digest_acks is None:
+            self._digest_acks = asyncio.Queue()
+        # watermark FIRST, digest after: the digested state is then a
+        # superset of every op <= repl_last — ops landing in between are
+        # in the repl_log above it and replay after the delta, the same
+        # redelivery class the shared full-sync dump documents
+        # (persist/share.py; coalesced re-applies are idempotent)
+        repl_last = getattr(node.repl_log, "landed_last_uuid",
+                            node.repl_log.last_uuid)
+        fanout = DIGEST_FANOUT
+        plane = node.serve_plane
+        if plane is not None:
+            n_keys = await plane.key_count()
+        else:
+            n_keys = node.ks.n_keys()
+        leaves = leaves_for(n_keys, fanout,
+                            max(1, getattr(app, "delta_bucket_keys", 8)))
+        self._delta_token += 1
+        token = self._delta_token
+        matrix = await self._local_digest(fanout, leaves)
+        st.repl_digest_rounds += 1
+        self._write(writer, encode_msg(Arr([
+            Bulk(DIGEST), Int(token), Int(0), Int(fanout), Int(leaves),
+            Bulk(matrix.sum(axis=1, dtype=np.uint64)
+                 .astype("<u8").tobytes())])))
+        await writer.drain()
+        ack = await self._await_digest_ack(token, 0)
+        if ack is None:
+            log.warning("delta sync %s: no usable rollup reply; demoting "
+                        "to a full snapshot", meta.addr)
+            return None
+        shards = np.frombuffer(ack, dtype="<i8")
+        buckets = np.zeros(0, dtype=np.int64)
+        if len(shards):
+            if int(shards.min()) < 0 or int(shards.max()) >= fanout:
+                log.warning("delta sync %s: out-of-range shard ids in "
+                            "reply; demoting to a full snapshot", meta.addr)
+                return None
+            shards64 = shards.astype(np.int64)
+            st.repl_digest_rounds += 1
+            self._write(writer, encode_msg(Arr([
+                Bulk(DIGEST), Int(token), Int(1), Int(fanout), Int(leaves),
+                Bulk(ack),
+                Bulk(matrix[shards64].astype("<u8").tobytes())])))
+            await writer.drain()
+            ack = await self._await_digest_ack(token, 1)
+            if ack is None:
+                log.warning("delta sync %s: no usable leaf reply; "
+                            "demoting to a full snapshot", meta.addr)
+                return None
+            buckets = np.frombuffer(ack, dtype="<i8").astype(np.int64)
+            if len(buckets) and (int(buckets.min()) < 0 or
+                                 int(buckets.max()) >= fanout * leaves):
+                log.warning("delta sync %s: out-of-range bucket ids in "
+                            "reply; demoting to a full snapshot", meta.addr)
+                return None
+        # divergence threshold: past this bucket fraction a delta stops
+        # paying for itself (the leaf granularity targets ~bucket_keys
+        # keys per bucket, so bucket fraction ~ key fraction); demote —
+        # and name the shards being demoted, so an operator can see
+        # WHERE the mesh diverged
+        max_div = getattr(app, "delta_max_divergence", 0.5)
+        if len(buckets) > max_div * fanout * leaves:
+            dirty_shards = sorted(set((buckets // leaves).tolist()))
+            log.warning(
+                "delta sync %s: %d/%d buckets diverged (> %.0f%%); "
+                "demoting shards %s to a full transfer", meta.addr,
+                len(buckets), fanout * leaves, max_div * 100, dirty_shards)
+            return None
+        mask = np.zeros(fanout * leaves, dtype=bool)
+        mask[buckets] = True
+        nmeta = NodeMeta(node_id=node.node_id, alias=node.alias,
+                         addr=getattr(app, "advertised_addr", ""),
+                         repl_last_uuid=repl_last)
+        records = node.replicas.records()
+        chunk_keys = getattr(app, "snapshot_chunk_keys", 1 << 16)
+        level = getattr(app, "snapshot_compress_level", 1)
+        if plane is not None:
+            # shard-per-core pusher: whole-bucket export via the workers
+            # (per-key refinement would need a stamp fan-out RPC; the
+            # bucket granularity is already O(divergence) on the wire)
+            parts = await plane.export_bucket_payloads(
+                fanout, leaves, mask, chunk_keys=chunk_keys)
+        else:
+            from ..store.digest import export_bucket_batch
+            node.ensure_flushed()  # acks were awaited: re-sync the host
+            batch = None
+            if len(buckets):
+                batch = await self._refine_keys(writer, token, fanout,
+                                                leaves, mask)
+            if batch is None:
+                batch = export_bucket_batch(node.ks, fanout, leaves,
+                                            mask)
+            parts = [batch]
+        path = os.path.join(app.work_dir,
+                            f"delta.out.{meta.addr.replace(':', '_')}")
+        loop = asyncio.get_running_loop()
+        # file write off-loop (ASYNC-BLOCK): the captures are already
+        # materialized, so the worker thread only encodes + writes
+        size = await loop.run_in_executor(
+            None, lambda: write_snapshot_file(
+                path, nmeta, records, parts, chunk_keys=chunk_keys,
+                compress_level=level))
+        try:
+            await self._stream_file(writer, path, encode_msg(Arr([
+                Bulk(DELTASYNC), Int(size), Int(repl_last),
+                Int(len(buckets))])))
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        st.repl_delta_syncs += 1
+        st.repl_delta_bytes += size
+        log.info("delta sync %s: %d/%d buckets diverged, %d bytes "
+                 "streamed (watermark %d)", meta.addr, len(buckets),
+                 fanout * leaves, size, repl_last)
+        return repl_last
 
     # ----------------------------------------------------------------- pull
 
-    async def _pull_loop(self, reader, parser) -> None:
+    async def _pull_loop(self, reader, writer, parser) -> None:
         """Inbound half (reference pull.rs): coalesce replicate frames
         into columnar micro-batches (replica/coalesce.py) and land them
         through the MergeEngine; non-mergeable frames apply per-key as
-        barriers; snapshots load chunk-streamed as before.
+        barriers; snapshots load chunk-streamed as before.  `writer` is
+        the same full-duplex stream's outbound half: digest questions
+        from the peer's push loop are ANSWERED here (frames are encoded
+        into single atomic writes, so interleaving with our own push
+        loop's frames is safe).
 
         Flush cadence: the applier enforces the frame-count and latency
         bounds; this loop additionally flushes whenever the stream goes
@@ -473,10 +795,146 @@ class ReplicaLink:
                     repl_last=as_int(items[2]),
                     reset=bool(as_int(items[3])) if len(items) > 3 else False)
                 applier.resync()
+            elif kind == DELTASYNC:
+                await applier.aflush()  # barrier, like FULLSYNC
+                await self._receive_delta(
+                    reader, parser, size=as_int(items[1]),
+                    repl_last=as_int(items[2]),
+                    buckets=as_int(items[3]) if len(items) > 3 else 0)
+                applier.resync()
+            elif kind == DIGEST:
+                if not getattr(self.app, "delta_sync", True):
+                    # CONSTDB_DELTA_SYNC=0 kills the responder leg too:
+                    # we did not advertise CAP_DELTA_SYNC, so a
+                    # conforming peer never asks — but a nonconforming
+                    # one must not make us pay the O(keyspace) fold the
+                    # operator switched off (it times out into its full-
+                    # snapshot fallback)
+                    log.warning("digest question from %s ignored: "
+                                "CONSTDB_DELTA_SYNC=0", self.meta.addr)
+                    continue
+                if self._stream_lock.locked():
+                    # our own push loop is mid raw-payload window on this
+                    # writer: the answer would be dropped anyway (see
+                    # _answer_digest's final check, which still guards
+                    # the race where the lock is taken during the flush
+                    # below) — skip EARLY, before paying the applier
+                    # flush and the O(keyspace) digest fold just to
+                    # discard the result
+                    log.warning("digest question from %s skipped: local "
+                                "push loop is mid-stream (peer will "
+                                "demote to full sync)", self.meta.addr)
+                    continue
+                # the peer's push loop is asking where we diverge: the
+                # answer must cover every frame already intaken, so land
+                # them first (digest-over-pending would flag buckets the
+                # pending flush is about to fix)
+                await applier.aflush()
+                await self._answer_digest(items, writer)
+            elif kind == DIGESTACK:
+                # reply to OUR push loop's digest question (bridged)
+                if self._digest_acks is not None and len(items) >= 4:
+                    self._digest_acks.put_nowait(items)
             elif kind == PARTSYNC:
                 pass  # stream continues from our requested resume point
             else:
                 raise CstError(f"unknown repl frame {kind!r}")
+
+    async def _answer_digest(self, items: list, writer) -> None:
+        """Answer one of the peer's digest questions (the puller leg of
+        the delta anti-entropy protocol): compare the received digests
+        against this node's own and reply with the mismatching shard ids
+        (level 0) / flat bucket indices (level 1).  The level-0 matrix is
+        CACHED for the round so both levels compare one consistent state
+        cut — anything landing in between is either ours (the peer does
+        not need to send it) or will redeliver through the stream."""
+        from ..store.digest import MAX_BUCKETS
+        token = as_int(items[1])
+        level = as_int(items[2])
+        fanout = as_int(items[3])
+        leaves = as_int(items[4])
+        if fanout < 1 or leaves < 1 or fanout * leaves > MAX_BUCKETS or \
+                len(items) < 6:
+            raise CstError(f"bad digest geometry from {self.meta.addr}: "
+                           f"{fanout}x{leaves}")
+        cache_key = (token, fanout, leaves)
+        if level in (0, 1):
+            cached = self._digest_cache
+            if cached is not None and cached[0] == cache_key:
+                matrix = cached[1]
+            else:
+                matrix = await self._local_digest(fanout, leaves)
+                self._digest_cache = (cache_key, matrix)
+        if level == 0:
+            theirs = np.frombuffer(as_bytes(items[5]), dtype="<u8")
+            if len(theirs) != fanout:
+                raise CstError(f"digest rollup size mismatch from "
+                               f"{self.meta.addr}")
+            mine = matrix.sum(axis=1, dtype=np.uint64)
+            reply = np.nonzero(mine != theirs)[0].astype("<i8").tobytes()
+            if not reply:
+                # every rollup matched: the peer skips level 1, so this
+                # round is over — release the matrix now instead of
+                # pinning up to 32MB on the long-lived link until the
+                # next negotiation
+                self._digest_cache = None
+        elif level == 1 and len(items) >= 7:
+            shards = np.frombuffer(as_bytes(items[5]), dtype="<i8")
+            sub = np.frombuffer(as_bytes(items[6]), dtype="<u8")
+            if len(sub) != len(shards) * leaves or \
+                    (len(shards) and (int(shards.min()) < 0 or
+                                      int(shards.max()) >= fanout)):
+                raise CstError(f"digest refinement shape mismatch from "
+                               f"{self.meta.addr}")
+            shards64 = shards.astype(np.int64)
+            mine = matrix[shards64]
+            srow, leaf = np.nonzero(mine != sub.reshape(len(shards),
+                                                        leaves))
+            reply = (shards64[srow] * leaves + leaf).astype("<i8").tobytes()
+            self._digest_cache = None  # matrix rounds complete
+        elif level == 2 and len(items) >= 7:
+            # per-key stamp refinement: which of the peer's listed keys
+            # actually differ here (store/digest.py KeyStampTable)
+            crcs = np.frombuffer(as_bytes(items[5]),
+                                 dtype="<u4").astype(np.uint64)
+            stamps = np.frombuffer(as_bytes(items[6]), dtype="<u8")
+            if len(crcs) != len(stamps):
+                raise CstError(f"key-stamp table shape mismatch from "
+                               f"{self.meta.addr}")
+            if self.node.serve_plane is not None:
+                # sharded puller: per-key stamps would need a worker
+                # fan-out — select every offered key instead (exactly
+                # the whole-bucket byte cost, still convergent: the
+                # re-merge of an equal key is idempotent)
+                sel = np.arange(len(crcs), dtype=np.int64)
+            else:
+                self.node.ensure_flushed()
+                from ..store.digest import stamp_mismatch_indices
+                sel = stamp_mismatch_indices(self.node.ks, crcs, stamps)
+            reply = sel.astype("<i4").tobytes()
+        else:
+            raise CstError(f"unknown digest level {level} from "
+                           f"{self.meta.addr}")
+        if self._stream_lock.locked():
+            # our own push loop is mid raw-payload window on this
+            # writer.  Blocking here could cross-deadlock two symmetric
+            # resyncs (each side streaming, each pull loop parked on its
+            # lock, nobody reading); drop the answer instead — the
+            # peer's negotiation times out and demotes to a full
+            # snapshot, the designed-safe fallback.
+            log.warning("digest answer to %s dropped: local push loop "
+                        "is mid-stream (peer will demote to full sync)",
+                        self.meta.addr)
+            return
+        self._write(writer, encode_msg(Arr([
+            Bulk(DIGESTACK), Int(token), Int(level), Bulk(reply)])))
+        # no drain() here ON PURPOSE: the pull loop is this connection's
+        # only reader, and parking it on flow control while the peer's
+        # pull loop is symmetrically parked on ITS ack (two simultaneous
+        # resyncs whose level-2 acks both exceed the socket buffers)
+        # deadlocks the pair — neither side reads, neither drain ever
+        # completes.  The ack is one bounded frame the negotiating peer
+        # reads promptly; the transport buffers it in the meantime.
 
     async def _receive_snapshot(self, reader, parser, size: int,
                                 repl_last: int, reset: bool = False) -> None:
@@ -491,28 +949,7 @@ class ReplicaLink:
         snapshot like a fresh node."""
         path = os.path.join(self.app.work_dir,
                             f"snapshot.{self.meta.addr.replace(':', '_')}")
-        loop = asyncio.get_running_loop()
-        # spill-file open/close off-loop (ASYNC-BLOCK): close flushes the
-        # buffered tail to disk, which on a loaded disk blocks for real;
-        # the per-piece writes land in the page cache between awaits
-        f = await loop.run_in_executor(None, open, path, "wb")
-        try:
-            remaining = size
-            while remaining > 0:
-                got = parser.take_raw(min(remaining, _READ_CHUNK))
-                if not got:
-                    got = await reader.read(min(remaining, _READ_CHUNK))
-                    if not got:
-                        raise ConnectionError("EOF during snapshot download")
-                    self._count_in(len(got))
-                f.write(got)
-                remaining -= len(got)
-        finally:
-            try:
-                await loop.run_in_executor(None, f.close)
-            except asyncio.CancelledError:
-                f.close()  # teardown path: close inline rather than leak
-                raise
+        await self._download_spill(reader, parser, size, path)
         node = self.node
         if reset:
             log.warning("peer %s demands a state-clearing resync (we were "
@@ -525,19 +962,75 @@ class ReplicaLink:
             # THIS stream stays valid: the snapshot below + the gap-free
             # frames that follow it re-establish our pull position
             self._epoch = node.reset_epoch
+        applied_rows, replica_rows = await self._apply_spill(path, size)
+        self._finish_sync(path, applied_rows, replica_rows, repl_last,
+                          "snapshot")
+
+    async def _receive_delta(self, reader, parser, size: int,
+                             repl_last: int, buckets: int) -> None:
+        """Apply a digest-negotiated delta stream: the divergent
+        buckets' whole state in snapshot format, merged through the same
+        chunk-streamed path a full snapshot takes (merges are
+        idempotent/commutative, so bucket-scoped re-merges are plain
+        merges).  Watermark + replica-record adoption follow the same
+        snapshot-backed discipline (_finish_sync): after the merge our
+        state covers everything the pusher had at `repl_last`, because
+        every bucket whose digests disagreed was just streamed and every
+        bucket whose digests agreed already held identical state."""
+        path = os.path.join(self.app.work_dir,
+                            f"delta.in.{self.meta.addr.replace(':', '_')}")
+        await self._download_spill(reader, parser, size, path)
+        applied_rows, replica_rows = await self._apply_spill(path, size)
+        self._finish_sync(path, applied_rows, replica_rows, repl_last,
+                          f"delta ({buckets} buckets)")
+
+    async def _download_spill(self, reader, parser, size: int,
+                              path: str) -> None:
+        """Download `size` raw stream bytes to a spill file."""
+        loop = asyncio.get_running_loop()
+        # spill-file open/close off-loop (ASYNC-BLOCK): close flushes the
+        # buffered tail to disk, which on a loaded disk blocks for real;
+        # the per-piece writes land in the page cache between awaits
+        f = await loop.run_in_executor(None, open, path, "wb")
+        try:
+            remaining = size
+            while remaining > 0:
+                got = parser.take_raw(min(remaining, _READ_CHUNK))
+                if not got:
+                    got = await reader.read(min(remaining, _READ_CHUNK))
+                    if not got:
+                        raise ConnectionError("EOF during sync download")
+                    self._count_in(len(got))
+                f.write(got)
+                remaining -= len(got)
+        finally:
+            try:
+                await loop.run_in_executor(None, f.close)
+            except asyncio.CancelledError:
+                f.close()  # teardown path: close inline rather than leak
+                raise
+
+    async def _apply_spill(self, path: str, size: int):
+        """Merge a downloaded snapshot-format spill file through
+        whichever apply machinery this node runs — the serve plane
+        (workers ARE the store), the process-parallel sharded ingest, or
+        the plain chunk-streamed path.  -> (applied_rows, replica_rows)."""
+        node = self.node
         if node.serve_plane is not None:
             # shard-per-core node: sections fan out to the serve workers
             # by key hash (server/serve_shards.py) — they ARE the store
-            applied_rows, replica_rows = \
-                await self._apply_snapshot_via_plane(path)
-        elif (shards := self.app.snapshot_ingest_shards(size)) > 1:
+            return await self._apply_snapshot_via_plane(path)
+        if (shards := self.app.snapshot_ingest_shards(size)) > 1:
             log.info("sharded snapshot ingest from %s: %d bytes over %d "
                      "shard workers", self.meta.addr, size, shards)
-            applied_rows, replica_rows = \
-                await self._apply_snapshot_sharded(path, shards)
-        else:
-            applied_rows, replica_rows = \
-                await self._apply_snapshot_plain(path)
+            return await self._apply_snapshot_sharded(path, shards)
+        return await self._apply_snapshot_plain(path)
+
+    def _finish_sync(self, path: str, applied_rows: int, replica_rows,
+                     repl_last: int, what: str) -> None:
+        """Post-apply bookkeeping shared by full and delta syncs: the
+        stream just re-based us to the pusher's state at `repl_last`."""
+        node = self.node
         if replica_rows:
             # transitive mesh join (reference pull.rs:136-153) + watermark
             # adoption, now that the state backing them is fully merged
@@ -547,7 +1040,7 @@ class ReplicaLink:
         if repl_last > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = repl_last
         node.hlc.observe(repl_last)
-        log.info("loaded snapshot from %s: %d rows", self.meta.addr,
+        log.info("loaded %s from %s: %d rows", what, self.meta.addr,
                  applied_rows)
         try:
             os.unlink(path)

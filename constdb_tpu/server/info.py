@@ -93,6 +93,13 @@ def _section_stats(node, out):
     out.append(("repl_frames_coalesced", st.repl_frames_coalesced))
     out.append(("repl_coalesce_flushes", st.repl_coalesce_flushes))
     out.append(("repl_apply_barriers", st.repl_apply_barriers))
+    # anti-entropy resyncs this node pushed: digest-negotiated deltas
+    # vs full snapshots (replica/link.py; the demotion counter rides
+    # `extra` as repl_delta_demotions, with shard ids in the log)
+    out.append(("repl_delta_syncs", st.repl_delta_syncs))
+    out.append(("repl_delta_bytes", st.repl_delta_bytes))
+    out.append(("repl_full_syncs", st.repl_full_syncs))
+    out.append(("repl_digest_rounds", st.repl_digest_rounds))
     # client-serving coalescing (server/serve.py), mirroring the repl_*
     # trio above; the latency percentiles come from the sampled
     # plan→land ring (CONSTDB_SERVE_LAT_SAMPLE)

@@ -51,7 +51,11 @@ class ServerApp:
                  apply_batch: Optional[int] = None,
                  apply_latency: Optional[float] = None,
                  serve_batch: Optional[int] = None,
-                 serve_shards: Optional[int] = None):
+                 serve_shards: Optional[int] = None,
+                 delta_sync: Optional[bool] = None,
+                 delta_max_divergence: Optional[float] = None,
+                 delta_bucket_keys: Optional[int] = None,
+                 delta_stamp_min: Optional[int] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -107,6 +111,28 @@ class ServerApp:
         # path, byte for byte.
         self.serve_shards = env_int("CONSTDB_SERVE_SHARDS", 1) \
             if serve_shards is None else serve_shards
+        # digest-driven delta resync (replica/link.py _send_delta, wire
+        # frames digest/digestack/deltasync): enabled by default — a
+        # peer without CAP_DELTA_SYNC still gets the exact full-sync
+        # byte stream.  delta_max_divergence = bucket-mismatch fraction
+        # past which the pusher demotes to a full snapshot;
+        # delta_bucket_keys = target keys per digest leaf bucket (finer
+        # buckets localize random divergence at the cost of a larger
+        # digest matrix — 8-byte hash per bucket, on the wire once per
+        # refined shard).
+        from ..conf import env_flag, env_float
+        self.delta_sync = env_flag("CONSTDB_DELTA_SYNC", True) \
+            if delta_sync is None else delta_sync
+        self.delta_max_divergence = \
+            env_float("CONSTDB_DELTA_MAX_DIVERGENCE", 0.5) \
+            if delta_max_divergence is None else delta_max_divergence
+        self.delta_bucket_keys = env_int("CONSTDB_DELTA_BUCKET_KEYS", 8) \
+            if delta_bucket_keys is None else delta_bucket_keys
+        # per-key stamp refinement floor: below this many keys in the
+        # divergent buckets the level-2 exchange (~12B/listed key) can
+        # cost more than the whole-bucket payload it would trim
+        self.delta_stamp_min = env_int("CONSTDB_DELTA_STAMP_MIN", 4096) \
+            if delta_stamp_min is None else delta_stamp_min
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -449,11 +475,11 @@ class ServerApp:
             # membership through full syncs (pull.rs:136-153), which leaves
             # hub-and-spoke topologies permanently partitioned
             node.execute([Bulk(b"meet"), Bulk(peer_addr.encode())])
-        from ..replica.link import MY_CAPS
+        from ..replica.link import my_caps
         writer.write(encode_msg_arr([
             Bulk(SYNC), Int(1), Int(node.node_id), Bulk(node.alias.encode()),
             Bulk(self.advertised_addr.encode()), Int(meta.uuid_he_sent),
-            Int(MY_CAPS)]))
+            Int(my_caps(self))]))
         link = meta.link if isinstance(meta.link, ReplicaLink) else \
             ReplicaLink(self, meta)
         link.adopt(reader, writer, parser, peer_resume, peer_caps=peer_caps)

@@ -42,6 +42,13 @@ class NodeStats:
     repl_frames_coalesced: int = 0
     repl_coalesce_flushes: int = 0
     repl_apply_barriers: int = 0
+    # anti-entropy resyncs SENT by this node's push legs
+    # (replica/link.py): digest-negotiated deltas vs full snapshots,
+    # the delta payload bytes that replaced them, and digest rounds run
+    repl_delta_syncs: int = 0
+    repl_delta_bytes: int = 0
+    repl_full_syncs: int = 0
+    repl_digest_rounds: int = 0
     # client-serving coalescing (server/serve.py): pipelined client
     # commands folded into columnar micro-batches, batches landed,
     # commands that acted as ordered barriers (reads / non-plannable

@@ -330,6 +330,38 @@ class ServeShardPlane:
         payloads = await self.pool.call_all("export")
         return [_decode_batch(p) for p in payloads]
 
+    async def key_count(self) -> int:
+        """Live key total across the workers (delta-sync leaf sizing,
+        replica/link.py _send_delta).  Asked of the workers directly:
+        the `serve_shard<i>_keys` stat gauges only update on serve-chunk
+        acks and catch-up ingests, so a node whose state arrived purely
+        via the replication stream would size its digest from zero and
+        collapse the leaf granularity."""
+        return sum(await self.pool.call_all("n_keys"))
+
+    async def state_digest(self, fanout: int, leaves: int):
+        """The plane's (fanout, leaves) anti-entropy digest matrix
+        (replica/link.py delta sync): each worker folds ITS disjoint key
+        set over the negotiated crc32 partition and the parent sums the
+        matrices — the fold is an unordered sum, so plane-wide = Σ
+        per-worker whatever the worker count (store/digest.py)."""
+        from ..store.digest import sum_matrices
+        mats = await self.pool.call_all("digest", fanout, leaves)
+        return sum_matrices(mats, fanout, leaves).astype("<u8")
+
+    async def export_bucket_payloads(self, fanout: int, leaves: int,
+                                     mask, chunk_keys: int = 1 << 16
+                                     ) -> list:
+        """Encoded BATCH-section chunks of the masked digest buckets'
+        state, from every worker (the delta-sync stream's payload —
+        written as-is via SnapshotWriter.write_chunk_raw, no parent-side
+        decode/re-encode)."""
+        import numpy as np
+        parts = await self.pool.call_all(
+            "digest_export", fanout, leaves,
+            np.asarray(mask, dtype=bool).tobytes(), chunk_keys)
+        return [p for chunks in parts for p in chunks]
+
     async def canonical(self, keys=None) -> dict:
         if keys is None:
             parts = await self.pool.call_all("canonical", None)

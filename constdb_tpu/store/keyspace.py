@@ -33,6 +33,8 @@ crdt/semantics.py; bulk merge goes through engine/ (MergeEngine boundary).
 from __future__ import annotations
 
 import heapq
+import threading
+import zlib
 from typing import Iterator, Optional
 
 import numpy as np
@@ -130,6 +132,23 @@ class KeySpace:
         # compaction slipped in between.
         self.el_compact_epoch = 0
 
+        # incremental crc32 caches for the anti-entropy digest
+        # (store/digest.py): key/member bytes are hashed ONCE, in append
+        # order, by key_crcs()/member_crcs() — the per-item Python cost
+        # of a digest exchange is amortized over the row's lifetime
+        # instead of re-paid per exchange.  _compact_elements drops the
+        # member cache (row ids change); keys are never re-identified.
+        self._key_crc: Optional[np.ndarray] = None
+        self._key_crc_n = 0
+        self._member_crc: Optional[np.ndarray] = None
+        self._member_crc_n = 0
+        # serializes the crc cache grow-and-fill: warm_digest_caches
+        # runs in an executor thread while the event loop may sync the
+        # same caches inline (digest refinement on another link) —
+        # unserialized, interleaved (cache, n) field writes could pair
+        # a small-capacity array with a larger synced count
+        self._crc_lock = threading.Lock()
+
         # key-level tombstone record for snapshot DELETES + GC
         # (parity: reference db.rs `deletes` map)
         self.key_deletes: dict[bytes, int] = {}
@@ -226,6 +245,71 @@ class KeySpace:
         if m.any():
             out[m] = self.keys.dt[kids[m]]
         return out
+
+    @staticmethod
+    def _crc_sync(cache: Optional[np.ndarray], synced: int, n: int,
+                  items) -> tuple[np.ndarray, int]:
+        """Grow-and-fill helper for the incremental crc caches: crc32 the
+        items appended since the last sync into a uint64 cache array."""
+        if cache is None or len(cache) < n:
+            cap = 1 << max(n - 1, 1023).bit_length()
+            new = np.zeros(cap, dtype=np.uint64)
+            if cache is not None and synced:
+                new[:synced] = cache[:synced]
+            cache = new
+        if synced < n:
+            crc = zlib.crc32
+            cache[synced:n] = np.fromiter(
+                (crc(b) if b is not None else 0
+                 for b in items[synced:n]),
+                dtype=np.uint64, count=n - synced)
+        return cache, n
+
+    def key_crcs(self) -> np.ndarray:
+        """crc32 of every key's bytes, kid-aligned (the digest partition
+        — store/digest.py).  Maintained incrementally in append order:
+        each key is hashed once over its lifetime, not once per digest
+        exchange."""
+        n = self.keys.n
+        with self._crc_lock:
+            self._key_crc, self._key_crc_n = self._crc_sync(
+                self._key_crc, self._key_crc_n, n, self.key_bytes)
+            return self._key_crc[:n]
+
+    def member_crcs(self) -> np.ndarray:
+        """crc32 of every element row's member bytes, row-aligned (0 for
+        GC-dead rows, which digests exclude anyway).  Incremental like
+        key_crcs; element compaction re-identifies rows and drops the
+        cache (_compact_elements)."""
+        n = self.el.n
+        with self._crc_lock:
+            epoch = self.el_compact_epoch
+            cache, cn = self._crc_sync(
+                self._member_crc, self._member_crc_n, n, self.el_member)
+            if self.el_compact_epoch != epoch:
+                # an element compaction interleaved this pass — only
+                # possible off-loop (warm_digest_caches in an executor;
+                # inline callers run on the loop, where compaction can't
+                # preempt).  Rows were re-identified under us: drop the
+                # pass instead of storing a misaligned cache (the warm
+                # caller discards the return; the next inline sync
+                # rebuilds from the compacted columns).
+                self._member_crc = None
+                self._member_crc_n = 0
+                return np.zeros(0, dtype=np.uint64)
+            self._member_crc, self._member_crc_n = cache, cn
+            return self._member_crc[:n]
+
+    def warm_digest_caches(self) -> None:
+        """Fill the incremental digest crc caches — safe to run in an
+        executor thread while the event loop serves (replica/link.py
+        _local_digest warms off-loop so the FIRST digest on a long-lived
+        store doesn't stall the loop on the per-item crc32 backlog over
+        every key and member).  Inline syncs serialize on _crc_lock; an
+        element compaction interleaving the member pass is ordered by
+        the same lock (see _compact_elements / member_crcs)."""
+        self.key_crcs()
+        self.member_crcs()
 
     def enc_of(self, kid: int) -> int:
         return int(self.keys.enc[kid])
@@ -719,7 +803,17 @@ class KeySpace:
         reuse: row ids must stay stable BETWEEN compactions so the batched
         engine's staged row indices never alias)."""
         self.touch("el")  # row ids change: resident device mirrors are stale
-        self.el_compact_epoch += 1
+        # row ids are about to change: the digest's member-crc cache is
+        # row-aligned and must rebuild from the compacted columns.  The
+        # lock orders this against an off-loop warm_digest_caches pass:
+        # either the warm stored its cache first (we drop it here) or it
+        # observes the epoch bump and drops its own pass — never a
+        # misaligned cache surviving.  Worst case this waits out one
+        # in-flight warm (gc-triggered compaction, background path).
+        with self._crc_lock:
+            self.el_compact_epoch += 1
+            self._member_crc = None
+            self._member_crc_n = 0
         n = self.el.n
         live = np.nonzero(self.el.kid[:n] >= 0)[0]
         # row-id stability accounting: rows only die through gc() (which

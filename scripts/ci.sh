@@ -49,6 +49,25 @@ print("serve-shards smoke verified:",
 EOF
 
 echo
+echo "== resync smoke (bench --mode resync) =="
+# tiny oracle-verified run of the digest-negotiated delta resync vs the
+# full-snapshot leg through the REAL push loop: both pullers must
+# converge to the pusher's canonical export + full-state digest at
+# every divergence fraction (the differential suite proper runs inside
+# tier-1 — tests/test_delta_sync.py)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_RESYNC_KEYS=20000 \
+CONSTDB_BENCH_RESYNC_VERIFY=5000 CONSTDB_BENCH_RESYNC_FRACS=0.01 \
+    timeout -k 10 300 python bench.py --mode resync \
+    > /tmp/_ci_resync.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_resync.json"))
+assert out["verified"], "resync smoke failed oracle verification"
+print("resync smoke verified:",
+      [(leg["frac"], leg["bytes_ratio"]) for leg in out["curve"]])
+EOF
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

@@ -17,8 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from test_link_pushloop import _Writer, _log_write, _mk_link  # noqa: E402
 
 from constdb_tpu.persist.snapshot import ReplicaRecord  # noqa: E402
-from constdb_tpu.replica.link import (CAP_FULLSYNC_RESET, FULLSYNC,  # noqa: E402
-                                      MY_CAPS)
+from constdb_tpu.replica.link import (CAP_DELTA_SYNC,  # noqa: E402
+                                      CAP_FULLSYNC_RESET, FULLSYNC, MY_CAPS)
 from constdb_tpu.replica.manager import ReplicaManager  # noqa: E402
 from constdb_tpu.resp.codec import make_parser  # noqa: E402
 from constdb_tpu.resp.message import Arr, Bulk, Int, as_bytes, as_int  # noqa: E402
@@ -118,6 +118,43 @@ def test_plain_fullsync_keeps_reset_zero(tmp_path):
     asyncio.run(main())
 
 
+def test_legacy_peer_gets_exact_prepr_fullsync_stream(tmp_path):
+    """Mixed-version pin for CAP_DELTA_SYNC: an off-ring catch-up against
+    a peer WITHOUT the bit writes not one digest frame — the wire stream
+    is the exact pre-delta byte layout (FULLSYNC header + the snapshot
+    dump's bytes, reset=0), so a legacy peer never sees a frame kind it
+    cannot parse."""
+    async def main():
+        node, app, link = _off_ring_link(tmp_path, needs_full=False,
+                                         peer_caps=CAP_FULLSYNC_RESET)
+        assert not (link._peer_caps & CAP_DELTA_SYNC)
+        writer = _Writer()
+        task = asyncio.create_task(link._push_loop(writer, peer_resume=0))
+        try:
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                if _fullsync_reset_flags(writer.buf):
+                    break
+        finally:
+            task.cancel()
+        st = node.stats
+        assert st.repl_digest_rounds == 0
+        assert st.repl_delta_syncs == 0
+        assert st.repl_full_syncs == 1
+        assert _fullsync_reset_flags(writer.buf) == [0]
+        # byte-exact: the stream opens with the FULLSYNC header followed
+        # by the dump file's bytes, nothing negotiated in between
+        with open(os.path.join(str(tmp_path), "dump1.snapshot"),
+                  "rb") as f:
+            dump = f.read()
+        from constdb_tpu.resp.codec import encode_msg
+        header = encode_msg(Arr([Bulk(FULLSYNC), Int(len(dump)),
+                                 Int(node.repl_log.last_uuid), Int(0)]))
+        want = header + dump
+        assert bytes(writer.buf[:len(want)]) == want
+    asyncio.run(main())
+
+
 def test_check_sync_reply_parses_caps(tmp_path):
     node, app, link = _mk_link(tmp_path)
     reply = Arr([Bulk(b"sync"), Int(1), Int(7), Bulk(b"peer"),
@@ -148,6 +185,9 @@ def test_caps_exchanged_end_to_end(tmp_path):
                     break
             assert len(links) >= 2
             assert all(lk._peer_caps == MY_CAPS for lk in links)
+            # the delta-sync bit is part of the exchanged mask on both
+            # sides — the partial-resync path is negotiable mesh-wide
+            assert all(lk._peer_caps & CAP_DELTA_SYNC for lk in links)
             await c.close()
         finally:
             await close_cluster(apps)

@@ -347,11 +347,11 @@ def test_partial_resync_after_restart(tmp_path):
                              heartbeat=0.15, reconnect_delay=0.25)
             await app2.start()
             apps[1] = app2
-            full_before = apps[0].node.stats.extra.get("full_syncs_sent", 0)
+            full_before = apps[0].node.stats.repl_full_syncs
             await converge(apps, timeout=20.0)
             c2 = await Client().connect(app2.advertised_addr)
             assert await c2.cmd("get", "cnt") == Int(20)
-            assert apps[0].node.stats.extra.get("full_syncs_sent", 0) == \
+            assert apps[0].node.stats.repl_full_syncs == \
                 full_before, "partial resync must not dump a snapshot"
             await c1.close()
             await c2.close()
@@ -381,7 +381,7 @@ def test_full_resync_after_log_eviction(tmp_path):
             await app2.start()
             apps[1] = app2
             await converge(apps, timeout=20.0)
-            assert apps[0].node.stats.extra.get("full_syncs_sent", 0) >= 1
+            assert apps[0].node.stats.repl_full_syncs >= 1
             await c1.close()
         finally:
             await close_cluster(apps)
@@ -519,7 +519,7 @@ def test_full_sync_stream_is_compressed(tmp_path):
                 await c.cmd("meet", b.advertised_addr)
                 await converge(apps, timeout=20.0)
                 sizes[level] = a.node.stats.extra["last_snapshot_bytes"]
-                assert a.node.stats.extra.get("full_syncs_sent", 0) >= 1
+                assert a.node.stats.repl_full_syncs >= 1
                 got = await c.cmd("get", "key:000399")
                 assert got == Bulk(b"v" * 128)
                 await c.close()
