@@ -103,6 +103,21 @@ def host_fingerprint() -> dict:
     }
 
 
+def engine_counters(engine) -> dict:
+    """Device-transfer gauges stamped into every JSON line that has an
+    engine in reach (the residency metrics BENCH_r12 and the v5e
+    follow-up round read; zeros for host-only engines)."""
+    return {
+        "dev_upload_bytes": getattr(engine, "bytes_h2d", 0),
+        "dev_download_bytes": getattr(engine, "bytes_d2h", 0),
+        "dev_rounds_resident": getattr(engine, "dev_rounds_resident", 0),
+        "host_micro_rounds": getattr(engine, "host_micro_rounds", 0),
+        "flush_rows_downloaded": getattr(engine, "flush_rows_downloaded", 0),
+        "flush_rows_full_equiv": getattr(engine, "flush_rows_full_equiv", 0),
+        "pallas_broken": bool(getattr(engine, "_pallas_broken", False)),
+    }
+
+
 def _uuids(rng, n, span_ms=600_000):
     # float-scaled draws: ~5x faster than bounded-integer rejection
     # sampling at the 10M scale (this is workload GENERATION — outside the
@@ -492,6 +507,83 @@ def replay_stream(frames, make_engine, apply_batch: int,
     return node, end - t0, lat
 
 
+def stream_resident_legs(args, frames, n_keys, apply_batch, latency_s,
+                         backend, note) -> None:
+    """`--resident 0,1` stream legs: interleaved best-of-3 replays of the
+    SAME frame log through a device-resident engine (steady in-place
+    micro merges) vs the host-path engine (resident=0 routes micro
+    batches to engine/hostbatch), each oracle-verified against the
+    per-frame CPU replay, with per-leg transfer counters (BENCH_r12)."""
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+
+    legs = [int(x) for x in str(args.resident).split(",")]
+    # CONSTDB_BENCH_FOLD carries the kernel-backend forcing into the leg
+    # engines (ci.sh runs the resident smoke under pallas-interpret)
+    fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
+    best = {r: (float("inf"), None) for r in legs}
+    base_wall, base_node = float("inf"), None
+    for _ in range(3):
+        for r in legs:
+            n_, w_, _ = replay_stream(
+                frames,
+                # steady FORCED per leg: the auto default only engages
+                # over a real accelerator, and this leg measures the
+                # path itself (the host note flags the CPU-box caveat)
+                lambda: TpuMergeEngine(resident=bool(r), steady=bool(r),
+                                       dense_fold=fold),
+                apply_batch=apply_batch, latency_s=latency_s)
+            if w_ < best[r][0]:
+                best[r] = (w_, n_)
+        bn_, bw_, _ = replay_stream(frames, CpuMergeEngine,
+                                    apply_batch=1, latency_s=1.0)
+        if bw_ < base_wall:
+            base_node, base_wall = bn_, bw_
+    want = base_node.canonical()
+    curve = []
+    verified = True
+    for r in legs:
+        w_, n_ = best[r]
+        diffs = compare_canonical(n_.canonical(), want)
+        verified = verified and diffs == 0
+        leg = {"resident": r, "wall_s": round(w_, 3),
+               "fps": round(len(frames) / w_, 1),
+               "coalesce_flushes": n_.stats.repl_coalesce_flushes,
+               "apply_barriers": n_.stats.repl_apply_barriers,
+               "diffs": diffs}
+        leg.update(engine_counters(n_.engine))
+        curve.append(leg)
+        print(f"[bench] resident={r}: {w_:.3f}s = {leg['fps']:,.0f} "
+              f"frames/s; dev rounds {leg['dev_rounds_resident']}, host "
+              f"rounds {leg['host_micro_rounds']}, flush rows "
+              f"{leg['flush_rows_downloaded']}/"
+              f"{leg['flush_rows_full_equiv']}, h2d "
+              f"{leg['dev_upload_bytes']:,} d2h "
+              f"{leg['dev_download_bytes']:,} "
+              f"({'OK' if diffs == 0 else 'MISMATCH'})", file=sys.stderr)
+        if hasattr(n_.engine, "close"):
+            n_.engine.close()
+    base_fps = len(frames) / base_wall
+    out = {
+        "metric": "stream_apply_frames_per_sec",
+        "value": curve[-1]["fps"],
+        "unit": "frames/sec",
+        "mode": "stream",
+        "frames": len(frames),
+        "stream_keys": n_keys,
+        "apply_batch": apply_batch,
+        "per_frame_baseline_fps": round(base_fps, 1),
+        "resident_curve": curve,
+        "backend": backend,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
+
+
 def stream_main(args) -> None:
     """`bench.py --mode stream`: coalesced steady-state apply vs the
     exact per-frame path (CONSTDB_APPLY_BATCH=1 degenerate), replaying
@@ -538,6 +630,11 @@ def stream_main(args) -> None:
 
         backend = jax.default_backend()
         make_engine = TpuMergeEngine
+
+    if args.resident is not None:
+        stream_resident_legs(args, frames, n_keys, apply_batch, latency_s,
+                             backend, note)
+        return
 
     # both paths replay the SAME log, interleaved, best-of-3 (the same
     # convention the snapshot bench uses — one unlucky run on a shared
@@ -594,6 +691,7 @@ def stream_main(args) -> None:
         "verified": verified,
         "host": host_fingerprint(),
     }
+    out.update(engine_counters(node.engine))
     if note:
         out["note"] = note
     eng = getattr(node, "engine", None)
@@ -1429,6 +1527,100 @@ def resync_main(args) -> None:
         sys.exit(1)
 
 
+def snapshot_resident_legs(args, chunks, batches, n_keys, n_rep, group,
+                           fold, oracle, verify_on, cpu_rate, note) -> None:
+    """`--resident 0,1` snapshot legs: interleaved best-of-2 catch-up
+    merges of the SAME chunk stream through a device-resident engine
+    (state persists across chunk merges, one flush at the end) vs the
+    non-resident engine (per-round state upload + download), both
+    oracle-verified, with per-leg transfer counters (BENCH_r12).
+    Single-keyspace path only (the process pool pins resident=True)."""
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+    from constdb_tpu.store.sharded_keyspace import ShardedKeySpace
+
+    legs = [int(x) for x in str(args.resident).split(",")]
+    stores = {}
+    walls = {r: float("inf") for r in legs}
+    for _ in range(2):
+        for r in legs:
+            sks = stores.get(r)
+            if sks is None:
+                sks = stores[r] = ShardedKeySpace(
+                    n_shards=1, group=group,
+                    engine_factory=lambda rr=r: TpuMergeEngine(
+                        resident=bool(rr), dense_fold=fold))
+            sks.reset()
+            t0 = time.perf_counter()
+            for c in chunks:
+                sks.submit(c)
+            sks.flush()
+            walls[r] = min(walls[r], time.perf_counter() - t0)
+    want = None
+    oracle_err = None
+    if verify_on and oracle is not None:
+        try:
+            oracle[1].send("go")
+        except OSError as e:
+            oracle_err = str(e) or type(e).__name__
+    curve = []
+    verified = None
+    sub_keys = subsample_keys(batches[0].keys, n_keys) if verify_on else None
+    if verify_on and oracle is not None and oracle_err is None:
+        p, rx = oracle
+        try:
+            want = rx.recv()
+        except (EOFError, OSError) as e:
+            want = None
+            oracle_err = str(e) or type(e).__name__
+        finally:
+            p.join()
+    for r in legs:
+        sks = stores[r]
+        secs = sks.host_secs_per_shard()[0]
+        leg = {"resident": r, "wall_s": round(walls[r], 3),
+               "keys_per_sec": round(n_keys / walls[r], 1),
+               "dev_upload_bytes": secs.get("bytes_h2d", 0),
+               "dev_download_bytes": secs.get("bytes_d2h", 0),
+               "dev_rounds_resident": secs.get("dev_rounds_resident", 0),
+               "host_micro_rounds": secs.get("host_micro_rounds", 0),
+               "flush_rows_downloaded":
+                   secs.get("flush_rows_downloaded", 0),
+               "flush_rows_full_equiv":
+                   secs.get("flush_rows_full_equiv", 0),
+               "folds": secs.get("folds", 0)}
+        if want is not None and not isinstance(want, Exception):
+            diffs = compare_canonical(sks.canonical(keys=sub_keys), want)
+            leg["diffs"] = diffs
+            verified = (verified is not False) and diffs == 0
+        curve.append(leg)
+        print(f"[bench] resident={r}: {walls[r]:.3f}s = "
+              f"{leg['keys_per_sec']:,.0f} keys/s; h2d "
+              f"{leg['dev_upload_bytes']:,} d2h "
+              f"{leg['dev_download_bytes']:,}"
+              + (f" ({leg['diffs']} diffs)" if "diffs" in leg else ""),
+              file=sys.stderr)
+        sks.close() if hasattr(sks, "close") else None
+    out = {
+        "metric": "snapshot_merge_keys_per_sec",
+        "value": curve[-1]["keys_per_sec"],
+        "unit": "keys/sec",
+        "mode": "snapshot",
+        "keys": n_keys,
+        "replicas": n_rep,
+        "vs_baseline": round(curve[-1]["keys_per_sec"] / cpu_rate, 2),
+        "resident_curve": curve,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    if oracle_err is not None:
+        out["verify_error"] = oracle_err
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
+    if verified is False:
+        sys.exit(1)
+
+
 def main() -> None:
     import argparse
 
@@ -1450,6 +1642,11 @@ def main() -> None:
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
+    ap.add_argument("--resident", default=None,
+                    help="snapshot/stream modes: comma list of 0|1 legs "
+                    "(e.g. 0,1) — interleaves device-resident vs "
+                    "host-path engine legs and records per-leg transfer "
+                    "counters (BENCH_r12)")
     ap.add_argument("--serve-shards", default=None,
                     help="serve mode: comma list of shard counts (e.g. "
                     "1,2) — runs the shard-per-core scaling curve "
@@ -1524,12 +1721,19 @@ def main() -> None:
     from constdb_tpu.engine.tpu import TpuMergeEngine
     import jax
     # persistent compile cache: state shapes recur across runs (pow2-padded),
-    # so repeated bench invocations skip the ~0.7 s/kernel XLA compiles
+    # so repeated bench invocations skip the ~0.7 s/kernel XLA compiles.
+    # NEVER under a forced interpret backend: an interpret-mode pallas_call
+    # lowers through per-process python callbacks, and a cache-reloaded
+    # executable resolves a STALE callback id — the kernel silently runs
+    # the wrong python body and corrupts merge output (caught by the
+    # resident smoke's oracle: rep 1 verified, rep 2 garbage)
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("CONSTDB_JAX_CACHE",
-                                         "/tmp/constdb_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        if "interpret" not in os.environ.get("CONSTDB_BENCH_FOLD", "auto"):
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ.get("CONSTDB_JAX_CACHE",
+                                             "/tmp/constdb_jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.1)
     except Exception:
         pass
     print(f"[bench] jax backend: {jax.default_backend()} "
@@ -1548,6 +1752,10 @@ def main() -> None:
     fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
     from constdb_tpu.store.sharded_keyspace import (ShardedKeySpace,
                                                     default_shards)
+    if args.resident is not None:
+        snapshot_resident_legs(args, chunks, batches, n_keys, n_rep, group,
+                               fold, oracle, verify_on, cpu_rate, note)
+        return
     shards = args.shards if args.shards is not None else default_shards()
     # every run goes through the sharded keyspace facade: shards == 1 is
     # the degenerate single-keyspace path (byte-identical to driving the

@@ -130,6 +130,15 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_DELTA_STAMP_MIN", "4096",
            "min keys in the divergent buckets before the per-key stamp "
            "refinement round runs (below it, whole buckets stream)"),
+    EnvVar("CONSTDB_RESIDENT", "auto",
+           "steady-state device residency: a resident engine merges "
+           "op-stream micro-batches in place against the resident "
+           "planes; auto = only over a real (non-CPU) backend, "
+           "1 = force on, 0 = always the host micro strategy"),
+    EnvVar("CONSTDB_RESIDENT_WARMUP", "2",
+           "consecutive micro rounds a plane's host version must stay "
+           "stable before its device mirror uploads (cold planes merge "
+           "on host meanwhile)"),
 )}
 
 
@@ -289,7 +298,13 @@ def build_engine(kind: str):
         if probe.ok and probe.platform != "cpu":
             try:
                 from .engine.tpu import TpuMergeEngine
-                return TpuMergeEngine()
+                # resident: per-family device state persists across merge
+                # rounds — the steady-state engine of round 12 (op-stream
+                # micro-batches merge in place per CONSTDB_RESIDENT, and
+                # bulk catch-up pays row uploads only, never a state
+                # round-trip per chunk); Node.ensure_flushed syncs before
+                # every host read
+                return TpuMergeEngine(resident=True)
             except Exception:
                 # device vanished between probe and real init
                 if kind in ("tpu", "tpu!"):
@@ -312,7 +327,9 @@ def build_engine(kind: str):
             force_cpu_platform()
             try:
                 from .engine.tpu import TpuMergeEngine
-                eng = TpuMergeEngine()
+                eng = TpuMergeEngine(resident=True)  # see the healthy
+                # branch above; steady residency still gates on
+                # CONSTDB_RESIDENT=auto, which stays host-side on CPU
                 eng.degraded = f"tpu requested, running XLA-on-CPU: {reason}"
                 return eng
             except Exception:

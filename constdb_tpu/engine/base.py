@@ -106,6 +106,18 @@ class MergeStats:
     type_conflicts: int = 0
     counter_rows: int = 0
     elem_rows: int = 0
+    # device-transfer accounting for THIS call (engine/tpu.py fills them
+    # from its cumulative counters; host-only engines leave zeros).
+    # dev_rounds_resident counts micro rounds merged in place against
+    # resident device planes — the steady-state residency signal the
+    # bench legs and the v5e acceptance criterion read.
+    dev_upload_bytes: int = 0
+    dev_download_bytes: int = 0
+    dev_rounds_resident: int = 0
+    # rows a flush actually downloaded during this call (auto-flushes);
+    # the engine's cumulative attribute of the same name covers explicit
+    # flush() calls too
+    flush_rows_downloaded: int = 0
 
     def __iadd__(self, other: "MergeStats") -> "MergeStats":
         self.keys_seen += other.keys_seen
@@ -113,6 +125,10 @@ class MergeStats:
         self.type_conflicts += other.type_conflicts
         self.counter_rows += other.counter_rows
         self.elem_rows += other.elem_rows
+        self.dev_upload_bytes += other.dev_upload_bytes
+        self.dev_download_bytes += other.dev_download_bytes
+        self.dev_rounds_resident += other.dev_rounds_resident
+        self.flush_rows_downloaded += other.flush_rows_downloaded
         return self
 
 

@@ -50,13 +50,54 @@ def _group_first(sorted_keys: np.ndarray) -> np.ndarray:
     return np.nonzero(np.append(True, sorted_keys[1:] != sorted_keys[:-1]))[0]
 
 
-def _merge_env(store: KeySpace, kids: np.ndarray, mat: np.ndarray) -> None:
-    """Envelope plane: per-column max over (possibly repeated) kids."""
+# ------------------------------------------------- duplicate-slot folds
+# A raw op-stream batch may hit the same slot many times; every reduction
+# below folds those duplicates to one winner per slot with the exact
+# associative rule from crdt/semantics.py, so "fold then merge once"
+# equals "apply in order".  These are THE shared fold implementations:
+# the host strategies below use them in place, and the resident device
+# path (engine/tpu.py micro merges) folds with the very same functions
+# before scattering the unique winners into resident planes.
+
+
+def fold_env_rows(kids: np.ndarray, mat: np.ndarray):
+    """-> (unique kids, [U, 4] per-column max)."""
     order = np.argsort(kids, kind="stable")
     k_s = kids[order]
     first = _group_first(k_s)
-    uniq = k_s[first]
-    red = np.maximum.reduceat(mat[order], first, axis=0)
+    return k_s[first], np.maximum.reduceat(mat[order], first, axis=0)
+
+
+def fold_pair_rows(rows: np.ndarray, primary: np.ndarray,
+                   secondary: np.ndarray):
+    """Lexicographic (primary, secondary) max per row group ->
+    (unique rows, win primary, win secondary, winning source index).
+    Registers fold (t, node); counter pairs fold (uuid, val) /
+    (base_t, base)."""
+    order = np.lexsort((secondary, primary, rows))
+    r_s = rows[order]
+    last = _group_last(r_s)
+    src = order[last]
+    return r_s[last], primary[src], secondary[src], src
+
+
+def fold_el_rows(rows: np.ndarray, at: np.ndarray, an: np.ndarray,
+                 dt: np.ndarray):
+    """Element fold: add side = lexicographic (add_t, add_node) winner,
+    del side = plain max -> (unique rows, win add_t, win add_node,
+    max del_t, winning source index)."""
+    order = np.lexsort((an, at, rows))
+    r_s = rows[order]
+    first = _group_first(r_s)
+    last = _group_last(r_s)
+    src = order[last]
+    return (r_s[last], at[src], an[src],
+            np.maximum.reduceat(dt[order], first), src)
+
+
+def _merge_env(store: KeySpace, kids: np.ndarray, mat: np.ndarray) -> None:
+    """Envelope plane: per-column max over (possibly repeated) kids."""
+    uniq, red = fold_env_rows(kids, mat)
     keys = store.keys
     for i, name in enumerate(("ct", "mt", "dt", "expire")):
         col = keys.col(name)
@@ -69,13 +110,7 @@ def _merge_reg(store: KeySpace, kids: np.ndarray, t: np.ndarray,
                node: np.ndarray, vals: list) -> None:
     """Register plane: lexicographic (t, node) LWW; the winner carries
     its value (semantics.merge_register)."""
-    order = np.lexsort((node, t, kids))
-    k_s = kids[order]
-    last = _group_last(k_s)
-    wk = k_s[last]
-    wt = t[order][last]
-    wn = node[order][last]
-    src = order[last]
+    wk, wt, wn, src = fold_pair_rows(kids, t, node)
     cur_t = store.keys.rv_t[wk]
     cur_n = store.keys.rv_node[wk]
     win = (wt > cur_t) | ((wt == cur_t) & (wn > cur_n))
@@ -126,12 +161,7 @@ def _apply_cnt_pair(store: KeySpace, rows: np.ndarray, vals: np.ndarray,
     time tie — semantics.merge_counter_slot), with the incremental
     per-key sum delta (`sign`: +1 for the total pair, -1 for the base
     pair, mirroring KeySpace.counter_merge_slot)."""
-    order = np.lexsort((vals, ts, rows))
-    r_s = rows[order]
-    last = _group_last(r_s)
-    wr = r_s[last]
-    wv = vals[order][last]
-    wt = ts[order][last]
+    wr, wt, wv, _src = fold_pair_rows(rows, ts, vals)
     cv = store.cnt.col(vcol)
     ct = store.cnt.col(tcol)
     cur_v = cv[wr]
@@ -192,14 +222,7 @@ def _merge_el(store: KeySpace, rows: np.ndarray, at: np.ndarray,
     """Element plane: add-side lexicographic (t, node) LWW carrying the
     value, del-side plain max, newly-dead rows queued for GC
     (semantics.merge_elem / KeySpace.elem_merge)."""
-    order = np.lexsort((an, at, rows))
-    r_s = rows[order]
-    first = _group_first(r_s)
-    last = _group_last(r_s)
-    wr = r_s[last]
-    wat = at[order][last]
-    wan = an[order][last]
-    d_red = np.maximum.reduceat(dt[order], first)
+    wr, wat, wan, d_red, win_src = fold_el_rows(rows, at, an, dt)
     old_at = store.el.add_t[wr]
     old_an = store.el.add_node[wr]
     old_dt = store.el.del_t[wr]
@@ -220,7 +243,7 @@ def _merge_el(store: KeySpace, rows: np.ndarray, at: np.ndarray,
     vsel = win & val_enc
     if vsel.any():
         el_val = store.el_val
-        src = order[last][vsel]
+        src = win_src[vsel]
         if vals is None:
             for r in wr[vsel].tolist():
                 el_val[r] = None

@@ -24,9 +24,23 @@ the Node triggers before any command touches the numeric plane
 (dict fields / register bytes) resolve through a device src plane at
 flush — no per-call win-flag download; value bytes live only on the host.
 
-Batches whose rows are NOT unique per slot (raw op streams) always take the
-scatter path — its reductions tolerate intra-batch collisions; the bulk
-kernels require `rows_unique_per_slot` (one scatter per slot per call).
+**Steady state** (round 12): op-stream micro-batches — the
+serve/replication coalescers' flushes, previously always routed to the
+host micro strategy — merge IN PLACE against the resident planes too
+(`_merge_micro_resident`): duplicate slots fold on host with the shared
+hostbatch reductions, unique winners scatter once per family
+(ops/pallas_dense.py `scatter_pair_src` or its XLA twin), the env plane
+stays host-authoritative, and `flush()` downloads only the rows touched
+since the last flush (dirty-row accounting; counter sums update
+incrementally or re-derive via the device `segment_sum`).  This inverts
+HOST_SCATTER_MAX into a FALLBACK threshold — per family for cold planes
+(`_micro_placement`), whole-round only when the steady path is off
+(CONSTDB_RESIDENT=0, non-resident engines, mesh-partitioned state).
+
+Bulk batches whose rows are NOT unique per slot (raw op streams) above the
+micro ceiling take the scatter path — its reductions tolerate intra-batch
+collisions; the bulk kernels require `rows_unique_per_slot` (one scatter
+per slot per call).
 
 Must be semantically bit-identical to engine/cpu.py — differential-tested in
 tests/test_engine_equivalence.py and tests/test_resident_engine.py.
@@ -171,12 +185,23 @@ class TpuMergeEngine:
     # win-source pool ids live in an int32 device plane; merge_many flushes
     # before staging a round that could cross this (tests lower it)
     POOL_ID_CEILING = 1 << 31
+    # pow2 pad FLOORS for the steady micro path: batch/dirty vectors pad
+    # up to these before the pow2 round, so the jitted scatter/gather
+    # kernels re-trace per PLANE CAP only, not per batch-size bucket —
+    # per-shape tracing dominated small-stream walls, while scattering/
+    # gathering a few hundred padded rows costs microseconds on any
+    # backend.  (Scatter pads engage only while a free pad row exists —
+    # see _micro_scatter_pair.)
+    MICRO_SCATTER_PAD = 256
+    FLUSH_GATHER_PAD = 512
     # staging order = dispatch order = the on-store plane contract
     FAM_ORDER = ("env", "reg", "cnt", "el")
 
     def __init__(self, resident: bool = False, mesh=None,
                  dense_fold: str = "auto",
-                 pipeline: Optional[bool] = None) -> None:
+                 pipeline: Optional[bool] = None,
+                 steady: Optional[bool] = None,
+                 warmup: Optional[int] = None) -> None:
         """`mesh`: an optional jax.sharding.Mesh with a "kv" axis.  When
         given, per-slot device state range-partitions over that axis
         (NamedSharding P("kv")) while batch rows replicate — GSPMD then
@@ -193,6 +218,22 @@ class TpuMergeEngine:
         TPU backends, XLA dense kernels (ops/dense.py) elsewhere; "pallas"
         / "pallas-interpret" / "xla" force a backend; "off" disables
         folding.  Both backends are differential-tested bit-identical.
+
+        `steady`: device-resident STEADY-STATE path — op-stream
+        micro-batches (the serve/replication coalescers' flushes) merge
+        IN PLACE against the resident device planes instead of falling
+        back to the host micro strategy; flushes then download only the
+        rows those merges touched (dirty-row accounting).  This is the
+        routing inversion that makes HOST_SCATTER_MAX a FALLBACK
+        threshold: the host micro path runs only when the engine is not
+        resident, a mesh partitions the state, or — per family — a
+        touched plane is COLD (no warm mirror and the plane's host
+        version has not been stable for `warmup` consecutive micro
+        rounds — op-path writes between rounds would otherwise force a
+        full mirror re-upload per round).  None = CONSTDB_RESIDENT:
+        "auto" (default) engages only over a real non-CPU backend, "1"
+        forces on, "0" off; `warmup` defaults to
+        CONSTDB_RESIDENT_WARMUP (2).
 
         `pipeline`: double-buffered merge dispatch.  Each CRDT family's
         work splits into STAGE (pure host prep: columnarization, slot
@@ -229,12 +270,37 @@ class TpuMergeEngine:
         # time — staging overlapped with device compute shows up there
         # while family_secs shrinks to the un-overlapped remainder.
         self.family_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0,
-                            "flush": 0.0, "host": 0.0}
+                            "flush": 0.0, "host": 0.0, "micro": 0.0}
         self.stage_secs = {"env": 0.0, "reg": 0.0, "cnt": 0.0, "el": 0.0}
         from ..conf import env_flag, env_int
         if pipeline is None:
             pipeline = env_flag("CONSTDB_PIPELINE", True)
         self.pipeline = bool(pipeline)
+        # steady-state residency (see __init__ docstring): micro rounds
+        # merged in place on device vs routed to the host fallback, and
+        # the flush download accounting the acceptance criterion reads.
+        # "auto" (the default) engages only over a REAL accelerator: on
+        # a CPU-only backend the "device" IS the host, so in-place
+        # XLA-CPU scatters just add dispatch overhead over the numpy
+        # micro strategy — the healthy-device clause of the routing
+        # inversion.  Tests/bench legs force steady=True to exercise the
+        # path on CPU builders.
+        if steady is None:
+            from ..conf import env_str
+            mode = env_str("CONSTDB_RESIDENT", "auto")
+            steady = jax.default_backend() != "cpu" if mode == "auto" \
+                else mode != "0"
+        self.steady = bool(steady)
+        self.warmup = env_int("CONSTDB_RESIDENT_WARMUP", 2) \
+            if warmup is None else int(warmup)
+        self._warm_streak: dict[str, tuple[int, int]] = {}
+        self.dev_rounds_resident = 0
+        self.host_micro_rounds = 0
+        self.flush_rows_downloaded = 0
+        # rows a whole-plane flush WOULD have downloaded at the same
+        # points — the denominator that proves partial, not full,
+        # downloads (bench legs report both)
+        self.flush_rows_full_equiv = 0
         self._stage_ex = None          # lazy single-worker staging executor
         self._stage_pending = None     # in-flight stage futures (flush joins)
         self._pallas_broken = False
@@ -451,7 +517,23 @@ class TpuMergeEngine:
         """Fold any number of columnar batches into the store.  Reductions
         are associative + commutative, so all batches merge in one device
         pass per CRDT family — and the same properties license the
-        pipelined stage/dispatch overlap (see __init__)."""
+        pipelined stage/dispatch overlap (see __init__).
+
+        The returned MergeStats carries this call's device-transfer
+        deltas (dev_upload_bytes / dev_download_bytes /
+        dev_rounds_resident / flush_rows_downloaded) sliced out of the
+        engine's cumulative counters."""
+        h0, d0 = self.bytes_h2d, self.bytes_d2h
+        r0, f0 = self.dev_rounds_resident, self.flush_rows_downloaded
+        st = self._merge_many_impl(store, batches)
+        st.dev_upload_bytes = self.bytes_h2d - h0
+        st.dev_download_bytes = self.bytes_d2h - d0
+        st.dev_rounds_resident = self.dev_rounds_resident - r0
+        st.flush_rows_downloaded = self.flush_rows_downloaded - f0
+        return st
+
+    def _merge_many_impl(self, store: KeySpace,
+                         batches: list[ColumnarBatch]) -> MergeStats:
         st = MergeStats()
         # the bulk path scatters each slot once per batch, which is only a
         # merge if slots are unique within every batch
@@ -486,22 +568,54 @@ class TpuMergeEngine:
             resolved.append((b, kid_of))
         if not self._unique_ok and self._mesh is None and \
                 sum(b.n_rows for b in batches) <= self.HOST_SCATTER_MAX:
-            # third placement strategy: op-stream micro-batches (the
-            # steady-state coalescer's flushes) merge on host — the
-            # duplicate-tolerant scatter path's device round-trips cost
-            # more than the merge at this scale.  Any resident mirror of
-            # the touched planes syncs down first, exactly like the
-            # device scatter path would via _drop_family.
+            # op-stream micro-batches (the steady-state coalescers'
+            # flushes).  DEFAULT placement for a resident engine: fold
+            # each batch's duplicate slots on host (a few hundred rows)
+            # and scatter-merge the unique winners IN PLACE against the
+            # resident device planes — state never round-trips, and the
+            # next flush downloads only the touched (dirty) rows.  The
+            # host micro strategy (engine/hostbatch.py) is the FALLBACK,
+            # per family (cold planes — see _micro_placement) or for the
+            # whole round (non-resident engines, CONSTDB_RESIDENT=0,
+            # mesh-partitioned state).
+            import time as _time
+            placement = self._micro_placement(store, resolved)
+            if placement is not None:
+                t0 = _time.perf_counter()
+                for b, kid_of in resolved:
+                    self._merge_micro_resident(store, b, kid_of, st,
+                                               placement)
+                if any(placement.values()):
+                    self.dev_rounds_resident += 1
+                elif placement:
+                    self.host_micro_rounds += 1
+                # empty placement (env-only / delete-only round): neither
+                # gauge — no device family was touched at all
+                self.family_secs["micro"] += _time.perf_counter() - t0
+                if self.needs_flush and \
+                        self._pool_bytes > self.pool_flush_bytes:
+                    self.flush(store)
+                return st
+            # legacy whole-round fallback (steady path off): any resident
+            # mirror of the touched planes syncs down first, exactly like
+            # the device scatter path would via _drop_family
             from .hostbatch import merge_host_batch
             for fam in list(self._res):
                 self._drop_family(store, fam)
-            import time as _time
+            self.host_micro_rounds += 1
             t0 = _time.perf_counter()
             for b, kid_of in resolved:
                 merge_host_batch(store, b, kid_of, st)
             self.family_secs["host"] += _time.perf_counter() - t0
             return st
         import time as _time
+        # a src-tracked pool from resident MICRO rounds must resolve
+        # before a bulk branch that does not track src (forced dense_fold
+        # configs skip the src kernels) scatters into the same planes —
+        # flush would otherwise assign stale pool values over the bulk
+        # round's winners
+        if self.resident and self._pool_size and not self._host_combine():
+            self.flush(store)
         # the fold/no-fold decision is STAGED (the [R, N] stack builds it
         # gates are host work that belongs on the staging pool, not the
         # dispatch critical path) but _fold_backend reads device state
@@ -623,47 +737,85 @@ class TpuMergeEngine:
         mode only; a no-op otherwise).  Also re-derives counter sums and
         enqueues element tombstones whose del_t advanced on device.
 
+        Dirty-row accounting: a family whose merges since the last flush
+        were all resident MICRO rounds carries an explicit dirty-row set —
+        only those rows are gathered on device (ops/bulk.py gather_rows)
+        and downloaded; whole-plane downloads happen only for bulk
+        catch-up merges (dirty=None) that really did touch the plane
+        wholesale, and an untouched family costs nothing.  Counter sums
+        update INCREMENTALLY over the dirty rows (old-vs-new contribution
+        delta) instead of the O(table) recompute.
+
         Download protocol: EVERY family's downloads dispatch up front
-        (device-side [:n] slice so padding never crosses the link;
-        copy_to_host_async overlaps transfers), then families are consumed
-        one at a time — family f's host-side application (column writes,
-        src resolution, tombstone scans) runs while the remaining
-        families' transfers are still in flight, and each consumed device
-        slice is dropped immediately so its buffer frees without waiting
-        for the whole flush."""
+        (device-side [:n] slice / dirty-row gather so padding and
+        untouched rows never cross the link; copy_to_host_async overlaps
+        transfers), then families are consumed one at a time — family f's
+        host-side application (column writes, src resolution, tombstone
+        scans) runs while the remaining families' transfers are still in
+        flight, and each consumed device slice is dropped immediately so
+        its buffer frees without waiting for the whole flush."""
         if not self.needs_flush:
             return
         self._join_staging()
         import time as _time
         t0 = _time.perf_counter()
         pending: dict[str, dict] = {}
+        partial: dict[str, tuple] = {}  # fam -> (rows_d, {name: dev}, src)
         for fam, res in self._res.items():
             n = res["n"]
             if n == 0:
                 continue
+            dirty = res.get("dirty")
+            if dirty is not None and not dirty:
+                continue  # untouched since the last flush: host == device
             cols = res["cols"]
             names = ["stack"] if fam == "env" else \
                 [name for name, _ in _FAMILIES[fam]]
             written = res.get("written")
             recon = res.get("recon") if res.get("src") is not None else None
-            fp: dict = {}
-            for name in names:
-                if written is not None and name not in written:
-                    continue  # mirror column never scattered into: the
-                    # host column it was built from is still exact
-                if recon and name in recon:
-                    continue  # winner-carried column: reconstructed on host
-                    # from the win pool via the (int32) src plane — the
-                    # int64 column itself never crosses the link
-                fp[name] = cols[name][:n]
-            if res.get("src") is not None:
-                fp["src"] = res["src"][:n]
-            if fp:
-                pending[fam] = fp
+            want = [name for name in names
+                    # mirror column never scattered into: the host column
+                    # it was built from is still exact
+                    if not (written is not None and name not in written)
+                    # winner-carried column: reconstructed on host from
+                    # the win pool via the (int32) src plane — the int64
+                    # column itself never crosses the link
+                    and not (recon and name in recon)]
+            self.flush_rows_full_equiv += n
+            if dirty is None:
+                fp = {name: cols[name][:n] for name in want}
+                if res.get("src") is not None:
+                    fp["src"] = res["src"][:n]
+                if fp:
+                    pending[fam] = fp
+                    self.flush_rows_downloaded += n
+                continue
+            rows_d = np.unique(np.concatenate(dirty))
+            # pow2-padded gather idx (pad rows re-gather row 0 and are
+            # sliced off after download): with the FLUSH_GATHER_PAD
+            # floor, the gather jit re-traces per plane cap only
+            np2 = K.next_pow2(max(len(rows_d), self.FLUSH_GATHER_PAD))
+            idx_dev = self._put_batch(_pad(rows_d.astype(_I32), np2, 0))
+            g = {name: B.gather_rows(cols[name], idx_dev) for name in want}
+            src_dev = B.gather_rows(res["src"], idx_dev) \
+                if res.get("src") is not None else None
+            partial[fam] = (rows_d, g, src_dev)
+            self.flush_rows_downloaded += len(rows_d)
         for fp in pending.values():
             for arr in fp.values():
                 try:
                     arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+        for _rows_d, g, src_dev in partial.values():
+            for arr in g.values():
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+            if src_dev is not None:
+                try:
+                    src_dev.copy_to_host_async()
                 except AttributeError:
                     pass
 
@@ -701,6 +853,50 @@ class TpuMergeEngine:
                 self._enqueue_elem_garbage(store, np.arange(n),
                                            table.add_t[:n], table.del_t[:n],
                                            old_dt)
+            # host now equals device for the whole plane: later flushes
+            # skip this family until new merges dirty it again
+            res["dirty"] = []
+
+        for fam, (rows_d, g, src_dev) in partial.items():
+            res = self._res[fam]
+            table = _host_table(store, fam)
+            nd = len(rows_d)
+            if fam == "cnt":
+                # incremental sum delta needs the PRE-flush host
+                # contributions of exactly the dirty rows
+                old_contrib = store.cnt.val[rows_d] - store.cnt.base[rows_d]
+            host = {}
+            for name in list(g):
+                h = np.asarray(g.pop(name))[:nd]
+                self.bytes_d2h += int(h.nbytes)
+                host[name] = h
+            if fam == "env":
+                out = host.get("stack")
+                if out is not None:
+                    for i, (name, _) in enumerate(_FAMILIES["env"]):
+                        table.col(name)[rows_d] = out[:, i]
+            else:
+                for name, _ in _FAMILIES[fam]:
+                    if name in host:
+                        table.col(name)[rows_d] = host[name]
+            if src_dev is not None:
+                src_h = np.asarray(src_dev)[:nd]
+                self.bytes_d2h += int(src_h.nbytes)
+                self._apply_src(store, fam, src_h, res, rows=rows_d)
+                res["src"] = None
+            if fam == "cnt":
+                new_contrib = store.cnt.val[rows_d] - store.cnt.base[rows_d]
+                delta = new_contrib - old_contrib
+                changed = np.nonzero(delta)[0]
+                if len(changed):
+                    np.add.at(store.keys.cnt_sum,
+                              store.cnt.kid[rows_d[changed]],
+                              delta[changed])
+            # el del side is host-maintained on the micro path; its GC
+            # entries ride _el_del_touched below
+            res["written"] = set()
+            res["dirty"] = []
+
         if self._el_del_touched:
             # host-maintained del side (el src path): with add_t now
             # reconstructed, queue rows that ended up dead.  old_dt=-1:
@@ -714,8 +910,13 @@ class TpuMergeEngine:
         self._val_pool.clear()
         self._pool_size = 0
         self._pool_bytes = 0
-        if "cnt" in self._res and self._res["cnt"]["n"]:
-            store.recompute_counter_sums()
+        # host val/base mutate ONLY through the two consume loops above:
+        # a whole-plane cnt flush re-derives every sum (device segment-sum
+        # when the backend supports it), the dirty path already applied
+        # its incremental deltas, and an untouched cnt mirror left the
+        # sums exact from the previous flush
+        if "cnt" in pending and self._res["cnt"]["n"]:
+            self._recompute_sums(store)
         self.needs_flush = False
         self.family_secs["flush"] += _time.perf_counter() - t0
 
@@ -732,18 +933,26 @@ class TpuMergeEngine:
         self.needs_flush = False
 
     def _apply_src(self, store: KeySpace, fam: str, src_h: np.ndarray,
-                   res: dict) -> None:
+                   res: dict, rows: Optional[np.ndarray] = None) -> None:
         """Consume the downloaded src plane: (a) RECONSTRUCT the
         winner-carried int64 columns from the host pool (bit-identical to
         the device state by construction — the kernels set column and src
         under the same win predicate), and (b) assign deferred win VALUES
-        (set rows — valueless by construction — are skipped wholesale)."""
-        n = len(src_h)
+        (set rows — valueless by construction — are skipped wholesale).
+
+        `rows`: table rows src_h's positions map to (the dirty-row
+        partial flush downloads a GATHERED src slice); None = src_h is
+        the whole plane and positions ARE table rows."""
         rows_all = np.nonzero(src_h >= 0)[0]
         if not len(rows_all):
             return
         pool = self._val_pool
         gids_all = src_h[rows_all].astype(_I64)
+        if rows is not None:
+            # sorted-unique dirty rows: positions map through in order,
+            # so rows_all stays strictly ascending (the contiguity fast
+            # path below still holds)
+            rows_all = rows[rows_all]
         if len(pool) == 1:
             # single staged segment (fully combined round): skip the
             # segment sort entirely
@@ -781,7 +990,7 @@ class TpuMergeEngine:
             vmask = np.ones(len(rows_all), dtype=bool)
             target = store.reg_val
         else:
-            vmask = np.isin(store.keys.enc[store.el.kid[:n]][rows_all],
+            vmask = np.isin(store.keys.enc[store.el.kid[rows_all]],
                             S.VALUE_ENCS)
             target = store.el_val
         for s, lo, hi in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
@@ -859,10 +1068,15 @@ class TpuMergeEngine:
         else:
             cols = res["cols"]
             cap = res["cap"]
+        # `dirty`/`recon` survive a reuse/grow (the micro path appends
+        # touched rows between flushes); a fresh build starts CLEAN
+        # (dirty=[] — host == device at build, nothing to download)
         self._res[fam] = {"cols": cols, "n": n, "cap": cap, "ver": ver,
                           "src": res.get("src") if res else None,
                           "written": res.get("written", set()) if res
-                          else set()}
+                          else set(),
+                          "recon": res.get("recon") if res else None,
+                          "dirty": res.get("dirty") if res else []}
         return cols, cap
 
     def _family_done(self, fam: str, cols: dict, n: int, cap: int,
@@ -890,6 +1104,339 @@ class TpuMergeEngine:
         if fam in self._res:
             self.flush(store)
             del self._res[fam]
+
+    # ------------------------------------------------- resident micro merges
+    # The steady-state placement (the ISSUE 8 routing inversion): op-stream
+    # micro-batches — the serve/replication coalescers' flushes — merge IN
+    # PLACE against the resident device planes instead of falling back to
+    # the host micro strategy.  Duplicate slots fold on host with the exact
+    # shared reductions from engine/hostbatch.py, the unique winners
+    # scatter once per family (Pallas gather-compare-scatter or its XLA
+    # twin, per _pallas_or_xla), the env plane stays HOST-AUTHORITATIVE
+    # (its merge is a collision-free max into host columns — zero device
+    # bytes, and key-dt reads never need a flush), and every scatter's
+    # rows land in the family's dirty set so flush() downloads only them.
+
+    def host_stale(self, families) -> bool:
+        """True when any of `families` holds unflushed device-side merge
+        state (its host columns lag the device).  Callers that provably
+        read only planes OUTSIDE the stale set may skip the flush — the
+        narrow read-barrier Node.ensure_flushed_for exposes to the
+        steady-state coalescers (env is host-authoritative on the micro
+        path, so dt reads cost no round-trip)."""
+        if not self.needs_flush:
+            return False
+        for fam in families:
+            res = self._res.get(fam)
+            if res is not None and (res.get("written")
+                                    or res.get("src") is not None):
+                return True
+        return False
+
+    @staticmethod
+    def _micro_touched(resolved):
+        """Device families a micro round actually merges (env is host-side
+        and never gates the routing decision)."""
+        from ..utils.native_tables import nonnull_mask
+        fams = set()
+        for b, _ in resolved:
+            if "reg" not in fams and b.n_keys and \
+                    nonnull_mask(b.reg_val).any():
+                fams.add("reg")
+            if len(b.cnt_ki):
+                fams.add("cnt")
+            if len(b.el_ki):
+                fams.add("el")
+        return fams
+
+    def _micro_placement(self, store: KeySpace, resolved):
+        """Per-family steady-state routing: {fam: True=device in-place,
+        False=host twin} over the device families this round touches —
+        or None when the steady path is off entirely (the legacy
+        whole-round host fallback, pre-round-12 behavior).  Families
+        route INDEPENDENTLY: CRDT planes are independent by construction
+        (the same property that licenses the stage/dispatch overlap), so
+        a cold el plane — its version just bumped by a barrier op —
+        merges on its host twin while a warm cnt plane keeps merging in
+        place.  Warm = mirror already resident and fresh, or host
+        version stable for more than `warmup` consecutive micro rounds
+        (mixed op/merge traffic would otherwise re-upload a full mirror
+        every round just to merge a few hundred rows into it)."""
+        if not (self.steady and self.resident):
+            return None
+        placement = {}
+        for fam in self._micro_touched(resolved):
+            res = self._res.get(fam)
+            ver = store.fam_ver[fam]
+            if res is not None and res.get("ver") == ver:
+                placement[fam] = True  # resident and fresh: free to ride
+                continue
+            last_ver, streak = self._warm_streak.get(fam, (-1, 0))
+            streak = streak + 1 if last_ver == ver else 1
+            self._warm_streak[fam] = (ver, streak)
+            placement[fam] = streak > self.warmup
+        return placement
+
+    def _merge_micro_resident(self, store: KeySpace, b: ColumnarBatch,
+                              kid_of: np.ndarray, st: MergeStats,
+                              placement: dict) -> None:
+        """Merge ONE op-stream micro-batch under the steady placement:
+        warm families scatter in place against resident device planes —
+        the device twin of engine/hostbatch.merge_host_batch, fold for
+        fold (both sides use the very same fold_* reductions, so the
+        scattered winners ARE the host path's winners) — and cold
+        families take their host twins directly.  Differential-tested
+        byte-identical in tests/test_resident_steady.py."""
+        from ..utils.native_tables import nonnull_mask
+        from .hostbatch import (_apply_cnt_pair, _merge_el, _merge_env,
+                                _merge_reg, _resolve_el_rows, fold_el_rows,
+                                fold_pair_rows)
+        if "env" in self._res:
+            # forced-fold catch-ups can leave a device env mirror; the
+            # micro path keeps env host-authoritative, so sync it down
+            # once and merge on host from here on
+            self._drop_family(store, "env")
+        valid = kid_of >= 0
+        all_valid = bool(valid.all())
+        if b.n_keys:
+            kids = kid_of if all_valid else kid_of[valid]
+            if len(kids):
+                mat = np.stack([b.key_ct, b.key_mt, b.key_dt,
+                                b.key_expire], axis=-1)
+                _merge_env(store, kids, mat if all_valid else mat[valid])
+            em = valid & (b.key_enc == S.ENC_BYTES) & \
+                nonnull_mask(b.reg_val)
+            idx = np.nonzero(em)[0]
+            if len(idx):
+                if placement.get("reg"):
+                    wk, wt, wn, srci = fold_pair_rows(
+                        kid_of[idx], b.reg_t[idx], b.reg_node[idx])
+                    vals = list(map(b.reg_val.__getitem__,
+                                    idx[srci].tolist()))
+                    self._micro_scatter_pair(store, "reg",
+                                             ("rv_t", "rv_node"),
+                                             wk, wt, wn, vals)
+                else:
+                    _merge_reg(store, kid_of[idx], b.reg_t[idx],
+                               b.reg_node[idx],
+                               list(map(b.reg_val.__getitem__,
+                                        idx.tolist())))
+
+        if len(b.cnt_ki):
+            kid_arr = kid_of[b.cnt_ki]
+            keep = np.nonzero(kid_arr >= 0)[0]
+            if len(keep):
+                st.counter_rows += len(keep)
+                sel = slice(None) if len(keep) == len(kid_arr) else keep
+                rows = self._resolve_cnt_rows(store, kid_arr[sel],
+                                              b.cnt_node[sel])
+                bt = b.cnt_base_t[sel]
+                base_neutral = bool((bt == K.NEUTRAL_T).all())
+                if placement.get("cnt"):
+                    # (uuid, val) pair: LWW on uuid, max-value tie — the
+                    # winners reconstruct from the pool at flush, so the
+                    # two widest counter columns never download
+                    wr, wu, wv, _ = fold_pair_rows(rows, b.cnt_uuid[sel],
+                                                   b.cnt_val[sel])
+                    self._micro_scatter_pair(store, "cnt", ("uuid", "val"),
+                                             wr, wu, wv, None)
+                    if not base_neutral:
+                        # base pair (counter deletes — rare): no src
+                        # tracking, its dirty rows download at flush
+                        wr2, wbt, wb, _ = fold_pair_rows(rows, bt,
+                                                         b.cnt_base[sel])
+                        self._micro_scatter_pair(store, "cnt",
+                                                 ("base_t", "base"),
+                                                 wr2, wbt, wb, None,
+                                                 src=False)
+                else:
+                    _apply_cnt_pair(store, rows, b.cnt_val[sel],
+                                    b.cnt_uuid[sel], "val", "uuid", 1)
+                    if not base_neutral:
+                        _apply_cnt_pair(store, rows, b.cnt_base[sel], bt,
+                                        "base", "base_t", -1)
+
+        if len(b.el_ki):
+            kid_arr = kid_of[b.el_ki]
+            keep = np.nonzero(kid_arr >= 0)[0]
+            if len(keep):
+                st.elem_rows += len(keep)
+                if len(keep) == len(kid_arr):
+                    sel = slice(None)
+                    members = b.el_member
+                    vals = b.el_val
+                else:
+                    sel = keep
+                    members = list(map(b.el_member.__getitem__,
+                                       keep.tolist()))
+                    vals = list(map(b.el_val.__getitem__, keep.tolist()))
+                rows = _resolve_el_rows(store, kid_arr[sel], members)
+                if not placement.get("el"):
+                    _merge_el(store, rows, b.el_add_t[sel],
+                              b.el_add_node[sel], b.el_del_t[sel], vals)
+                else:
+                    wr, wat, wan, d_red, srci = fold_el_rows(
+                        rows, b.el_add_t[sel], b.el_add_node[sel],
+                        b.el_del_t[sel])
+                    if b.el_has_vals is False or not has_values(vals):
+                        wvals = None  # winning valueless adds still CLEAR
+                        # the slot value at flush (pool vals=None contract)
+                    else:
+                        wvals = list(map(vals.__getitem__, srci.tolist()))
+                    self._micro_scatter_pair(store, "el",
+                                             ("add_t", "add_node"),
+                                             wr, wat, wan, wvals)
+                    # del side: plain max applied straight to the HOST
+                    # column, with the DEVICE del_t plane advanced in
+                    # lockstep (one max scatter, only when the batch
+                    # actually carries deletes — rare in steady state).
+                    # A host-only write would leave the mirror's del_t
+                    # stale-but-"fresh", and a later forced-fold bulk
+                    # round (bulk_elems reads and re-downloads del_t)
+                    # would regress the host column and resurrect the
+                    # deleted elements.  Newly-dead rows queue for GC at
+                    # flush, after add_t reconstruction.
+                    nz = np.flatnonzero(d_red)
+                    if len(nz):
+                        sel_r = wr[nz]
+                        cur = store.el.del_t[sel_r]
+                        dv = d_red[nz]
+                        adv = dv > cur
+                        if adv.any():
+                            rows_adv = sel_r[adv]
+                            dv_adv = dv[adv]
+                            store.el.del_t[rows_adv] = dv_adv
+                            self._el_del_touched.append(rows_adv)
+                            res = self._res["el"]
+                            sp = res["cap"]
+                            np2 = K.next_pow2(max(len(rows_adv),
+                                                  self.MICRO_SCATTER_PAD))
+                            res["cols"]["del_t"] = B.bulk_max1(
+                                res["cols"]["del_t"],
+                                self._batch_idx(rows_adv, 0, sp, np2),
+                                self._put_batch(_pad(dv_adv, np2, 0)))
+
+        for i, key in enumerate(b.del_keys):
+            store.record_key_delete(key, int(b.del_t[i]))
+
+    def _micro_scatter_pair(self, store: KeySpace, fam: str, pair, wr,
+                            wp, ws, vals, src: bool = True) -> None:
+        """Scatter one folded LWW pair in place against `fam`'s resident
+        planes.  `pair` = (primary, secondary) column names; the win rule
+        is lexicographic (primary, secondary) > current — exactly
+        hostbatch's fold rule and ops/bulk._pair_win.  With `src`
+        tracking (default) the winners' pool ids land in the resident
+        src plane: flush downloads the int32 src slice and reconstructs
+        both columns AND win values from the host pool.  src=False (the
+        rare counter base pair) keeps its winner on device and downloads
+        its dirty rows at flush."""
+        nw = len(wr)
+        if not nw:
+            return
+        n = _fam_rows(store, fam)
+        cols, sp = self._resident_state(store, fam, n)
+        pcol, scol = pair
+        # pad-floor the batch length (see MICRO_SCATTER_PAD) — but only
+        # while a free pad-target row exists (nw < sp); a batch covering
+        # every plane row pads to itself (nw == sp == pow2, no pads)
+        np2 = K.next_pow2(nw if nw >= sp
+                          else max(nw, self.MICRO_SCATTER_PAD))
+        p_d, s_d = cols[pcol], cols[scol]
+        if src:
+            src_d = self._src_state(fam, sp)
+            pb = self._pool_add(vals, **{pcol: wp, scol: ws})
+            from ..ops import pallas_dense as PD
+
+            def _pallas(interp):
+                pad = self._scatter_pad_row(wr, nw, sp) if np2 > nw else 0
+                return PD.scatter_pair_src(
+                    p_d, s_d, src_d,
+                    self._put_batch(_pad(wr.astype(_I32), np2, pad)),
+                    self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
+                    self._put_batch(_pad(ws, np2, K.NEUTRAL_T)),
+                    np.int32(pb), interpret=interp)
+
+            def _xla():
+                return B.bulk_lww_src(
+                    p_d, s_d, src_d, self._batch_idx(wr, 0, sp, np2),
+                    self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
+                    self._put_batch(_pad(ws, np2, K.NEUTRAL_T)), pb)
+
+            p2, s2, src2 = self._pallas_or_xla(_pallas, _xla)
+            self._micro_done(fam, {pcol: p2, scol: s2}, src=src2,
+                             recon={pcol: pcol, scol: scol},
+                             written={pcol, scol}, rows=wr)
+        else:
+            p2, s2, _win = B.bulk_lww(
+                p_d, s_d, self._batch_idx(wr, 0, sp, np2),
+                self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
+                self._put_batch(_pad(ws, np2, K.NEUTRAL_T)))
+            self._micro_done(fam, {pcol: p2, scol: s2}, src=None,
+                             recon=None, written={pcol, scol}, rows=wr)
+
+    @staticmethod
+    def _scatter_pad_row(rows: np.ndarray, n: int, sp: int) -> int:
+        """An in-range state row NO real batch row targets (`rows` is
+        sorted unique over [0, sp)): a Pallas pad step re-writes its
+        target from a read that may predate a real step's merge, so a pad
+        aliased onto a real target would silently revert the merge
+        (ops/pallas_dense.py contract; pinned in test_pallas_dense.py).
+        Unique rows over a pow2 plane always leave a free row whenever
+        padding is needed (n < pow2(n) <= sp)."""
+        last = int(rows[n - 1])
+        if last + 1 < sp:
+            return last + 1
+        # rows - iota is non-decreasing; its first step to >= 1 marks the
+        # first absent row
+        d = rows - np.arange(n, dtype=np.int64)
+        return int(np.searchsorted(d, 1))
+
+    def _micro_done(self, fam: str, cols: dict, src, recon,
+                    written: set, rows: np.ndarray) -> None:
+        """Fold a micro scatter's results into the family record: updated
+        device columns, src/recon tracking, written columns, and the
+        touched rows appended to the dirty set (a bulk-merged plane —
+        dirty None — stays whole-plane)."""
+        res = self._res[fam]
+        res["cols"].update(cols)
+        if src is not None:
+            res["src"] = src
+        if recon is not None:
+            res["recon"] = dict(recon) if res.get("recon") is None \
+                else {**res["recon"], **recon}
+        res["written"] |= written
+        if res.get("dirty") is not None:
+            res["dirty"].append(np.asarray(rows))
+        self.needs_flush = True
+
+    def _recompute_sums(self, store: KeySpace) -> None:
+        """Counter-sum re-derivation after a whole-plane cnt flush.  On a
+        Pallas-capable backend the segment-sum runs ON DEVICE over the
+        resident slot contributions (slot kids upload as int32, only the
+        [n_keys] sums download — val/base never cross the link); the
+        host bincount pass covers everything else (the CPU default,
+        where uploading to sum would cost more than it saves).  All
+        paths are exact int64 — bit-identical to
+        KeySpace.recompute_counter_sums."""
+        from ..ops import pallas_dense as PD
+        res = self._res.get("cnt")
+        n = store.cnt.n
+        nk = store.keys.n
+        be = self._fold_backend()
+        if not (be.startswith("pallas") and res is not None
+                and res["n"] == n and n and nk
+                and nk <= PD.SEGMENT_SUM_MAX_SEG):
+            store.recompute_counter_sums()
+            return
+        from ..ops import dense as D
+        cols = res["cols"]
+        ids = self._put_batch(store.cnt.kid[:n].astype(_I32))
+        contrib = cols["val"][:n] - cols["base"][:n]
+        sums = self._pallas_or_xla(
+            lambda interp: PD.segment_sum(ids, contrib, n_seg=nk,
+                                          interpret=interp),
+            lambda: D.segment_sum(ids, contrib, n_seg=nk))
+        store.keys.cnt_sum[:nk] = np.asarray(self._device_get(sums))
 
     # ------------------------------------------------------- key resolution
 
@@ -1054,69 +1601,70 @@ class TpuMergeEngine:
             return "xla"
         return "pallas" if self._jax.default_backend() != "cpu" else "xla"
 
-    def _fold_lex(self, t_s, n_s, d_s):
-        """[R, N] stacks -> per-slot lexicographic (t, n) winner, max d,
-        winning batch row: (t[N], n[N], d[N], win_batch[N]) on device."""
+    def _pallas_or_xla(self, pallas_fn, xla_fn):
+        """ONE home for kernel-backend resolution: run `pallas_fn(interpret)`
+        when the resolved backend is a Pallas variant, falling back to
+        `xla_fn()` — permanently (self._pallas_broken) — when the Pallas
+        lowering fails under dense_fold="auto", and re-raising when a
+        Pallas backend was forced.  Every Pallas call site (the three
+        fold kernels, the resident scatter, the segment-sum) routes
+        through here so a new kernel cannot re-grow its own divergent
+        try/except copy."""
         be = self._fold_backend()
         if be.startswith("pallas"):
-            from ..ops import pallas_dense as PD
             try:
-                return PD.merge_elems(
-                    self._put_batch(t_s), self._put_batch(n_s),
-                    self._put_batch(d_s),
-                    interpret=(be == "pallas-interpret"))
+                return pallas_fn(be == "pallas-interpret")
             except Exception:
                 if self.dense_fold != "auto":
                     raise
-                log.warning("pallas fold unavailable; falling back to XLA",
-                            exc_info=True)
+                log.warning("pallas kernel unavailable; falling back to "
+                            "XLA", exc_info=True)
                 self._pallas_broken = True
+        return xla_fn()
+
+    def _fold_lex(self, t_s, n_s, d_s):
+        """[R, N] stacks -> per-slot lexicographic (t, n) winner, max d,
+        winning batch row: (t[N], n[N], d[N], win_batch[N]) on device."""
         from ..ops import dense as D
-        return D.dense_merge_elems(self._put_batch(t_s), self._put_batch(n_s),
-                                   self._put_batch(d_s))
+        from ..ops import pallas_dense as PD
+        return self._pallas_or_xla(
+            lambda interp: PD.merge_elems(
+                self._put_batch(t_s), self._put_batch(n_s),
+                self._put_batch(d_s), interpret=interp),
+            lambda: D.dense_merge_elems(
+                self._put_batch(t_s), self._put_batch(n_s),
+                self._put_batch(d_s)))
 
     def _fold_lww(self, t_s, n_s):
         """[R, N] stacks -> plain (t, node) LWW winner: (t[N], n[N],
         win_batch[N]) on device.  The del side the element kernel wants is
         fabricated ON DEVICE (zeros never cross the host link)."""
-        be = self._fold_backend()
-        if be.startswith("pallas"):
-            from ..ops import pallas_dense as PD
-            try:
-                t_d = self._put_batch(t_s)
-                at, an, _dt, win = PD.merge_elems(
-                    t_d, self._put_batch(n_s),
-                    self._jax.numpy.zeros_like(t_d),
-                    interpret=(be == "pallas-interpret"))
-                return at, an, win
-            except Exception:
-                if self.dense_fold != "auto":
-                    raise
-                log.warning("pallas fold unavailable; falling back to XLA",
-                            exc_info=True)
-                self._pallas_broken = True
         from ..ops import dense as D
-        return D.dense_merge_lww(self._put_batch(t_s), self._put_batch(n_s))
+        from ..ops import pallas_dense as PD
+
+        def _pallas(interp):
+            t_d = self._put_batch(t_s)
+            at, an, _dt, win = PD.merge_elems(
+                t_d, self._put_batch(n_s),
+                self._jax.numpy.zeros_like(t_d), interpret=interp)
+            return at, an, win
+
+        return self._pallas_or_xla(
+            _pallas,
+            lambda: D.dense_merge_lww(self._put_batch(t_s),
+                                      self._put_batch(n_s)))
 
     def _fold_pair(self, v_s, t_s):
         """[R, N] stacks -> per-slot (value @ time) LWW with max-value tie:
         (val[N], t[N]) on device (counter slots — no win flags needed)."""
-        be = self._fold_backend()
-        if be.startswith("pallas"):
-            from ..ops import pallas_dense as PD
-            try:
-                return PD.merge_counters(
-                    self._put_batch(v_s), self._put_batch(t_s),
-                    interpret=(be == "pallas-interpret"))
-            except Exception:
-                if self.dense_fold != "auto":
-                    raise
-                log.warning("pallas fold unavailable; falling back to XLA",
-                            exc_info=True)
-                self._pallas_broken = True
         from ..ops import dense as D
-        return D.dense_merge_counters(self._put_batch(v_s),
-                                      self._put_batch(t_s))
+        from ..ops import pallas_dense as PD
+        return self._pallas_or_xla(
+            lambda interp: PD.merge_counters(
+                self._put_batch(v_s), self._put_batch(t_s),
+                interpret=interp),
+            lambda: D.dense_merge_counters(self._put_batch(v_s),
+                                           self._put_batch(t_s)))
 
     # ------------------------------------------------------------ envelopes
 

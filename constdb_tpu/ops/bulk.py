@@ -39,12 +39,12 @@ import jax.numpy as jnp  # noqa: E402
 
 from ..crdt.semantics import NEUTRAL_T  # noqa: E402
 
-__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
+__all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_max1", "bulk_lww",
            "bulk_counters", "bulk_counters_vu", "bulk_counters_vu_src",
            "bulk_counters_src", "bulk_elems",
            "bulk_lww_src", "bulk_elems_src_nodt", "bulk_elems_nodt",
            "bulk_lww_src_iota", "bulk_counters_vu_src_iota",
-           "bulk_elems_src_nodt_iota"]
+           "bulk_elems_src_nodt_iota", "gather_rows"]
 
 # An element add-side without its del side IS the plain LWW pair — same
 # kernels, no duplicate _pair_win call sites:
@@ -67,6 +67,16 @@ __all__ = ["NEUTRAL_T", "device_full", "bulk_max", "bulk_lww",
 # dominated by exactly these downloads).
 
 
+@jax.jit
+def gather_rows(state, idx):
+    """Compact dirty-row gather: the flush path downloads ONLY the rows a
+    resident plane's merges touched since the last flush — gather them
+    into one contiguous [D] (or [D, C]) buffer on device, then a single
+    small transfer replaces the whole-plane download.  Non-donating: the
+    resident plane stays put."""
+    return jnp.take(state, idx, axis=0)
+
+
 @partial(jax.jit, static_argnames=("n", "fill", "i32"))
 def device_full(n: int, fill: int, i32: bool = False):
     """Neutral state created ON device (avoids uploading zeros when every
@@ -85,6 +95,15 @@ def bulk_max(state, idx, cols):
     """state [Sp, C] ← elementwise max with one batch; idx [Np] int32,
     cols [Np, C].  Envelope merge (ct/mt/dt/expire are all max-merges)."""
     return state.at[idx].max(cols, mode="drop", unique_indices=True)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def bulk_max1(state, idx, vals):
+    """One-column twin of bulk_max: state [Sp] ← per-slot max (the
+    element DEL plane on the resident micro path — the host column and
+    the device mirror advance together so a later bulk round never
+    merges against a stale device del_t)."""
+    return state.at[idx].max(vals, mode="drop", unique_indices=True)
 
 
 

@@ -63,3 +63,11 @@ def dense_merge_lww(t, n):
 def dense_max(cols):
     """[R, S, C] pointwise max over R — envelopes."""
     return cols.max(axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_seg",))
+def segment_sum(ids, vals, n_seg: int):
+    """Per-segment int64 sums over unsorted segment ids — the XLA twin of
+    ops/pallas_dense.py segment_sum (counter-sum re-derivation from
+    resident slot contributions)."""
+    return jnp.zeros(n_seg, dtype=jnp.int64).at[ids].add(vals)

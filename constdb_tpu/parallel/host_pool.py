@@ -190,6 +190,14 @@ def _worker_main(conn, shard: int, n_shards: int, engine_spec: str,
                     "bytes_h2d": getattr(engine, "bytes_h2d", 0),
                     "bytes_d2h": getattr(engine, "bytes_d2h", 0),
                     "folds": getattr(engine, "folds", 0),
+                    "dev_rounds_resident": getattr(engine,
+                                                   "dev_rounds_resident", 0),
+                    "host_micro_rounds": getattr(engine,
+                                                 "host_micro_rounds", 0),
+                    "flush_rows_downloaded": getattr(
+                        engine, "flush_rows_downloaded", 0),
+                    "flush_rows_full_equiv": getattr(
+                        engine, "flush_rows_full_equiv", 0),
                 }))
             elif cmd == "memory":
                 conn.send(("ok", flushed_store().memory_report()))

@@ -130,6 +130,19 @@ def _section_stats(node, out):
     if rebuilds is not None:
         for name, cnt in sorted(rebuilds.items()):
             out.append((f"mirror_rebuilds_{name}", cnt))
+    # device-transfer accounting (engine/tpu.py): cumulative host<->device
+    # bytes, steady-state micro rounds merged in place against resident
+    # planes vs routed to the host fallback, and the dirty-row flush
+    # downloads vs their whole-plane equivalent — the residency metrics
+    # the bench legs and the v5e acceptance round read
+    if getattr(node.engine, "bytes_h2d", None) is not None:
+        out.append(("dev_upload_bytes", node.engine.bytes_h2d))
+        out.append(("dev_download_bytes", node.engine.bytes_d2h))
+    for gauge in ("dev_rounds_resident", "host_micro_rounds",
+                  "flush_rows_downloaded", "flush_rows_full_equiv"):
+        v = getattr(node.engine, gauge, None)
+        if v is not None:
+            out.append((gauge, v))
     out.append(("engine", node.engine.name))
     degraded = getattr(node.engine, "degraded", None)
     if degraded:

@@ -211,11 +211,14 @@ class Node:
         """Land one coalesced replication micro-batch (the steady-state
         pull path, replica/coalesce.py) through the same engine seam
         snapshot ingest uses.  `builder.finalize()` evaluates the
-        element-plane key-delete rule against LIVE host columns, so any
-        device-resident merge state must flush first — the same
-        flush-before-read discipline `apply_replicated` applies per
-        frame."""
-        self.ensure_flushed()
+        element-plane key-delete rule against LIVE host dt columns, so
+        unflushed device state COVERING the env plane must flush first —
+        the narrow form of the flush-before-read discipline
+        `apply_replicated` applies per frame.  A steady-state resident
+        engine keeps env host-authoritative (engine/tpu.py micro path),
+        so consecutive stream batches merge in place on device with no
+        flush round-trip between them."""
+        self.ensure_flushed_for(("env",))
         self.merge_batches([builder.finalize()])
         self.stats.repl_frames_coalesced += frames
         self.stats.repl_coalesce_flushes += 1
@@ -223,11 +226,13 @@ class Node:
     def merge_serve_batch(self, builder, msgs: int) -> None:
         """Land one coalesced client-serving micro-batch (the pipelined
         RESP path, server/serve.py) through the same engine seam the
-        replication coalescer rides.  Same flush-before-finalize
-        discipline as merge_stream_batch: `builder.finalize()` reads
-        LIVE host columns.  The run is fully repl-logged by the caller,
-        so logged=True keeps the shared full-sync dump reusable."""
-        self.ensure_flushed()
+        replication coalescer rides.  Same narrow flush-before-finalize
+        discipline as merge_stream_batch (`builder.finalize()` reads
+        live env dt columns only; the serve planners' own reads flush
+        through the coalescer's probe paths).  The run is fully
+        repl-logged by the caller, so logged=True keeps the shared
+        full-sync dump reusable."""
+        self.ensure_flushed_for(("env",))
         self.merge_batches([builder.finalize()], logged=True)
         self.stats.serve_msgs_coalesced += msgs
         self.stats.serve_flushes += 1
@@ -293,6 +298,18 @@ class Node:
             t0 = time.perf_counter()
             engine.flush(self.ks)
             self.stats.flush_secs += time.perf_counter() - t0
+
+    def ensure_flushed_for(self, families) -> None:
+        """Flush only when unflushed device-resident state actually
+        covers one of `families` — the narrow read-barrier for callers
+        that provably read nothing else (docs/INVARIANTS.md
+        flush-before-read law).  Engines without the staleness probe
+        take the full flush."""
+        engine = self.engine
+        if getattr(engine, "needs_flush", False):
+            stale = getattr(engine, "host_stale", None)
+            if stale is None or stale(families):
+                self.ensure_flushed()
 
     def canonical(self) -> dict:
         self.ensure_flushed()

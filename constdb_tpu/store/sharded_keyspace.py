@@ -444,7 +444,13 @@ class ShardedKeySpace:
                  "stage_secs": dict(getattr(e, "stage_secs", {}) or {}),
                  "bytes_h2d": getattr(e, "bytes_h2d", 0),
                  "bytes_d2h": getattr(e, "bytes_d2h", 0),
-                 "folds": getattr(e, "folds", 0)}
+                 "folds": getattr(e, "folds", 0),
+                 "dev_rounds_resident": getattr(e, "dev_rounds_resident", 0),
+                 "host_micro_rounds": getattr(e, "host_micro_rounds", 0),
+                 "flush_rows_downloaded":
+                     getattr(e, "flush_rows_downloaded", 0),
+                 "flush_rows_full_equiv":
+                     getattr(e, "flush_rows_full_equiv", 0)}
                 for e in engines]
 
     # ------------------------------------------------------- consolidation

@@ -68,6 +68,41 @@ print("resync smoke verified:",
 EOF
 
 echo
+echo "== resident smoke (pallas-interpret snapshot + stream) =="
+# tiny oracle-verified runs of the device-resident steady path with the
+# Pallas kernels forced through the interpreter: a kernel that drifts
+# from the host semantics fails HERE on CPU-only builders, not on the
+# first real-TPU round.  Snapshot leg = bulk catch-up through the fold
+# kernels; stream leg = in-place micro merges through the resident
+# scatter kernels (the differential suite proper runs inside tier-1 —
+# tests/test_resident_steady.py / tests/test_pallas_dense.py).
+JAX_PLATFORMS=cpu CONSTDB_BENCH_KEYS=20000 CONSTDB_BENCH_REPLICAS=2 \
+CONSTDB_BENCH_CPU_KEYS=5000 CONSTDB_BENCH_FOLD=pallas-interpret \
+    timeout -k 10 300 python bench.py --mode snapshot --resident 1 \
+    > /tmp/_ci_resident_snap.json || exit $?
+JAX_PLATFORMS=cpu CONSTDB_BENCH_FRAMES=3000 CONSTDB_BENCH_STREAM_KEYS=500 \
+CONSTDB_BENCH_APPLY_BATCH=256 CONSTDB_BENCH_FOLD=pallas-interpret \
+    timeout -k 10 300 python bench.py --mode stream --resident 1 \
+    > /tmp/_ci_resident_stream.json || exit $?
+python - <<'EOF' || exit $?
+import json
+snap = json.load(open("/tmp/_ci_resident_snap.json"))
+assert snap["verified"], "resident snapshot smoke failed oracle verification"
+stream = json.load(open("/tmp/_ci_resident_stream.json"))
+assert stream["verified"], "resident stream smoke failed oracle verification"
+leg = stream["resident_curve"][0]
+assert leg["dev_rounds_resident"] > 0, "steady path never engaged"
+assert not leg["pallas_broken"], "pallas kernels fell back to XLA"
+assert 0 < leg["flush_rows_downloaded"] < leg["flush_rows_full_equiv"], \
+    "flush downloads were not partial"
+print("resident smoke verified: snapshot",
+      snap["resident_curve"][0]["keys_per_sec"], "keys/s; stream",
+      leg["fps"], "fps,", leg["dev_rounds_resident"], "resident rounds,",
+      f"{leg['flush_rows_downloaded']}/{leg['flush_rows_full_equiv']}",
+      "rows flushed")
+EOF
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

@@ -73,3 +73,182 @@ def test_negative_and_extreme_values():
     np.testing.assert_array_equal(n1, n2)
     np.testing.assert_array_equal(d1, d2)
     np.testing.assert_array_equal(w1, w2)
+
+
+# -------------------------------------------------- resident scatter kernels
+# The steady-state micro-path kernels (gather-compare-scatter over one LWW
+# pair + the segment-sum counter re-derivation) vs their XLA twins
+# (ops/bulk.py bulk_lww_src / ops/dense.py segment_sum) and the host
+# reference, over the engine's exact padding protocol.
+
+import jax.numpy as jnp
+
+from constdb_tpu.engine.tpu import TpuMergeEngine
+from constdb_tpu.ops import bulk as B
+
+
+def _pad1(arr, n, fill):
+    out = np.full(n, fill, dtype=arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+def _scatter_both(p, s, src, idx, bp, bs, base):
+    """Run the Pallas scatter (engine padding protocol: pads target a
+    free row with NEUTRAL values) and the XLA twin (pads out of range)
+    on copies; -> ((p, s, src) pallas, (p, s, src) xla)."""
+    sp, n = len(p), len(idx)
+    np2 = PD._pow2(max(n, 1))
+    pad_row = TpuMergeEngine._scatter_pad_row(idx.astype(np.int64), n, sp) \
+        if np2 > n else 0
+    pl_out = PD.scatter_pair_src(
+        jnp.array(p), jnp.array(s), jnp.array(src),
+        jnp.array(_pad1(idx, np2, pad_row)),
+        jnp.array(_pad1(bp, np2, NEUTRAL_T)),
+        jnp.array(_pad1(bs, np2, NEUTRAL_T)),
+        np.int32(base), interpret=INTERPRET)
+    idx_x = np.concatenate([idx, (sp + np.arange(np2 - n)).astype(np.int32)])
+    xla_out = B.bulk_lww_src(
+        jnp.array(p), jnp.array(s), jnp.array(src), jnp.array(idx_x),
+        jnp.array(_pad1(bp, np2, NEUTRAL_T)),
+        jnp.array(_pad1(bs, np2, NEUTRAL_T)), base)
+    return tuple(np.asarray(x) for x in pl_out), \
+        tuple(np.asarray(x) for x in xla_out)
+
+
+def _host_scatter_ref(p, s, src, idx, bp, bs, base):
+    """Per-row host reference: lexicographic (primary, secondary) win —
+    exactly crdt/semantics.py lww_wins / hostbatch's fold rule."""
+    p, s, src = p.copy(), s.copy(), src.copy()
+    for j, r in enumerate(idx.tolist()):
+        win = (bp[j] > p[r]) or (bp[j] == p[r] and bs[j] > s[r])
+        if win:
+            p[r], s[r], src[r] = bp[j], bs[j], base + j
+    return p, s, src
+
+
+def _scatter_case(rng, sp):
+    n = int(rng.integers(1, sp + 1))
+    idx = np.sort(rng.choice(sp, n, replace=False)).astype(np.int32)
+    p = rng.integers(-9, 9, sp).astype(np.int64)
+    s = rng.integers(-9, 9, sp).astype(np.int64)
+    p[rng.random(sp) < 0.2] = NEUTRAL_T
+    src = np.where(rng.random(sp) < 0.5, -1,
+                   rng.integers(0, 50, sp)).astype(np.int32)
+    bp = rng.integers(-9, 9, n).astype(np.int64)
+    bs = rng.integers(-9, 9, n).astype(np.int64)
+    # equal-stamp ties (local must keep) and full-pair ties
+    for j in range(n):
+        if rng.random() < 0.3:
+            bp[j] = p[idx[j]]
+        if rng.random() < 0.3:
+            bs[j] = s[idx[j]]
+    return p, s, src, idx, bp, bs, int(rng.integers(0, 1000))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_scatter_pair_xla_twin_matches_host(seed):
+    """The XLA resident-scatter twin (ops/bulk.py bulk_lww_src) vs the
+    per-row host reference, randomized — cheap enough for tier-1 at full
+    shape coverage (XLA traces are ~ms; the Pallas interpreter pays ~1s
+    PER SHAPE to trace, so its randomized twin runs in the slow suite
+    and tier-1 keeps the small fixed-shape Pallas cases below)."""
+    from constdb_tpu.ops import bulk as B
+    rng = np.random.default_rng(seed)
+    for _ in range(25):
+        sp = int(2 ** rng.integers(0, 7))
+        p, s, src, idx, bp, bs, base = _scatter_case(rng, sp)
+        n = len(idx)
+        np2 = PD._pow2(n)
+        idx_x = np.concatenate([idx,
+                                (sp + np.arange(np2 - n)).astype(np.int32)])
+        got = tuple(np.asarray(x) for x in B.bulk_lww_src(
+            jnp.array(p), jnp.array(s), jnp.array(src), jnp.array(idx_x),
+            jnp.array(_pad1(bp, np2, NEUTRAL_T)),
+            jnp.array(_pad1(bs, np2, NEUTRAL_T)), base))
+        want = _host_scatter_ref(p, s, src, idx, bp, bs, base)
+        for g, w, name in zip(got, want, ("primary", "secondary", "src")):
+            np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_scatter_pair_src_matches_xla_and_host(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(16):
+        sp = int(2 ** rng.integers(0, 7))
+        p, s, src, idx, bp, bs, base = _scatter_case(rng, sp)
+        got_pl, got_xla = _scatter_both(p, s, src, idx, bp, bs, base)
+        want = _host_scatter_ref(p, s, src, idx, bp, bs, base)
+        for g, x, w, name in zip(got_pl, got_xla, want,
+                                 ("primary", "secondary", "src")):
+            np.testing.assert_array_equal(x, w, err_msg=f"xla {name}")
+            np.testing.assert_array_equal(g, w, err_msg=f"pallas {name}")
+
+
+def test_scatter_pad_collision_would_revert():
+    """The pad-targeting contract (ops/pallas_dense.py): a pad aliased
+    onto a REAL row's target reads pre-merge state and reverts the
+    merge.  _scatter_pad_row must therefore pick a row outside the
+    batch — pinned both ways."""
+    sp = 8
+    p = np.zeros(sp, dtype=np.int64)
+    s = np.zeros(sp, dtype=np.int64)
+    src = np.full(sp, -1, np.int32)
+    idx = np.array([0], dtype=np.int32)       # one real row, wins slot 0
+    bp = np.array([5], dtype=np.int64)
+    bs = np.array([1], dtype=np.int64)
+    # engine helper picks a free row — result must match the reference
+    assert TpuMergeEngine._scatter_pad_row(idx.astype(np.int64), 1, sp) == 1
+    got_pl, got_xla = _scatter_both(p, s, src, idx, bp, bs, 7)
+    want = _host_scatter_ref(p, s, src, idx, bp, bs, 7)
+    for g, x, w in zip(got_pl, got_xla, want):
+        np.testing.assert_array_equal(g, w)
+        np.testing.assert_array_equal(x, w)
+
+
+def test_scatter_pad_row_finds_interior_gap():
+    rows = np.array([0, 1, 3, 4, 6, 7], dtype=np.int64)  # 2 and 5 absent
+    assert TpuMergeEngine._scatter_pad_row(rows, len(rows), 8) == 2
+    rows = np.array([1, 2, 3], dtype=np.int64)
+    assert TpuMergeEngine._scatter_pad_row(rows, len(rows), 4) == 0
+    rows = np.array([0, 1, 2], dtype=np.int64)
+    assert TpuMergeEngine._scatter_pad_row(rows, len(rows), 8) == 3
+
+
+@pytest.mark.parametrize("seed,n,n_seg", [(0, 1, 1), (1, 33, 7),
+                                          (2, 257, 64), (3, 1000, 100)])
+def test_segment_sum_matches_xla_and_host(seed, n, n_seg):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, n_seg, n).astype(np.int32)
+    # full-range magnitudes force the unsigned lo-word carry chains
+    vals = rng.integers(-(1 << 61), 1 << 61, n).astype(np.int64)
+    got = np.asarray(PD.segment_sum(jnp.array(ids), jnp.array(vals),
+                                    n_seg=n_seg, interpret=INTERPRET))
+    xla = np.asarray(D.segment_sum(jnp.array(ids), jnp.array(vals),
+                                   n_seg=n_seg))
+    want = np.zeros(n_seg, dtype=np.int64)
+    np.add.at(want, ids, vals)
+    np.testing.assert_array_equal(xla, want)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_segment_sum_carry_boundary():
+    """Sums crossing the uint32 boundary exercise the explicit carry."""
+    ids = np.zeros(8, dtype=np.int32)
+    vals = np.full(8, (1 << 32) - 1, dtype=np.int64)
+    got = np.asarray(PD.segment_sum(jnp.array(ids), jnp.array(vals),
+                                    n_seg=3, interpret=INTERPRET))
+    assert got.tolist() == [8 * ((1 << 32) - 1), 0, 0]
+    # negative totals round-trip the split sign correctly
+    vals = np.array([-(1 << 40), 1, -(1 << 33), 5], dtype=np.int64)
+    ids = np.array([0, 1, 0, 1], dtype=np.int32)
+    got = np.asarray(PD.segment_sum(jnp.array(ids), jnp.array(vals),
+                                    n_seg=2, interpret=INTERPRET))
+    assert got.tolist() == [-(1 << 40) - (1 << 33), 6]
+
+
+def test_segment_sum_scratch_cap():
+    with pytest.raises(ValueError):
+        PD.segment_sum(jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int64),
+                       n_seg=PD.SEGMENT_SUM_MAX_SEG + 1, interpret=INTERPRET)

@@ -130,11 +130,15 @@ def mixed_workload(n_conns: int, rounds: int, seed: int = 9) -> list:
     return work
 
 
-async def drive_node(tmp_path, serve_batch, work):
+async def drive_node(tmp_path, serve_batch, work, engine=None):
     """One node + len(work) client connections driven in deterministic
     lockstep (a conn's chunk fully replies before the next conn sends).
-    Returns (reply_bytes_per_conn, canonical, repl_entries, stats)."""
-    node = Node(node_id=1, alias="n1", clock=stepping_clock())
+    `engine`: a MergeEngine INSTANCE for the node (default CPU reference;
+    test_resident_steady.py passes a device-resident one and inspects
+    its transfer gauges afterwards).  Returns (reply_bytes_per_conn,
+    canonical, repl_entries, stats)."""
+    node = Node(node_id=1, alias="n1", clock=stepping_clock(),
+                **({"engine": engine} if engine is not None else {}))
     app = await start_node(node, host="127.0.0.1", port=0,
                            work_dir=str(tmp_path), serve_batch=serve_batch,
                            **FAST)
