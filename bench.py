@@ -703,6 +703,205 @@ def stream_main(args) -> None:
 
 
 # --------------------------------------------------------------------------
+# --mode tensor: tensor-valued registers — the first family designed
+# device-first (crdt/tensor.py).  A stream of contribution micro-batches
+# (the coalescer flush shape: a few hundred rows, rows_unique=False)
+# merges into a store, and EVERY round the full key set is read back
+# (the aggregation product — distributed model/embedding serving).  The
+# device leg keeps payloads resident (engine/tpu.py pools: merges
+# scatter in place, reads gather+reduce on device, only [G, K] results
+# download); the host leg is the per-row reference
+# (KeySpace.tensor_merge_row + tensor_read).  Both legs are
+# oracle-verified bit-identical — the canonical-order law makes that a
+# hard equality even for float reductions.
+
+
+def make_tensor_workload(n_rounds: int, batch_rows: int, n_keys: int,
+                         n_nodes: int, elems: int, strat: str,
+                         seed: int = 17) -> list:
+    """Deterministic per-round ColumnarBatches of tensor contributions
+    (every (key, node) slot seeded in round 0 so reads always see
+    n_nodes contributors — the model-merge shape)."""
+    from constdb_tpu.crdt import semantics as S
+    from constdb_tpu.crdt import tensor as T
+    from constdb_tpu.engine.base import ColumnarBatch
+
+    rng = np.random.default_rng(seed)
+    meta = T.TensorMeta(T.STRATEGY_IDS[strat], 0, (elems,))
+    cfg = T.pack_config(meta)
+    u = 1
+    out = []
+    for r in range(n_rounds):
+        if r == 0:
+            pairs = [(k, nd) for k in range(n_keys)
+                     for nd in range(1, n_nodes + 1)]
+        else:
+            pairs = [(int(rng.integers(n_keys)),
+                      int(rng.integers(1, n_nodes + 1)))
+                     for _ in range(batch_rows)]
+        n = len(pairs)
+        b = ColumnarBatch()
+        b.keys = [b"t%06d" % k for k, _ in pairs]
+        uuids = np.empty(n, dtype=_I64)
+        for i in range(n):
+            u += 1
+            uuids[i] = (MS0 + u) << SEQ_BITS
+        b.key_enc = np.full(n, S.ENC_TENSOR, np.int8)
+        b.key_ct = uuids.copy()
+        b.key_mt = uuids.copy()
+        b.key_dt = np.zeros(n, dtype=_I64)
+        b.key_expire = np.zeros(n, dtype=_I64)
+        b.reg_val = [None] * n
+        b.reg_t = np.zeros(n, dtype=_I64)
+        b.reg_node = np.zeros(n, dtype=_I64)
+        b.tns_ki = np.arange(n, dtype=_I64)
+        b.tns_node = np.fromiter((nd for _, nd in pairs), dtype=_I64,
+                                 count=n)
+        b.tns_uuid = uuids
+        b.tns_cnt = rng.integers(1, 8, size=n).astype(_I64)
+        b.tns_cfg = [cfg] * n
+        payloads = (rng.standard_normal((n, elems)) * 4).astype(np.float32)
+        b.tns_payload = [payloads[i].tobytes() for i in range(n)]
+        b.rows_unique_per_slot = False
+        out.append(b)
+    return out
+
+
+def _tensor_leg(batches, n_keys: int, make_engine, device_reads: bool):
+    """One leg: merge every round's batch, then read ALL keys (device
+    path via engine.tensor_read_many when available).  Returns (store,
+    engine, wall_s, final reads dict key->bytes)."""
+    from constdb_tpu.store.keyspace import KeySpace
+
+    store = KeySpace()
+    engine = make_engine()
+    reads = None
+    t0 = time.perf_counter()
+    for b in batches:
+        engine.merge_many(store, [b])
+        kids = range(n_keys)
+        if device_reads:
+            reads = engine.tensor_read_many(store, kids)
+        else:
+            reads = {kid: store.tensor_read(kid) for kid in kids}
+    if getattr(engine, "needs_flush", False):
+        engine.flush(store)
+    wall = time.perf_counter() - t0
+    final = {store.key_bytes[kid]: (None if arr is None else arr.tobytes())
+             for kid, arr in reads.items()}
+    return store, engine, wall, final
+
+
+def tensor_main(args) -> None:
+    """`bench.py --mode tensor`: the resident device tensor path vs the
+    host reference on coalescer-sized micro-batches, interleaved
+    best-of-3 per strategy, both legs oracle-verified bit-identical
+    (final reads AND canonical export).  Emits ONE JSON line
+    (BENCH_r13)."""
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+    from constdb_tpu.utils.backend import force_cpu_platform, probe_backend
+
+    n_keys = int(os.environ.get("CONSTDB_BENCH_TNS_KEYS", 128))
+    elems = int(os.environ.get("CONSTDB_BENCH_TNS_ELEMS", 4096))
+    n_nodes = int(os.environ.get("CONSTDB_BENCH_TNS_NODES", 8))
+    n_rounds = int(os.environ.get("CONSTDB_BENCH_TNS_ROUNDS", 24))
+    batch_rows = int(os.environ.get("CONSTDB_BENCH_TNS_BATCH", 128))
+    strats = os.environ.get("CONSTDB_BENCH_TNS_STRATS",
+                            "avg,maxmag,trimmed-mean,sum,lww").split(",")
+    reps = int(os.environ.get("CONSTDB_BENCH_TNS_REPS", 3))
+    fold = os.environ.get("CONSTDB_BENCH_FOLD", "auto")
+
+    probe = probe_backend()
+    note = ""
+    if not probe.ok:
+        note = (f"device backend unavailable ({probe.error}); "
+                "XLA-on-CPU fallback")
+        print(f"[bench] WARNING: {note}", file=sys.stderr)
+        force_cpu_platform()
+    import jax
+    backend = jax.default_backend()
+
+    curve = []
+    verified = True
+    for strat in strats:
+        batches = make_tensor_workload(n_rounds, batch_rows, n_keys,
+                                       n_nodes, elems, strat)
+        rows_total = sum(len(b.tns_ki) for b in batches)
+        best_dev = (float("inf"), None, None, None)
+        best_host = (float("inf"), None, None)
+        for _ in range(reps):
+            st_d, eng_d, w_d, reads_d = _tensor_leg(
+                batches, n_keys,
+                # steady FORCED: this leg measures the resident path
+                # itself; 'auto' keeps CPU-only production boxes on the
+                # host strategy (the host leg below IS that path)
+                lambda: TpuMergeEngine(resident=True, steady=True,
+                                       warmup=0, dense_fold=fold),
+                device_reads=True)
+            if w_d < best_dev[0]:
+                if best_dev[2] is not None:
+                    best_dev[2].close()  # displaced best: free its pools
+                best_dev = (w_d, st_d, eng_d, reads_d)
+            elif hasattr(eng_d, "close"):
+                eng_d.close()
+            st_h, _eng_h, w_h, reads_h = _tensor_leg(
+                batches, n_keys, CpuMergeEngine, device_reads=False)
+            if w_h < best_host[0]:
+                best_host = (w_h, st_h, reads_h)
+        w_d, st_d, eng_d, reads_d = best_dev
+        w_h, st_h, reads_h = best_host
+        ok = reads_d == reads_h and \
+            st_d.canonical() == st_h.canonical()
+        verified = verified and ok
+        leg = {
+            "strategy": strat,
+            "dev_wall_s": round(w_d, 3),
+            "host_wall_s": round(w_h, 3),
+            "dev_rows_per_sec": round(rows_total / w_d, 1),
+            "host_rows_per_sec": round(rows_total / w_h, 1),
+            "speedup": round(w_h / w_d, 2),
+            "rows": rows_total,
+            "reads": n_rounds * n_keys,
+            "verified": ok,
+        }
+        leg.update(engine_counters(eng_d))
+        leg["tns_dev_rows"] = getattr(eng_d, "tns_dev_rows", 0)
+        leg["tns_host_rows"] = getattr(eng_d, "tns_host_rows", 0)
+        curve.append(leg)
+        print(f"[bench] tensor {strat}: device {w_d:.3f}s vs host "
+              f"{w_h:.3f}s = {leg['speedup']:.2f}x "
+              f"({rows_total} rows, {leg['reads']} reads, "
+              f"{eng_d.tns_dev_rows} dev rows, "
+              f"{leg['dev_rounds_resident']} resident rounds) "
+              f"({'OK' if ok else 'MISMATCH'})", file=sys.stderr)
+        if hasattr(eng_d, "close"):
+            eng_d.close()
+    ratios = [leg["speedup"] for leg in curve]
+    out = {
+        "metric": "tensor_merge_speedup_vs_host",
+        "value": round(min(ratios), 2),
+        "unit": "x (worst strategy)",
+        "mode": "tensor",
+        "keys": n_keys,
+        "elems": elems,
+        "payload_bytes": elems * 4,
+        "contributors": n_nodes,
+        "rounds": n_rounds,
+        "batch_rows": batch_rows,
+        "curve": curve,
+        "backend": backend,
+        "fold": fold,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
+
+
+# --------------------------------------------------------------------------
 # --mode serve: pipelined client serving over real sockets (the serve
 # coalescer, server/serve.py) vs the CONSTDB_SERVE_BATCH=1 per-command
 # baseline — the serving-throughput headline the r05-r08 trajectory
@@ -1631,14 +1830,17 @@ def main() -> None:
                     "worker processes (default: CONSTDB_SHARDS / auto; "
                     "1 = single-keyspace path)")
     ap.add_argument("--mode",
-                    choices=["snapshot", "stream", "serve", "resync"],
+                    choices=["snapshot", "stream", "serve", "resync",
+                             "tensor"],
                     default="snapshot",
                     help="snapshot = bulk catch-up merge (default); "
                     "stream = steady-state replication apply through the "
                     "coalescing pull path; serve = pipelined client "
                     "serving over real sockets through the serve "
                     "coalescer; resync = digest-negotiated delta resync "
-                    "vs full snapshot at configurable divergence")
+                    "vs full snapshot at configurable divergence; "
+                    "tensor = resident device tensor-register merges + "
+                    "reads vs the host reference at micro-batch size")
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
@@ -1663,6 +1865,9 @@ def main() -> None:
         return
     if args.mode == "resync":
         resync_main(args)
+        return
+    if args.mode == "tensor":
+        tensor_main(args)
         return
     # default = the BASELINE.json north-star scale (10M keys x 8 replicas);
     # the CPU baseline rate is measured on a capped key count (the per-row

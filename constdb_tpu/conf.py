@@ -139,6 +139,15 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "consecutive micro rounds a plane's host version must stay "
            "stable before its device mirror uploads (cold planes merge "
            "on host meanwhile)"),
+    EnvVar("CONSTDB_TENSOR_POOL_MB", "512",
+           "resident tensor payload pool cap (MB of device bytes) "
+           "before the engine flushes and releases the pools"),
+    EnvVar("CONSTDB_TENSOR_MAX_ELEMS", "4194304",
+           "max elements per tensor value a TENSOR.SET may create "
+           "(guards one client frame from allocating GBs)"),
+    EnvVar("CONSTDB_TENSOR_STRATEGY", "lww",
+           "merge strategy TENSOR.SET uses when the strategy argument "
+           "is '-' (lww, sum, avg, maxmag, trimmed-mean)"),
 )}
 
 

@@ -64,9 +64,14 @@ ENC_DICT = 4
 ENC_SET = 5
 ENC_MV = 6
 ENC_LIST = 7
+# 8 is new: tensor-valued registers (crdt/tensor.py) — dense float
+# arrays whose merge is a per-node contributor-slot LWW and whose read
+# is a registered strategy reduction (arXiv 2605.19373 two-layer CRDT)
+ENC_TENSOR = 8
 
 ENC_NAMES = {ENC_COUNTER: "Counter", ENC_BYTES: "Bytes", ENC_DICT: "LWWDict",
-             ENC_SET: "LWWSet", ENC_MV: "MultiValue", ENC_LIST: "List"}
+             ENC_SET: "LWWSet", ENC_MV: "MultiValue", ENC_LIST: "List",
+             ENC_TENSOR: "Tensor"}
 
 # encodings whose element rows carry value bytes (dict fields, multi-value
 # siblings, list entries); set members are valueless

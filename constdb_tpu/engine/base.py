@@ -57,6 +57,18 @@ class ColumnarBatch:
     el_add_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     el_add_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
     el_del_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    # tensor contributor slots (crdt/tensor.py two-layer registers):
+    # one row per (key, writer node) contribution — LWW stamp + count
+    # columns, the packed per-key config riding every row (rows of one
+    # key carry identical configs; the first merge fixes it), and the
+    # payload as a flat array of the key's dtype (or raw LE bytes on
+    # the wire — engines normalize via tensor.payload_array)
+    tns_ki: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    tns_node: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    tns_uuid: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    tns_cnt: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
+    tns_cfg: list = field(default_factory=list)
+    tns_payload: list = field(default_factory=list)
     # standalone key-level tombstones (snapshot DELETES section)
     del_keys: list = field(default_factory=list)
     del_t: np.ndarray = field(default_factory=lambda: np.zeros(0, _I64))
@@ -89,7 +101,8 @@ class ColumnarBatch:
 
     @property
     def n_rows(self) -> int:
-        return len(self.keys) + len(self.cnt_ki) + len(self.el_ki)
+        return (len(self.keys) + len(self.cnt_ki) + len(self.el_ki)
+                + len(self.tns_ki))
 
 
 def has_values(vals: list) -> bool:
@@ -106,6 +119,7 @@ class MergeStats:
     type_conflicts: int = 0
     counter_rows: int = 0
     elem_rows: int = 0
+    tensor_rows: int = 0
     # device-transfer accounting for THIS call (engine/tpu.py fills them
     # from its cumulative counters; host-only engines leave zeros).
     # dev_rounds_resident counts micro rounds merged in place against
@@ -125,6 +139,7 @@ class MergeStats:
         self.type_conflicts += other.type_conflicts
         self.counter_rows += other.counter_rows
         self.elem_rows += other.elem_rows
+        self.tensor_rows += other.tensor_rows
         self.dev_upload_bytes += other.dev_upload_bytes
         self.dev_download_bytes += other.dev_download_bytes
         self.dev_rounds_resident += other.dev_rounds_resident
@@ -194,6 +209,7 @@ def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True,
         rows = np.nonzero(live)[0]
         b.el_member = [ks.el_member[r] for r in rows]
         b.el_val = [ks.el_val[r] for r in rows]
+        _tns_dump(ks, b)
         assert n == len(b.keys)
     else:
         sel = np.asarray(key_sel, dtype=_I64)
@@ -228,8 +244,44 @@ def batch_from_keyspace(ks: KeySpace, include_deletes: bool = True,
             rows = em.tolist()
             b.el_member = [ks.el_member[r] for r in rows]
             b.el_val = [ks.el_val[r] for r in rows]
+        if ks.tns.n:
+            _tns_dump(ks, b, posmap=posmap)
 
     if include_deletes and ks.key_deletes:
         b.del_keys = list(ks.key_deletes.keys())
         b.del_t = np.fromiter(ks.key_deletes.values(), dtype=_I64, count=len(ks.key_deletes))
     return b
+
+
+def _tns_dump(ks: KeySpace, b: ColumnarBatch,
+              posmap: Optional[np.ndarray] = None) -> None:
+    """Dump the tensor plane into a batch: real contributions only
+    (neutral-stamped slots never ship — a fresh store materializes them
+    on merge), each row carrying its key's packed config (computed once
+    per key).  `posmap`: kid -> batch position for key_sel dumps."""
+    from ..crdt import tensor as T
+    from ..crdt.semantics import NEUTRAL_T
+
+    n = ks.tns.n
+    if not n:
+        return
+    sel = ks.tns.uuid[:n] != NEUTRAL_T
+    if posmap is not None:
+        sel &= posmap[ks.tns.kid[:n]] >= 0
+    rows = np.nonzero(sel)[0]
+    if not len(rows):
+        return
+    kids = ks.tns.kid[rows]
+    b.tns_ki = kids.copy() if posmap is None else posmap[kids]
+    b.tns_node = ks.tns.node[rows].copy()
+    b.tns_uuid = ks.tns.uuid[rows].copy()
+    b.tns_cnt = ks.tns.cnt[rows].copy()
+    cfg_of: dict = {}
+    cfgs = []
+    for kid in kids.tolist():
+        c = cfg_of.get(kid)
+        if c is None:
+            c = cfg_of[kid] = T.pack_config(ks.tns_meta[kid])
+        cfgs.append(c)
+    b.tns_cfg = cfgs
+    b.tns_payload = [ks.tns_payload[r] for r in rows.tolist()]

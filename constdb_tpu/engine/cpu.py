@@ -96,6 +96,16 @@ class CpuMergeEngine:
                              batch.el_val[r])
             st.elem_rows += 1
 
+        for r in range(len(batch.tns_ki)):
+            kid = kid_of[int(batch.tns_ki[r])]
+            if kid < 0:
+                continue
+            store.tensor_merge_row(kid, int(batch.tns_node[r]),
+                                   int(batch.tns_uuid[r]),
+                                   int(batch.tns_cnt[r]),
+                                   batch.tns_cfg[r], batch.tns_payload[r])
+            st.tensor_rows += 1
+
         for i, key in enumerate(batch.del_keys):
             store.record_key_delete(key, int(batch.del_t[i]))
 

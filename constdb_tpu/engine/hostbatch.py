@@ -390,5 +390,34 @@ def merge_host_batch(store: KeySpace, batch: ColumnarBatch,
             _merge_el(store, rows, batch.el_add_t[sel],
                       batch.el_add_node[sel], batch.el_del_t[sel], vals)
 
+    if len(batch.tns_ki):
+        merge_host_tns(store, batch, kid_of, st)
+
     for i, key in enumerate(batch.del_keys):
         store.record_key_delete(key, int(batch.del_t[i]))
+
+
+def merge_host_tns(store: KeySpace, batch: ColumnarBatch,
+                   kid_of: np.ndarray, st: MergeStats) -> None:
+    """Tensor plane, HOST strategy: the per-row reference loop
+    (KeySpace.tensor_merge_row — the ONE slot-merge implementation; the
+    op path and the CPU engine run the same calls).  Tensor rows are
+    few and payload-heavy, so the per-row Python here IS the measured
+    host baseline the resident device path (engine/tpu.py
+    _merge_micro_tns) must beat — and the two are differential-tested
+    byte-identical."""
+    kid_arr = kid_of[batch.tns_ki]
+    merge_row = store.tensor_merge_row
+    nodes = batch.tns_node
+    uuids = batch.tns_uuid
+    cnts = batch.tns_cnt
+    cfgs = batch.tns_cfg
+    payloads = batch.tns_payload
+    kept = 0
+    for i, kid in enumerate(kid_arr.tolist()):
+        if kid < 0:
+            continue
+        kept += 1
+        merge_row(kid, int(nodes[i]), int(uuids[i]), int(cnts[i]),
+                  cfgs[i], payloads[i])
+    st.tensor_rows += kept

@@ -304,6 +304,21 @@ class TpuMergeEngine:
         self._stage_ex = None          # lazy single-worker staging executor
         self._stage_pending = None     # in-flight stage futures (flush joins)
         self._pallas_broken = False
+        # resident tensor payload pools (the tensor-register family,
+        # crdt/tensor.py): one [cap, Kp] device pool per (dtype, elems)
+        # class, holding contributor payload rows; slot STAMPS stay
+        # host-authoritative (like the env plane on the micro path), so
+        # only payload bytes ever cross the link.  `dirty` pool slots
+        # are device-newer than the host side list; flush gathers and
+        # downloads exactly those (ops/bulk.py gather_rows).
+        self._tns_pools: dict[tuple, dict] = {}
+        self._tns_ver = 0
+        self._tns_epoch = 0            # bumped whenever pools drop
+        self._tns_read_cache: dict = {}
+        self._tns_bytes = 0            # device payload bytes resident
+        self.tns_dev_rows = 0          # tensor rows merged on device
+        self.tns_host_rows = 0         # tensor rows merged on host
+        self.tns_pool_cap = env_int("CONSTDB_TENSOR_POOL_MB", 512) << 20
         # host<->device transfer accounting (bench.py turns these into a
         # measured fraction of the link ceiling — the merge is
         # transfer-bound on tunnel-attached devices)
@@ -604,8 +619,10 @@ class TpuMergeEngine:
                 self._drop_family(store, fam)
             self.host_micro_rounds += 1
             t0 = _time.perf_counter()
+            rows0 = st.tensor_rows
             for b, kid_of in resolved:
                 merge_host_batch(store, b, kid_of, st)
+            self.tns_host_rows += st.tensor_rows - rows0
             self.family_secs["host"] += _time.perf_counter() - t0
             return st
         import time as _time
@@ -657,6 +674,15 @@ class TpuMergeEngine:
                 plan = self._timed_stage(fam, stage[fam], store, resolved, st)
                 dispatch[fam](store, plan, st)
                 self.family_secs[fam] += _time.perf_counter() - t0
+        # tensor rows (few, payload-heavy) ride the resident payload
+        # pools whenever the steady path is on — bulk catch-up seeds the
+        # pools the micro rounds then merge into; the host twin covers
+        # everything else (meshes partition slot rows the pools don't)
+        tns_device = self.resident and self.steady and self._mesh is None
+        for b, kid_of in resolved:
+            if len(b.tns_ki):
+                self._merge_micro_tns(store, b, kid_of, st,
+                                      device=tns_device)
         for b, _ in resolved:
             for i, key in enumerate(b.del_keys):
                 store.record_key_delete(key, int(b.del_t[i]))
@@ -917,6 +943,7 @@ class TpuMergeEngine:
         # sums exact from the previous flush
         if "cnt" in pending and self._res["cnt"]["n"]:
             self._recompute_sums(store)
+        self._flush_tns(store)
         self.needs_flush = False
         self.family_secs["flush"] += _time.perf_counter() - t0
 
@@ -930,6 +957,9 @@ class TpuMergeEngine:
         self._pool_size = 0
         self._pool_bytes = 0
         self._el_del_touched.clear()
+        self._tns_pools.clear()
+        self._tns_bytes = 0
+        self._tns_epoch += 1
         self.needs_flush = False
 
     def _apply_src(self, store: KeySpace, fam: str, src_h: np.ndarray,
@@ -1020,15 +1050,27 @@ class TpuMergeEngine:
 
     # ------------------------------------------------------ resident state
 
-    def _resident_state(self, store: KeySpace, fam: str, n: int):
+    def _resident_state(self, store: KeySpace, fam: str, n: int,
+                        micro: bool = False):
         """Device state dict for family `fam` covering rows [0, n); grows
         (neutral-filled) as the host table grows.  Returns (cols, cap).
 
         Staleness: the mirror records the host plane's write version at
         build time; an op-path write or GC to THIS plane (KeySpace.touch)
-        forces a rebuild from host — other planes' mirrors survive."""
+        forces a rebuild from host — other planes' mirrors survive.
+
+        `micro`: the caller is the steady scatter path, which keeps LWW
+        pair columns PRE-SPLIT as hi/lo 32-bit planes between rounds
+        (`res["split"]` — ops/pallas_dense.py scatter_pair_src_split).
+        Bulk callers (micro=False) and the grow path speak int64, so
+        they JOIN any split cache back into `cols` first; the micro
+        reuse path leaves the split intact — that is the whole point of
+        the layout (zero O(plane) split/join passes in steady state)."""
         res = self._res.get(fam)
         ver = store.fam_ver[fam]
+        if res is not None and res.get("split") and \
+                (not micro or n > res["cap"]):
+            self._join_split(res)
         if res is not None and res.get("ver") != ver:
             # rebuild from host.  A stale mirror never holds unflushed
             # device data: the Node flushes before every op-path write, so
@@ -1068,16 +1110,28 @@ class TpuMergeEngine:
         else:
             cols = res["cols"]
             cap = res["cap"]
-        # `dirty`/`recon` survive a reuse/grow (the micro path appends
-        # touched rows between flushes); a fresh build starts CLEAN
-        # (dirty=[] — host == device at build, nothing to download)
+        # `dirty`/`recon`/`split` survive a reuse/grow (the micro path
+        # appends touched rows between flushes); a fresh build starts
+        # CLEAN (dirty=[] — host == device at build, nothing to download)
         self._res[fam] = {"cols": cols, "n": n, "cap": cap, "ver": ver,
                           "src": res.get("src") if res else None,
                           "written": res.get("written", set()) if res
                           else set(),
                           "recon": res.get("recon") if res else None,
+                          "split": res.get("split") if res else None,
                           "dirty": res.get("dirty") if res else []}
         return cols, cap
+
+    @staticmethod
+    def _join_split(res: dict) -> None:
+        """Fold a family's pre-split hi/lo pair cache back into its int64
+        `cols` (bulk kernels, state growth, and mirror rebuilds speak
+        int64).  One O(plane) pass per steady→bulk transition — the
+        per-round pass the split layout exists to remove."""
+        from ..ops import pallas_dense as PD
+        for name, (hi, lo) in res["split"].items():
+            res["cols"][name] = PD.join_plane(hi, lo)
+        res["split"] = None
 
     def _family_done(self, fam: str, cols: dict, n: int, cap: int,
                      src=None, written=None, recon=None) -> None:
@@ -1127,6 +1181,10 @@ class TpuMergeEngine:
         if not self.needs_flush:
             return False
         for fam in families:
+            if fam == "tns":
+                if any(p["dirty"] for p in self._tns_pools.values()):
+                    return True
+                continue
             res = self._res.get(fam)
             if res is not None and (res.get("written")
                                     or res.get("src") is not None):
@@ -1147,6 +1205,8 @@ class TpuMergeEngine:
                 fams.add("cnt")
             if len(b.el_ki):
                 fams.add("el")
+            if len(b.tns_ki):
+                fams.add("tns")
         return fams
 
     def _micro_placement(self, store: KeySpace, resolved):
@@ -1166,8 +1226,15 @@ class TpuMergeEngine:
             return None
         placement = {}
         for fam in self._micro_touched(resolved):
-            res = self._res.get(fam)
             ver = store.fam_ver[fam]
+            if fam == "tns":
+                # the tensor plane's mirror is its payload pool set
+                if self._tns_pools and self._tns_ver == ver:
+                    placement[fam] = True
+                    continue
+                res = None
+            else:
+                res = self._res.get(fam)
             if res is not None and res.get("ver") == ver:
                 placement[fam] = True  # resident and fresh: free to ride
                 continue
@@ -1316,6 +1383,10 @@ class TpuMergeEngine:
                                 self._batch_idx(rows_adv, 0, sp, np2),
                                 self._put_batch(_pad(dv_adv, np2, 0)))
 
+        if len(b.tns_ki):
+            self._merge_micro_tns(store, b, kid_of, st,
+                                  device=bool(placement.get("tns")))
+
         for i, key in enumerate(b.del_keys):
             store.record_key_delete(key, int(b.del_t[i]))
 
@@ -1334,41 +1405,68 @@ class TpuMergeEngine:
         if not nw:
             return
         n = _fam_rows(store, fam)
-        cols, sp = self._resident_state(store, fam, n)
+        cols, sp = self._resident_state(store, fam, n, micro=True)
+        res = self._res[fam]
         pcol, scol = pair
         # pad-floor the batch length (see MICRO_SCATTER_PAD) — but only
         # while a free pad-target row exists (nw < sp); a batch covering
         # every plane row pads to itself (nw == sp == pow2, no pads)
         np2 = K.next_pow2(nw if nw >= sp
                           else max(nw, self.MICRO_SCATTER_PAD))
-        p_d, s_d = cols[pcol], cols[scol]
         if src:
             src_d = self._src_state(fam, sp)
             pb = self._pool_add(vals, **{pcol: wp, scol: ws})
             from ..ops import pallas_dense as PD
 
             def _pallas(interp):
+                # the pair columns live PRE-SPLIT between rounds (the
+                # retired PR 8 follow-up): a warm plane pays no O(plane)
+                # int64<->hi/lo pass — only the first round after a bulk
+                # merge / rebuild splits, and only a bulk round joins
+                split = res.get("split") or {}
+                p_sp = split.get(pcol) or PD.split_plane(cols[pcol])
+                s_sp = split.get(scol) or PD.split_plane(cols[scol])
                 pad = self._scatter_pad_row(wr, nw, sp) if np2 > nw else 0
-                return PD.scatter_pair_src(
-                    p_d, s_d, src_d,
+                o = PD.scatter_pair_src_split(
+                    p_sp[0], p_sp[1], s_sp[0], s_sp[1], src_d,
                     self._put_batch(_pad(wr.astype(_I32), np2, pad)),
                     self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
                     self._put_batch(_pad(ws, np2, K.NEUTRAL_T)),
                     np.int32(pb), interpret=interp)
+                return ("split", o)
 
             def _xla():
+                if res.get("split"):
+                    # a mid-stream pallas→XLA fallback: re-join so the
+                    # int64 kernels see the split cache's truth
+                    self._join_split(res)
                 return B.bulk_lww_src(
-                    p_d, s_d, src_d, self._batch_idx(wr, 0, sp, np2),
+                    cols[pcol], cols[scol], src_d,
+                    self._batch_idx(wr, 0, sp, np2),
                     self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
                     self._put_batch(_pad(ws, np2, K.NEUTRAL_T)), pb)
 
-            p2, s2, src2 = self._pallas_or_xla(_pallas, _xla)
+            out = self._pallas_or_xla(_pallas, _xla)
+            if isinstance(out, tuple) and len(out) == 2 and \
+                    out[0] == "split":
+                o_p_hi, o_p_lo, o_s_hi, o_s_lo, src2 = out[1]
+                split = res.get("split") or {}
+                split[pcol] = (o_p_hi, o_p_lo)
+                split[scol] = (o_s_hi, o_s_lo)
+                res["split"] = split
+                self._micro_done(fam, {}, src=src2,
+                                 recon={pcol: pcol, scol: scol},
+                                 written={pcol, scol}, rows=wr)
+                return
+            p2, s2, src2 = out
             self._micro_done(fam, {pcol: p2, scol: s2}, src=src2,
                              recon={pcol: pcol, scol: scol},
                              written={pcol, scol}, rows=wr)
         else:
+            # (the rare counter base pair: XLA int64 kernels; these
+            # columns are never split-cached — only src-tracked pairs)
             p2, s2, _win = B.bulk_lww(
-                p_d, s_d, self._batch_idx(wr, 0, sp, np2),
+                cols[pcol], cols[scol], self._batch_idx(wr, 0, sp, np2),
                 self._put_batch(_pad(wp, np2, K.NEUTRAL_T)),
                 self._put_batch(_pad(ws, np2, K.NEUTRAL_T)))
             self._micro_done(fam, {pcol: p2, scol: s2}, src=None,
@@ -1429,6 +1527,12 @@ class TpuMergeEngine:
             store.recompute_counter_sums()
             return
         from ..ops import dense as D
+        if res.get("split"):
+            # steady micro rounds left the val/uuid truth in the
+            # pre-split pair cache — the int64 cols are stale-by-design
+            # (the split-plane law); join before the device sum reads
+            # them, or cnt_sum would re-derive from pre-merge values
+            self._join_split(res)
         cols = res["cols"]
         ids = self._put_batch(store.cnt.kid[:n].astype(_I32))
         contrib = cols["val"][:n] - cols["base"][:n]
@@ -1437,6 +1541,525 @@ class TpuMergeEngine:
                                           interpret=interp),
             lambda: D.segment_sum(ids, contrib, n_seg=nk))
         store.keys.cnt_sum[:nk] = np.asarray(self._device_get(sums))
+
+    # ------------------------------------------------------ tensor registers
+    # The tensor-valued register family (crdt/tensor.py): contributor
+    # slot STAMPS (uuid/cnt columns) are host-authoritative — the merge
+    # decisions are tiny LWW compares, exactly the env-plane rule — while
+    # the payload ARRAYS, the part whose per-value work actually
+    # dominates, live in resident device pools keyed by (dtype, elems).
+    # A micro round folds each batch's duplicate slots on host, wins
+    # against the host uuid column, and scatters ONLY the winning
+    # payloads into the pool (one device call per class per batch);
+    # flush gathers and downloads exactly the dirty pool slots.  Batched
+    # reads (`tensor_read_many`) reduce contributor stacks ON DEVICE
+    # with the canonical-order kernels (ops/pallas_dense.py
+    # tensor_reduce + XLA twins) — byte-identical to the host reference
+    # (KeySpace.tensor_read), differential-tested.
+
+    def _tns_check(self, store: KeySpace) -> None:
+        """Tensor-pool staleness: an op-path tensor write bumped the
+        plane version, so every clean payload mirror may be stale —
+        drop the pools (they refill lazily).  Dirty slots present at a
+        version bump mean the flush-before-touch invariant broke
+        upstream: fail loud, exactly like _resident_state."""
+        ver = store.fam_ver["tns"]
+        if self._tns_ver != ver:
+            if any(p["dirty"] for p in self._tns_pools.values()):
+                raise RuntimeError(
+                    "tns pools invalidated with unflushed payloads "
+                    "(flush-before-touch invariant broken upstream)")
+            self._tns_pools.clear()
+            self._tns_bytes = 0
+            self._tns_ver = ver
+            self._tns_epoch += 1
+
+    def _tns_pool(self, store: KeySpace, meta) -> dict:
+        key = (meta.dtype_code, meta.elems)
+        pool = self._tns_pools.get(key)
+        if pool is None:
+            from ..ops import pallas_dense as PD
+            kp = max(K.next_pow2(meta.elems), PD.TENSOR_BLOCK)
+            pool = {"buf": None, "rows": np.full(0, -1, dtype=_I64),
+                    "map": {}, "n": 0, "cap": 0, "dirty": set(),
+                    "Kp": kp, "elems": meta.elems, "dtype": meta.dtype}
+            self._tns_pools[key] = pool
+        return pool
+
+    def _tns_slots(self, pool: dict, rows_store) -> np.ndarray:
+        """Pool slots for store rows, allocating (and growing the device
+        buffer with zero rows) for rows not yet resident."""
+        jnp = self._jax.numpy
+        m = pool["map"]
+        need = sum(1 for r in rows_store if r not in m)
+        if pool["n"] + need > pool["cap"]:
+            cap = K.next_pow2(max(pool["n"] + need, 64))
+            grown = np.full(cap, -1, dtype=_I64)
+            grown[: len(pool["rows"])] = pool["rows"]
+            pool["rows"] = grown
+            zeros = jnp.zeros((cap - pool["cap"], pool["Kp"]),
+                              dtype=pool["dtype"].name)
+            pool["buf"] = zeros if pool["buf"] is None else \
+                jnp.concatenate([pool["buf"], zeros])
+            self._tns_bytes += \
+                (cap - pool["cap"]) * pool["Kp"] * pool["dtype"].itemsize
+            pool["cap"] = cap
+        out = np.empty(len(rows_store), dtype=_I64)
+        for j, r in enumerate(rows_store):
+            slot = m.get(r)
+            if slot is None:
+                slot = pool["n"]
+                pool["n"] = slot + 1
+                m[r] = slot
+                pool["rows"][slot] = r
+            out[j] = slot
+        return out
+
+    # pow2 pad floor for tensor scatter stacks: winner counts vary per
+    # micro round, and each pow2 bucket is a pool_scatter re-trace —
+    # padding to a floor collapses the shape space (same reasoning as
+    # MICRO_SCATTER_PAD; pad rows scatter out of range and drop)
+    TNS_SCATTER_PAD = 128
+
+    def _tns_scatter(self, pool: dict, slots: np.ndarray,
+                     mats: list, dirty: bool) -> None:
+        """Scatter payload rows into a pool in one device call.  `mats`
+        are SIZE-VALIDATED payloads (wire bytes or flat arrays of the
+        pool dtype); `dirty` marks the slots device-newer than the host
+        list (merge winners) — uploads that MIRROR host payloads (read
+        staging) stay clean.
+
+        Hot path: an all-bytes batch whose elems fill the pool width
+        stacks via one C-speed join + zero-copy frombuffer instead of a
+        per-row fill loop (the fill loop was a top merge cost in the
+        tensor bench)."""
+        from ..ops import dense as D
+        w = len(slots)
+        wp = K.next_pow2(max(w, self.TNS_SCATTER_PAD))
+        kp = pool["Kp"]
+        dt = pool["dtype"]
+        # (wire payloads are little-endian; the zero-copy path needs the
+        # native order to match — every supported target is LE)
+        if pool["elems"] == kp and w and np.little_endian and \
+                all(type(m) is bytes for m in mats):
+            flat = np.frombuffer(b"".join(mats), dtype=dt).reshape(w, kp)
+            stack = flat if wp == w else \
+                np.concatenate([flat, np.zeros((wp - w, kp), dtype=dt)])
+        else:
+            stack = np.zeros((wp, kp), dtype=dt)
+            for j, m in enumerate(mats):
+                arr = m if isinstance(m, np.ndarray) \
+                    else np.frombuffer(m, dtype=dt.newbyteorder("<"))
+                stack[j, : len(arr)] = arr
+        idx = np.empty(wp, dtype=_I32)
+        idx[:w] = slots
+        if wp > w:  # out-of-range pads drop
+            idx[w:] = pool["cap"] + np.arange(wp - w, dtype=_I32)
+        pool["buf"] = D.pool_scatter(pool["buf"], self._put_batch(idx),
+                                     self._put_batch(stack))
+        if dirty:
+            pool["dirty"].update(slots.tolist())
+
+    def _merge_micro_tns(self, store: KeySpace, b: ColumnarBatch,
+                         kid_of: np.ndarray, st: MergeStats,
+                         device: bool) -> None:
+        """Merge one batch's tensor rows.  `device=False` is the host
+        reference (engine/hostbatch.merge_host_tns — the per-row loop);
+        `device=True` makes the same decisions in batch: fold duplicate
+        slots, win against the host uuid column, scatter the winning
+        payloads into the resident pools.  Differential-tested
+        byte-identical (tests/test_tensor_family.py)."""
+        from ..crdt import tensor as T
+        from .hostbatch import merge_host_tns
+        if not device:
+            n0 = st.tensor_rows
+            merge_host_tns(store, b, kid_of, st)
+            self.tns_host_rows += st.tensor_rows - n0
+            return
+        self._tns_check(store)
+        kid_arr = kid_of[b.tns_ki]
+        keep = np.nonzero(kid_arr >= 0)[0]
+        if not len(keep):
+            return
+        st.tensor_rows += len(keep)
+        self.tns_dev_rows += len(keep)
+        # count gate FIRST, matching the host reference's check order:
+        # tensor_merge_row runs check_count BEFORE installing a fresh
+        # key's config, so a batch whose every row for a key is
+        # count-invalid must leave tns_meta uninstalled on BOTH paths
+        cnt_ok = b.tns_cnt[keep] >= 1
+        if not cnt_ok.all():
+            log.error("skipping %d tensor rows: contribution count < 1",
+                      int((~cnt_ok).sum()))
+            keep = keep[cnt_ok]
+            if not len(keep):
+                return
+        # per-key config install/validate + per-row payload checks: the
+        # same skip rules as KeySpace.tensor_merge_row, decided once per
+        # distinct key where possible (bad rows drop exactly like type
+        # conflicts).  The common case — one config across the whole
+        # batch (a homogeneous aggregation stream) — validates once per
+        # DISTINCT KEY plus one vectorized size pass, no per-row python.
+        idx_list = keep.tolist()
+        metas: dict = {}
+        ok = np.ones(len(keep), dtype=bool)
+        cfg0 = b.tns_cfg[idx_list[0]]
+        uniform = True
+        for i in idx_list[1:]:
+            c = b.tns_cfg[i]
+            if c is not cfg0 and c != cfg0:
+                uniform = False
+                break
+        if uniform:
+            bad_kids = None
+            for kid in np.unique(kid_arr[keep]).tolist():
+                meta = store.tns_meta.get(kid)
+                try:
+                    if meta is None:
+                        meta = T.unpack_config(cfg0)
+                        store.tns_meta[kid] = meta
+                    elif T.pack_config(meta) != bytes(cfg0):
+                        raise T.TensorConfigError("tensor config mismatch")
+                    metas[kid] = meta
+                except T.TensorConfigError as e:
+                    log.error("skipping tensor rows for kid %d: %s",
+                              kid, e)
+                    metas[kid] = False
+                    bad_kids = True
+            if bad_kids:
+                ok &= np.fromiter(
+                    (metas[int(k)] is not False for k in kid_arr[keep]),
+                    dtype=bool, count=len(keep))
+            meta_u = next((m for m in metas.values()
+                           if m is not False), None)
+            if meta_u is not None:
+                # the shared validity predicate (T.payload_ok) — the
+                # same rule tensor_merge_row enforces via payload_array
+                bad_sz = np.fromiter(
+                    (not T.payload_ok(meta_u, p)
+                     for p in (b.tns_payload[i] for i in idx_list)),
+                    dtype=bool, count=len(keep))
+                if bad_sz.any():
+                    log.error("skipping %d tensor rows: bad payload "
+                              "(size/dtype)", int(bad_sz.sum()))
+                    ok &= ~bad_sz
+        else:
+            for j, i in enumerate(idx_list):
+                kid = int(kid_arr[i])
+                meta = metas.get(kid)
+                if meta is None:
+                    meta = store.tns_meta.get(kid)
+                    cfg = b.tns_cfg[i]
+                    try:
+                        if meta is None:
+                            meta = T.unpack_config(cfg)
+                            store.tns_meta[kid] = meta
+                        elif T.pack_config(meta) != bytes(cfg):
+                            raise T.TensorConfigError(
+                                "tensor config mismatch")
+                    except T.TensorConfigError as e:
+                        log.error("skipping tensor rows for kid %d: %s",
+                                  kid, e)
+                        metas[kid] = False
+                        ok[j] = False
+                        continue
+                    metas[kid] = meta
+                elif meta is False:
+                    ok[j] = False
+                    continue
+                else:
+                    cfg = b.tns_cfg[i]
+                    if T.pack_config(meta) != bytes(cfg):
+                        log.error("skipping tensor row for kid %d: "
+                                  "config mismatch", kid)
+                        ok[j] = False
+                        continue
+                if not T.payload_ok(meta, b.tns_payload[i]):
+                    log.error("skipping tensor row for kid %d: bad "
+                              "payload (size/dtype)", kid)
+                    ok[j] = False
+                    continue
+                store.tensor_count_merge(meta)
+        keep = keep[ok]
+        if not len(keep):
+            return
+        if uniform:
+            # per-strategy gauge: one bump per VALIDATED delivered row
+            # (the host reference counts in tensor_merge_row at the
+            # same point; a per-win count would depend on routing —
+            # the device path folds duplicates before its win test)
+            meta0 = next((m for m in metas.values() if m is not False),
+                         None)
+            if meta0 is not None:
+                store.tensor_count_merge(meta0, len(keep))
+        kids = kid_arr[keep]
+        nodes = b.tns_node[keep]
+        uuids = b.tns_uuid[keep]
+        cnts = b.tns_cnt[keep]
+        # resolve (kid, node) -> slot rows (creates neutral rows), then
+        # fold intra-batch duplicates: LWW on uuid, FIRST occurrence on
+        # exact ties (one node's equal stamps are the same write — the
+        # host loop's strict > keeps the first too)
+        rows = self._resolve_tns_rows(store, kids, nodes)
+        order = np.lexsort((-np.arange(len(rows)), uuids, rows))
+        r_s = rows[order]
+        last = np.nonzero(np.append(r_s[1:] != r_s[:-1], True))[0]
+        src = order[last]
+        wr = r_s[last]
+        wu = uuids[src]
+        cur = store.tns.uuid[wr]
+        win = wu > cur
+        if not win.any():
+            return
+        w_rows = wr[win]
+        w_src = src[win]
+        store.tns.uuid[w_rows] = wu[win]
+        store.tns.cnt[w_rows] = cnts[w_src]
+        # winners grouped per pool class, scattered in one call each
+        # (size-validated RAW payloads — _tns_scatter normalizes); host
+        # payload entries stay STALE until flush (the stamps above are
+        # what later merge decisions read — host-authoritative)
+        if uniform:
+            meta = next((m for m in metas.values() if m is not False),
+                        None)
+            if meta is not None:
+                mats = [b.tns_payload[int(keep[s_i])]
+                        for s_i in w_src.tolist()]
+                pool = self._tns_pool(store, meta)
+                slots = self._tns_slots(pool, w_rows.tolist())
+                self._tns_scatter(pool, slots, mats, dirty=True)
+        else:
+            classes: dict = {}
+            for r, s_i in zip(w_rows.tolist(), w_src.tolist()):
+                kid = int(kids[s_i])
+                meta = metas[kid]
+                ent = classes.setdefault((meta.dtype_code, meta.elems),
+                                         (meta, [], []))
+                ent[1].append(r)
+                ent[2].append(b.tns_payload[int(keep[s_i])])
+            for meta, rws, mats in classes.values():
+                pool = self._tns_pool(store, meta)
+                slots = self._tns_slots(pool, rws)
+                self._tns_scatter(pool, slots, mats, dirty=True)
+        self.needs_flush = True
+        if self._tns_bytes > self.tns_pool_cap:
+            # residency cap: sync the dirty payloads down and release
+            # the device pools (they refill lazily); loud in the log —
+            # a workload thrashing the cap should raise it
+            log.info("tensor pools over CONSTDB_TENSOR_POOL_MB; flushing "
+                     "and dropping %d pools (%d bytes)",
+                     len(self._tns_pools), self._tns_bytes)
+            self._flush_tns(store)
+            self._tns_pools.clear()
+            self._tns_bytes = 0
+            self._tns_epoch += 1
+
+    def _resolve_tns_rows(self, store: KeySpace, kids: np.ndarray,
+                          nodes: np.ndarray) -> np.ndarray:
+        """(kid, node) -> store tensor slot rows, creating neutral slots
+        for misses — the batched twin of KeySpace.tensor_slot_row."""
+        ranks = np.fromiter((store.rank_of(int(x)) for x in nodes),
+                            dtype=_I64, count=len(nodes))
+        combos = (kids << KeySpace.NODE_RANK_BITS) | ranks
+        rn0 = store.tns.n
+        rows, n_new = store.tns_index.get_or_assign_batch(combos,
+                                                          next_val=rn0)
+        if n_new:
+            created = np.nonzero(rows >= rn0)[0]
+            uniq_rows, first = np.unique(rows[created], return_index=True)
+            pos = created[first]
+            if len(uniq_rows) != n_new or int(uniq_rows[0]) != rn0 or \
+                    int(uniq_rows[-1]) != rn0 + n_new - 1:
+                span = f"[{int(uniq_rows[0])}, {int(uniq_rows[-1])}]" \
+                    if len(uniq_rows) else "[]"
+                raise RuntimeError(
+                    f"tns combo index issued non-contiguous rows {span} "
+                    f"(n={len(uniq_rows)}) for block "
+                    f"[{rn0}, {rn0 + n_new - 1}]")
+            store.tns.append_block(n_new, kid=kids[pos], node=nodes[pos],
+                                   uuid=K.NEUTRAL_T, cnt=0)
+            store.tns_payload.extend([None] * n_new)
+        return rows
+
+    def _flush_tns(self, store: KeySpace) -> None:
+        """Download dirty pool slots back into the host payload list —
+        the tensor half of the dirty-row flush discipline."""
+        for pool in self._tns_pools.values():
+            dirty = pool["dirty"]
+            if not dirty:
+                continue
+            slots = np.fromiter(dirty, dtype=_I64, count=len(dirty))
+            slots.sort()
+            self.flush_rows_full_equiv += pool["n"]
+            self.flush_rows_downloaded += len(slots)
+            np2 = K.next_pow2(max(len(slots), 1))
+            idx = self._put_batch(_pad(slots.astype(_I32), np2, 0))
+            got = np.asarray(self._device_get(
+                B.gather_rows(pool["buf"], idx)))[: len(slots)]
+            elems = pool["elems"]
+            rows = pool["rows"]
+            for j, slot in enumerate(slots.tolist()):
+                store.tensor_assign_payload(int(rows[slot]),
+                                            got[j, :elems].copy())
+            pool["dirty"] = set()
+
+    def tensor_read_many(self, store: KeySpace, kids) -> dict:
+        """Batched tensor reads: {kid: flat payload array (None when no
+        contribution landed)}.  With resident pools on, contributor
+        stacks reduce ON DEVICE (canonical-order kernels via
+        _pallas_or_xla; f64 and `lww` route to their exact twins) and
+        only the [G, K] results download — dirty payloads never
+        round-trip through the host.  Host-only engines/config read the
+        reference reduction (KeySpace.tensor_read).
+
+        The grouping/upload pass (contributor enumeration, pool-slot
+        resolution, missing-row staging, the device idx vector) is
+        CACHED between calls: contributor membership and canonical
+        order change only when slot rows are created (one slot per
+        (key, node), ordered by node), and pool slots only when pools
+        drop — the cache stamp covers both, so a steady read loop pays
+        per round only the per-round truth (count columns, lww stamps,
+        the reduce dispatches, the result download)."""
+        from ..crdt import tensor as T
+        from ..ops import dense as D
+        from ..ops import pallas_dense as PD
+        if not (self.resident and self.steady and self._mesh is None):
+            return {kid: store.tensor_read(kid) for kid in kids}
+        self._tns_check(store)
+        kids_t = tuple(kids)
+        # one staleness stamp for ALL cached key sets, then one entry
+        # per requested kids tuple — interleaved single-key GETs (the
+        # production Node.tensor_read pattern) each keep their own
+        # cached group/idx structure instead of thrashing one slot
+        stamp = (self._tns_epoch, self._tns_ver, store.tns.n)
+        rc = self._tns_read_cache
+        if rc.get("stamp") != stamp:
+            rc = self._tns_read_cache = {"stamp": stamp, "by_kids": {}}
+        cache = rc["by_kids"].get(kids_t)
+        if cache is None:
+            if len(rc["by_kids"]) >= 8192:  # bound a huge-keyspace scan
+                rc["by_kids"].clear()
+            cache = self._tns_read_build(store, kids_t)
+            rc["by_kids"][kids_t] = cache
+        out = dict(cache["empty"])
+        for grp in cache["groups"]:
+            (dcode, elems, strat, n, g, members, pool, idx_dev,
+             flat_rows, rows_mat, nodes_mat, slots_mat) = grp
+            buf = pool["buf"]
+            f32 = dcode == 0
+            if strat == T.STRAT_LWW:
+                # winner from host-authoritative stamps, vectorized:
+                # max uuid per key, writer node breaking exact ties;
+                # payload served from the pool (the dirty row's truth)
+                u = store.tns.uuid[rows_mat]
+                cand = u == u.max(axis=1, keepdims=True)
+                w = np.where(cand, nodes_mat,
+                             np.int64(-1) << 62).argmax(axis=1)
+                idx = slots_mat[np.arange(g), w].astype(_I32)
+                got = np.asarray(self._device_get(B.gather_rows(
+                    buf, self._put_batch(idx))))
+                for j, kid in enumerate(members):
+                    out[kid] = got[j, :elems]
+                continue
+            # trimmed-mean divisor as a RUNTIME scalar (a constant
+            # divisor strength-reduces to a reciprocal multiply and
+            # rounds away from the host's true division)
+            div = pool["dtype"].type(n if n <= 2 else n - 2)
+            cnts_f = store.tns.cnt[flat_rows].reshape(g, n).astype(
+                pool["dtype"])
+            cnts_dev = self._put_batch(cnts_f)
+
+            def _reduce(s_id):
+                # XLA fuses the pool gather INTO the fold
+                # (tensor_take_reduce — one dispatch, no [G, n, Kp]
+                # intermediate); the Pallas leg keeps the
+                # correctness-pinned two-step (gather + block kernel)
+                if f32:
+                    return self._pallas_or_xla(
+                        lambda interp: PD.tensor_reduce(
+                            B.gather_rows(buf, idx_dev).reshape(
+                                g, n, pool["Kp"]),
+                            cnts_dev, div, strat=s_id, n=n,
+                            interpret=interp),
+                        lambda: D.tensor_take_reduce(buf, idx_dev, div,
+                                                     strat=s_id, n=n,
+                                                     g=g))
+                return D.tensor_take_reduce(buf, idx_dev, div,
+                                            strat=s_id, n=n, g=g)
+
+            if strat == T.STRAT_AVG:
+                # gather+scale fused, then sum+div — the product
+                # rounding still lands on the dispatch boundary between
+                # them (ops/dense.py tensor_take_scale); count totals
+                # accumulate on host with the canonical sequential
+                # dtype chain
+                # vectorized over KEYS, sequential over contributors:
+                # elementwise float adds in the same per-key order as
+                # the scalar chain — bit-identical, n numpy ops instead
+                # of g*n interpreted iterations per read round
+                t = cnts_f[:, 0].copy()
+                for i in range(1, n):
+                    t = t + cnts_f[:, i]
+                tots_dev = self._put_batch(t.reshape(g, 1))
+                wmat = D.tensor_take_scale(buf, idx_dev, cnts_dev,
+                                           n=n, g=g)
+                if f32:
+                    red = self._pallas_or_xla(
+                        lambda interp: D.tensor_div(
+                            PD.tensor_reduce(wmat, cnts_dev, div,
+                                             strat=T.STRAT_SUM, n=n,
+                                             interpret=interp),
+                            tots_dev),
+                        lambda: D.tensor_sum_div(wmat, tots_dev, n=n))
+                else:
+                    red = D.tensor_sum_div(wmat, tots_dev, n=n)
+            else:
+                red = _reduce(strat)
+            got = np.asarray(self._device_get(red))
+            for j, kid in enumerate(members):
+                out[kid] = got[j, :elems]
+        return out
+
+    def _tns_read_build(self, store: KeySpace, kids_t: tuple) -> dict:
+        """Build (and stage) the cached read-group structure for one key
+        set: contributor rows in canonical order per key, grouped by
+        (dtype, elems, strategy, n); rows not yet pool-resident upload
+        as CLEAN mirrors; the flat pool-slot idx vector ships to the
+        device once."""
+        raw: dict = {}
+        empty: dict = {}
+        for kid in kids_t:
+            meta = store.tns_meta.get(kid)
+            rows = store.tensor_contrib_rows(kid)
+            if meta is None or not rows:
+                empty[kid] = None
+                continue
+            raw.setdefault((meta.dtype_code, meta.elems, meta.strat,
+                            len(rows)), []).append((kid, meta, rows))
+        groups = []
+        for (dcode, elems, strat, n), mem in raw.items():
+            pool = self._tns_pool(store, mem[0][1])
+            flat = np.fromiter((r for _k, _m, rows in mem for r in rows),
+                               dtype=_I64, count=len(mem) * n)
+            missing = [r for r in dict.fromkeys(flat.tolist())
+                       if r not in pool["map"]]
+            if missing:
+                mats = [store.tns_payload[r] for r in missing]
+                slots = self._tns_slots(pool, missing)
+                self._tns_scatter(pool, slots, mats, dirty=False)
+            g = len(mem)
+            m = pool["map"]
+            slots_mat = np.fromiter((m[r] for r in flat.tolist()),
+                                    dtype=_I64,
+                                    count=g * n).reshape(g, n)
+            rows_mat = flat.reshape(g, n)
+            groups.append((dcode, elems, strat, n, g,
+                           [kid for kid, _m2, _r in mem], pool,
+                           self._put_batch(
+                               slots_mat.reshape(-1).astype(_I32)),
+                           flat, rows_mat, store.tns.node[rows_mat],
+                           slots_mat))
+        return {"empty": empty, "groups": groups}
 
     # ------------------------------------------------------- key resolution
 

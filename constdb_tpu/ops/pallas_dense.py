@@ -207,6 +207,28 @@ _NEUTRAL64 = jnp.int64(-(1 << 62))
 from .segment import next_pow2 as _pow2  # noqa: E402
 
 
+def split_plane(x):
+    """int64 plane [Sp] -> pre-split ((Sp, 1) int32 hi, (Sp, 1) uint32
+    lo) COLUMN form — the storage layout `scatter_pair_src_split`
+    consumes and produces, so consecutive micro rounds never pay the
+    O(plane) split/join wrapper (the PR 8 flagged follow-up).  Column
+    shape on purpose: the kernel reads (1, 1) blocks of (Sp, 1) planes,
+    and keeping the stored form identical to the kernel form lets the
+    jit-level donation alias buffers across rounds."""
+    hi, lo = _split64(x)
+    return hi.reshape(-1, 1), lo.reshape(-1, 1)
+
+
+split_plane = jax.jit(split_plane)
+
+
+@jax.jit
+def join_plane(hi, lo):
+    """Pre-split (Sp, 1) pair -> int64 [Sp] (the bulk kernels and the
+    resident-state grow path still speak int64)."""
+    return _join64(hi[:, 0], lo[:, 0])
+
+
 def _scatter_pair_kernel(idx_ref, base_ref,
                          p_hi, p_lo, s_hi, s_lo, src,
                          bp_hi, bp_lo, bs_hi, bs_lo,
@@ -229,32 +251,26 @@ def _scatter_pair_kernel(idx_ref, base_ref,
     o_src[0, 0] = jnp.where(win, base_ref[0] + jnp.int32(i), src[0, 0])
 
 
-@partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0, 1, 2))
-def scatter_pair_src(p, s, src, idx, bp, bs, base, interpret: bool = False):
-    """Gather-compare-scatter one LWW pair against resident state planes.
+@partial(jax.jit, static_argnames=("interpret",),
+         donate_argnums=(0, 1, 2, 3, 4))
+def scatter_pair_src_split(p_hi, p_lo, s_hi, s_lo, src, idx, bp, bs, base,
+                           interpret: bool = False):
+    """Gather-compare-scatter one LWW pair against PRE-SPLIT resident
+    state planes — the steady-state form of `scatter_pair_src`.
 
-    `p`/`s` [Sp] int64 (primary/secondary: registers (t, node), element
-    adds (add_t, add_node), counter pairs (uuid, val)); `src` [Sp] int32
-    win-source plane; `idx` [Np] int32 slot rows, UNIQUE over the real
-    prefix and PRE-PADDED to a pow2 length (padding targets an in-range
-    state row, ideally a plane padding row); `bp`/`bs` [Np] int64 batch
-    columns, padded with NEUTRAL (losing) values; `base` int32 pool id of
-    the batch's first row — row j's pool id is derived as base + j, so
-    ids never upload.  -> (p, s, src) merged in place — bit-identical to
-    ops/bulk.py bulk_lww_src (differential-tested).
-
-    Known cost (flagged for the v5e round): the int64<->hi/lo split and
-    join around the kernel are whole-plane XLA passes per call (VMEM
-    lanes are 32-bit, and the int64 inputs cannot alias the 32-bit
-    outputs, so the p/s donations are dead) — the KERNEL DMAs only the
-    addressed rows, but eliminating the O(plane) wrapper means storing
-    the resident planes pre-split as hi/lo pairs, a cross-kernel layout
-    change deferred until real-TPU profiling justifies it.  The XLA twin
-    (the CPU-backend default) has no such pass."""
+    `p_hi`/`s_hi` [Sp, 1] int32 and `p_lo`/`s_lo` [Sp, 1] uint32 are the
+    hi/lo halves of the int64 planes in `split_plane`'s column layout;
+    `src` [Sp] int32; `idx`/`bp`/`bs`/`base` exactly as in the int64
+    wrapper below.  -> (p_hi, p_lo, s_hi, s_lo, src) merged IN PLACE:
+    input and output dtypes now MATCH, so the `input_output_aliases` are
+    true aliases and the jit-level donations are live — consecutive
+    micro rounds on a warm plane run ZERO whole-plane passes (the PR 8
+    flagged follow-up: the old wrapper re-split and re-joined the full
+    plane around every call).  engine/tpu.py keeps the split pair as the
+    plane's truth between rounds and joins only at bulk-round / grow
+    boundaries (`join_plane`)."""
     np_ = idx.shape[0]
-    sp = p.shape[0]
-    p_hi, p_lo = (x.reshape(sp, 1) for x in _split64(p))
-    s_hi, s_lo = (x.reshape(sp, 1) for x in _split64(s))
+    sp = p_hi.shape[0]
     bp_hi, bp_lo = (x.reshape(np_, 1) for x in _split64(bp))
     bs_hi, bs_lo = (x.reshape(np_, 1) for x in _split64(bs))
     state_spec = pl.BlockSpec((1, 1), lambda i, idx_ref, base_ref:
@@ -281,8 +297,107 @@ def scatter_pair_src(p, s, src, idx, bp, bs, base, interpret: bool = False):
       p_hi, p_lo, s_hi, s_lo, src.reshape(sp, 1),
       bp_hi, bp_lo, bs_hi, bs_lo)
     o_p_hi, o_p_lo, o_s_hi, o_s_lo, o_src = out
-    return (_join64(o_p_hi[:, 0], o_p_lo[:, 0]),
-            _join64(o_s_hi[:, 0], o_s_lo[:, 0]), o_src[:, 0])
+    return o_p_hi, o_p_lo, o_s_hi, o_s_lo, o_src[:, 0]
+
+
+def scatter_pair_src(p, s, src, idx, bp, bs, base, interpret: bool = False):
+    """Gather-compare-scatter one LWW pair against resident state planes.
+
+    `p`/`s` [Sp] int64 (primary/secondary: registers (t, node), element
+    adds (add_t, add_node), counter pairs (uuid, val)); `src` [Sp] int32
+    win-source plane; `idx` [Np] int32 slot rows, UNIQUE over the real
+    prefix and PRE-PADDED to a pow2 length (padding targets an in-range
+    state row, ideally a plane padding row); `bp`/`bs` [Np] int64 batch
+    columns, padded with NEUTRAL (losing) values; `base` int32 pool id of
+    the batch's first row — row j's pool id is derived as base + j, so
+    ids never upload.  -> (p, s, src) merged in place — bit-identical to
+    ops/bulk.py bulk_lww_src (differential-tested).
+
+    Compatibility wrapper: splits the int64 planes, runs
+    `scatter_pair_src_split`, joins back.  The split/join are O(plane)
+    XLA passes PER CALL — steady-state callers (engine/tpu.py) keep the
+    planes pre-split across rounds instead and call the split kernel
+    directly, which is the whole point of the layout change."""
+    p_hi, p_lo = split_plane(p)
+    s_hi, s_lo = split_plane(s)
+    o_p_hi, o_p_lo, o_s_hi, o_s_lo, o_src = scatter_pair_src_split(
+        p_hi, p_lo, s_hi, s_lo, src, idx, bp, bs, base,
+        interpret=interpret)
+    return join_plane(o_p_hi, o_p_lo), join_plane(o_s_hi, o_s_lo), o_src
+
+
+# ------------------------------------------------------ tensor registers
+# Strategy reduction over contributor stacks (crdt/tensor.py): one grid
+# step owns one (key, K-block) tile, loads the [n, BLOCK] contributor
+# slab, and folds it with the EXACT sequential operation chain of
+# crdt.tensor.reduce_rows (the canonical-order law: float reductions are
+# order-fixed so replicas cannot diverge through summation order; the
+# XLA twin in ops/dense.py unrolls the same chain).  f32 only — TPU VMEM
+# lanes are 32-bit; the engine routes f64 tensors onto the XLA twin.
+
+TENSOR_BLOCK = 512
+
+
+def _tensor_reduce_kernel(mat, cnts, div, out, *, strat: int, n: int):
+    # avg never reaches the kernel: its multiply-add chain would FMA-
+    # contract (no intermediate rounding — diverging from the host's
+    # rounded products), so it composes as scale → STRAT_SUM → divide
+    # across dispatch boundaries (ops/dense.py tensor_scale docstring).
+    # `div` is the trimmed divisor as a RUNTIME operand — a constant
+    # divisor gets strength-reduced to a reciprocal multiply, which
+    # rounds differently from the host's true division.
+    from ..crdt.tensor import STRAT_MAXMAG, STRAT_SUM, STRAT_TRIMMED
+    del cnts
+    if strat == STRAT_SUM:
+        acc = mat[0, 0, :]
+        for i in range(1, n):
+            acc = acc + mat[0, i, :]
+    elif strat == STRAT_MAXMAG:
+        acc = mat[0, 0, :]
+        for i in range(1, n):
+            acc = jnp.where(jnp.abs(mat[0, i, :]) > jnp.abs(acc),
+                            mat[0, i, :], acc)
+    elif strat == STRAT_TRIMMED and n <= 2:
+        acc = mat[0, 0, :]
+        for i in range(1, n):
+            acc = acc + mat[0, i, :]
+        acc = acc / div[0, 0]
+    elif strat == STRAT_TRIMMED:
+        s = mat[0, 0, :]
+        mn = mat[0, 0, :]
+        mx = mat[0, 0, :]
+        for i in range(1, n):
+            s = s + mat[0, i, :]
+            mn = jnp.minimum(mn, mat[0, i, :])
+            mx = jnp.maximum(mx, mat[0, i, :])
+        acc = (s - mn - mx) / div[0, 0]
+    else:
+        raise ValueError(f"tensor_reduce kernel: strategy {strat}")
+    out[0, :] = acc
+
+
+@partial(jax.jit, static_argnames=("strat", "n", "interpret"))
+def tensor_reduce(mat, cnts, div, *, strat: int, n: int,
+                  interpret: bool = False):
+    """[G, n, Kp] f32 contributor stacks (canonical (node, uuid) row
+    order, Kp a TENSOR_BLOCK multiple) -> [G, Kp] strategy reduction;
+    `cnts` [G, n] f32; `div` the trimmed divisor as a runtime f32
+    scalar.  Bit-identical to ops/dense.py tensor_reduce and
+    crdt.tensor.reduce_rows."""
+    G, n_, Kp = mat.shape
+    assert n_ == n and Kp % TENSOR_BLOCK == 0
+    assert mat.dtype == jnp.float32, "pallas tensor_reduce is f32-only"
+    grid = (G, Kp // TENSOR_BLOCK)
+    return pl.pallas_call(
+        partial(_tensor_reduce_kernel, strat=strat, n=n),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, n, TENSOR_BLOCK), lambda g, k: (g, 0, k)),
+                  pl.BlockSpec((1, n), lambda g, k: (g, 0)),
+                  pl.BlockSpec((1, 1), lambda g, k: (0, 0))],
+        out_specs=pl.BlockSpec((1, TENSOR_BLOCK), lambda g, k: (g, k)),
+        out_shape=jax.ShapeDtypeStruct((G, Kp), jnp.float32),
+        interpret=interpret,
+    )(mat, cnts, jnp.reshape(div, (1, 1)))
 
 
 # per-key counter-sum scratch cap: two (1, n_seg) int32 planes must fit

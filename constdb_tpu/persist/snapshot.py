@@ -244,6 +244,18 @@ def _encode_batch(b: ColumnarBatch, skip_keys: bool = False,
     _write_bytes_list(out, b.del_keys)
     _write_i64_col(out, b.del_t)
     out.append(1 if b.rows_unique_per_slot else 0)
+
+    # tensor planes (always written — one varint when empty; decoders
+    # treat an exhausted payload as zero rows, so pre-tensor snapshot
+    # FILES stay loadable)
+    nt = len(b.tns_ki)
+    write_uvarint(out, nt)
+    if nt:
+        for col in (b.tns_ki, b.tns_node, b.tns_uuid, b.tns_cnt):
+            _write_i64_col(out, col)
+        _write_bytes_list(out, list(b.tns_cfg))
+        _write_bytes_list(out, [p.tobytes() if isinstance(p, np.ndarray)
+                                else p for p in b.tns_payload])
     return out
 
 
@@ -296,6 +308,15 @@ def _decode_batch(payload: bytes, keys: Optional[list] = None,
     b.del_keys = _read_bytes_list(r, nd)
     b.del_t = _read_i64_col(r, nd)
     b.rows_unique_per_slot = bool(r.byte())
+    if r.pos < len(r.buf):  # tensor planes (absent in pre-tensor files)
+        nt = r.uvarint()
+        if nt:
+            b.tns_ki = _read_i64_col(r, nt)
+            b.tns_node = _read_i64_col(r, nt)
+            b.tns_uuid = _read_i64_col(r, nt)
+            b.tns_cnt = _read_i64_col(r, nt)
+            b.tns_cfg = _read_bytes_list(r, nt)
+            b.tns_payload = _read_bytes_list(r, nt)
     return b
 
 
@@ -340,6 +361,12 @@ def batch_chunks(batch: ColumnarBatch,
     el_presorted = bool(len(el_arr) == 0 or (np.diff(el_arr) >= 0).all())
     el_order = None if el_presorted else np.argsort(el_arr, kind="stable")
     el_sorted = el_arr if el_presorted else el_arr[el_order]
+    tns_arr = np.asarray(batch.tns_ki)
+    tns_presorted = bool(len(tns_arr) == 0
+                         or (np.diff(tns_arr) >= 0).all())
+    tns_order = None if tns_presorted \
+        else np.argsort(tns_arr, kind="stable")
+    tns_sorted = tns_arr if tns_presorted else tns_arr[tns_order]
     # one values scan for the whole batch; chunks inherit the hint (the
     # engine otherwise rescans per chunk per replica)
     el_hv = batch.el_has_vals
@@ -391,6 +418,22 @@ def batch_chunks(batch: ColumnarBatch,
         c.el_add_t = np.asarray(batch.el_add_t)[rows]
         c.el_add_node = np.asarray(batch.el_add_node)[rows]
         c.el_del_t = np.asarray(batch.el_del_t)[rows]
+
+        if len(tns_arr):
+            a, z = (int(x) for x in np.searchsorted(tns_sorted, (lo, hi)))
+            if tns_presorted:
+                rows = slice(a, z)
+                c.tns_cfg = batch.tns_cfg[a:z]
+                c.tns_payload = batch.tns_payload[a:z]
+            else:
+                rows = tns_order[a:z]
+                idx = rows.tolist()
+                c.tns_cfg = [batch.tns_cfg[i] for i in idx]
+                c.tns_payload = [batch.tns_payload[i] for i in idx]
+            c.tns_ki = tns_arr[rows] - lo
+            c.tns_node = np.asarray(batch.tns_node)[rows]
+            c.tns_uuid = np.asarray(batch.tns_uuid)[rows]
+            c.tns_cnt = np.asarray(batch.tns_cnt)[rows]
 
         if lo == 0 and batch.del_keys:
             c.del_keys = list(batch.del_keys)

@@ -102,7 +102,8 @@ class BatchBuilder:
     duplicate-safe reductions."""
 
     __slots__ = ("ks", "keys", "enc", "ct", "mt", "dt", "reg_runs",
-                 "_dels", "cnt_rows", "el_rows", "_el_has_vals", "n_rows")
+                 "_dels", "cnt_rows", "el_rows", "tns_rows",
+                 "_el_has_vals", "n_rows")
 
     def __init__(self, ks) -> None:
         self.ks = ks
@@ -120,8 +121,10 @@ class BatchBuilder:
         #   cnt_rows: (ki, node, total, uuid, base, base_t)
         #   el_rows:  (ki, members, vals-or-None, add_t, add_node,
         #              del_t, dt_check)
+        #   tns_rows: (ki, node, uuid, cnt, cfg, payload-bytes)
         self.cnt_rows: list[tuple] = []
         self.el_rows: list[tuple] = []
+        self.tns_rows: list[tuple] = []
         self._el_has_vals = False
         self.n_rows = 0
 
@@ -232,6 +235,15 @@ class BatchBuilder:
                     kill = check & (b.el_add_t < row_dt)
                     if kill.any():
                         b.el_del_t = np.where(kill, row_dt, b.el_del_t)
+        if self.tns_rows:
+            nt = len(self.tns_rows)
+            cols = list(zip(*self.tns_rows))
+            (b.tns_ki, b.tns_node, b.tns_uuid,
+             b.tns_cnt) = (np.fromiter(c, dtype=_I64, count=nt)
+                           for c in cols[:4])
+            b.tns_cfg = list(cols[4])
+            b.tns_payload = list(cols[5])
+
         if self._dels:
             b.del_keys = list(self._dels.keys())
             b.del_t = np.fromiter(self._dels.values(), dtype=_I64,

@@ -171,7 +171,16 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
     if cmd.flags & CMD_REPL_ONLY:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
-    node.ensure_flushed()  # device-resident merge results become readable
+    if name in TENSOR_DEVICE_READS:
+        # tensor reads are served DEVICE-FIRST (Node.tensor_read): they
+        # touch only the env plane (query/alive — flushed narrowly
+        # here) and host-authoritative slot stamps; the payload truth
+        # stays in the resident pools, so the blanket flush would force
+        # the very dirty-row round-trip the steady tensor path exists
+        # to avoid
+        node.ensure_flushed_for(("env",))
+    else:
+        node.ensure_flushed()  # device merge results become readable
     if uuid is None:
         uuid = node.hlc.tick(cmd.is_write)
     ctx = ExecCtx(uuid, node.node_id, False, client)
@@ -266,7 +275,7 @@ def del_command(node, ctx, args):
     enc = ks.enc_of(kid)
     ct, mt, dt = ks.envelope(kid)
     deleted = 0
-    if enc in (S.ENC_COUNTER, S.ENC_BYTES):
+    if enc in (S.ENC_COUNTER, S.ENC_BYTES, S.ENC_TENSOR):
         # no deletion while unseen later modifications exist (reference
         # policy for client-originated deletes, cmd.rs:232-235)
         if mt <= uuid and ct >= dt:
@@ -285,6 +294,8 @@ def del_command(node, ctx, args):
                     rep.append(Int(slot_node))
                     rep.append(Int(total))
                 node.replicate_cmd(uuid, b"delcnt", rep)
+            elif enc == S.ENC_TENSOR:
+                node.replicate_cmd(uuid, b"deltensor", [Bulk(key)])
             else:
                 node.replicate_cmd(uuid, b"delbytes", [Bulk(key)])
     elif enc in _DEL_COLLECTION_CMD:
@@ -842,6 +853,180 @@ def dellist_command(node, ctx, args):
 
 
 # ====================================================================
+# tensor-valued registers (crdt/tensor.py — the two-layer CRDT of
+# arXiv 2605.19373): dense float arrays whose merge is a per-node
+# contributor-slot LWW and whose read is a registered strategy
+# reduction in canonical (node, uuid) order.  Shape/dtype/strategy are
+# FIXED at key creation; contributions replicate as the absolute
+# rewrite `tset` (idempotent LWW assignment on the wire, like cntset).
+# ====================================================================
+
+
+def _tensor_error(e) -> CstError:
+    return InvalidRequestMsg(str(e))
+
+
+def _tensor_knobs() -> tuple[str, int]:
+    from ..conf import env_int, env_str
+    return (env_str("CONSTDB_TENSOR_STRATEGY", "lww"),
+            env_int("CONSTDB_TENSOR_MAX_ELEMS", 1 << 22))
+
+
+@register("tensor.set", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "tns"))
+def tensor_set_command(node, ctx, args):
+    """TENSOR.SET key strategy dtype shape payload [count] — create the
+    key (fixing strategy/dtype/shape) and assign this node's
+    contributor slot.  `strategy` may be `-` for the configured default
+    (CONSTDB_TENSOR_STRATEGY); `shape` is `4096` or `64x64`; `payload`
+    is the raw little-endian array bytes; `count` weights the `avg`
+    strategy (default 1)."""
+    from ..crdt import tensor as T
+
+    key = args.next_bytes()
+    strat_s = args.next_str()
+    dtype_s = args.next_str()
+    shape_s = args.next_str()
+    payload = args.next_bytes()
+    cnt = args.next_int() if args.has_more else 1
+    default_strat, max_elems = _tensor_knobs()
+    if node.ks.lookup(key) >= 0:
+        # the size cap guards key CREATION only — config is
+        # creation-fixed, so writes to an existing key must keep
+        # working after the knob is lowered (README Tuning row)
+        max_elems = 1 << 62
+    try:
+        T.check_count(cnt)
+        meta = T.parse_meta(strat_s, dtype_s, shape_s,
+                            default_strat=default_strat,
+                            max_elems=max_elems)
+        cfg = T.pack_config(meta)
+        arr = T.payload_array(meta, payload)
+        kid = node.ks.tensor_get_or_create(key, cfg, ctx.uuid)
+    except T.TensorConfigError as e:
+        raise _tensor_error(e) from None
+    node.ks.tensor_count_merge(meta)
+    node.ks.tensor_slot_set(kid, ctx.nodeid, ctx.uuid, cnt, arr)
+    node.ks.updated_at(kid, ctx.uuid)
+    node.replicate_cmd(ctx.uuid, b"tset",
+                       [Bulk(key), Bulk(cfg), Int(cnt), Bulk(payload)])
+    return OK
+
+
+@register("tensor.merge", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "tns"))
+def tensor_merge_command(node, ctx, args):
+    """TENSOR.MERGE key payload [count] — contribute a payload to an
+    EXISTING tensor key (the config came from its creation)."""
+    from ..crdt import tensor as T
+
+    key = args.next_bytes()
+    payload = args.next_bytes()
+    cnt = args.next_int() if args.has_more else 1
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        raise InvalidRequestMsg("no such tensor key (TENSOR.SET creates)")
+    if ks.enc_of(kid) != S.ENC_TENSOR:
+        raise _invalid_type()
+    meta = ks.tensor_meta_of(kid)
+    if meta is None:
+        # a tensor key can exist config-less (a replicated `deltensor`
+        # for a never-seen key materializes the tombstoned row only):
+        # without a creation-fixed config there is nothing to validate
+        # the payload against — same error as an absent key
+        raise InvalidRequestMsg("no such tensor key (TENSOR.SET creates)")
+    try:
+        T.check_count(cnt)
+        arr = T.payload_array(meta, payload)
+    except T.TensorConfigError as e:
+        raise _tensor_error(e) from None
+    ks.tensor_count_merge(meta)
+    ks.tensor_slot_set(kid, ctx.nodeid, ctx.uuid, cnt, arr)
+    ks.updated_at(kid, ctx.uuid)
+    node.replicate_cmd(ctx.uuid, b"tset",
+                       [Bulk(key), Bulk(T.pack_config(meta)), Int(cnt),
+                        Bulk(payload)])
+    return OK
+
+
+@register("tensor.get", CMD_READONLY)
+def tensor_get_command(node, ctx, args):
+    """TENSOR.GET key — the strategy reduction over the live contributor
+    set, as raw little-endian bytes (reshape client-side via STAT)."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return NIL
+    if ks.enc_of(kid) != S.ENC_TENSOR:
+        raise _invalid_type()
+    out = node.tensor_read(kid)  # device-first (resident pools)
+    if out is None:
+        return NIL
+    return Bulk(out.tobytes())
+
+
+@register("tensor.stat", CMD_READONLY)
+def tensor_stat_command(node, ctx, args):
+    """TENSOR.STAT key — config + contributor stamps: [strategy, dtype,
+    shape, n_contributors, total_count, [node uuid count]...]."""
+    from ..crdt import tensor as T
+
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0 or not ks.alive(kid):
+        return NIL
+    if ks.enc_of(kid) != S.ENC_TENSOR:
+        raise _invalid_type()
+    meta = ks.tensor_meta_of(kid)
+    if meta is None:
+        return NIL
+    contribs = ks.tensor_contribs(kid)
+    return Arr([
+        Bulk(meta.strat_name.encode()),
+        Bulk(T.DTYPE_NAMES[meta.dtype_code].encode()),
+        Bulk("x".join(str(d) for d in meta.shape).encode()),
+        Int(len(contribs)),
+        Int(sum(c for _n, _u, c, _p in contribs)),
+        Arr([Arr([Int(n_), Int(u), Int(c)])
+             for n_, u, c, _p in contribs]),
+    ])
+
+
+@register("tset", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "tns"))
+def tset_command(node, ctx, args):
+    """Replicated tensor contribution: absolute (cfg, count, payload)
+    assignment of the originator's slot at the frame uuid."""
+    key = args.next_bytes()
+    cfg = args.next_bytes()
+    cnt = args.next_int()
+    payload = args.next_bytes()
+    kid, _created = node.ks.get_or_create(key, S.ENC_TENSOR, ctx.uuid)
+    # snapshot-merge semantics on config/payload problems: log + skip
+    # (tensor_merge_row), exactly like the engine paths
+    node.ks.tensor_merge_row(kid, ctx.nodeid, ctx.uuid, cnt, cfg, payload)
+    node.ks.updated_at(kid, ctx.uuid)
+    return NO_REPLY
+
+
+@register("deltensor", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env",))
+def deltensor_command(node, ctx, args):
+    """Tensor key delete: an envelope-level tombstone (add-wins — a
+    later contribution resurrects the key with its full contributor
+    set, like registers; slots are never swept)."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.lookup(key)
+    if kid < 0:
+        kid = ks.create_key(key, S.ENC_TENSOR, 0)
+    elif ks.enc_of(kid) != S.ENC_TENSOR:
+        raise _invalid_type()
+    ks.set_delete_time(kid, ctx.uuid)
+    ks.record_key_delete(key, ctx.uuid)
+    return NO_REPLY
+
+
+# ====================================================================
 # expiry (capability completion: the reference ships the machinery with no
 # command — SURVEY.md §"Known reference defects"; db.rs:53-71)
 # ====================================================================
@@ -917,6 +1102,13 @@ COLUMNAR_ENCODERS: dict[bytes, Callable] = {}
 KEY_SCOPED_BARRIERS = frozenset(
     (b"delset", b"deldict", b"delmv", b"dellist", b"expireat", b"mvwrite"))
 STATE_FREE_BARRIERS = frozenset((b"meet", b"forget"))
+
+# Tensor reads skip execute()'s blanket flush (see the dispatch body):
+# everything they read is env (narrow-flushed) or host-authoritative
+# tensor stamps, and TENSOR.GET reduces from the resident device pools
+# (Node.tensor_read) — the family's whole point is that reads do not
+# force payload round-trips.
+TENSOR_DEVICE_READS = frozenset((b"tensor.get", b"tensor.stat"))
 
 
 def columnar(name: str):
@@ -1063,6 +1255,27 @@ def _enc_delbytes(bb, recs) -> None:
                     [r[2] for r in recs])
 
 
+@columnar("tset")
+def _enc_tset(bb, recs) -> None:
+    """Tensor contributions: pure slot LWW assignments — they commute
+    with everything a pending batch can hold.  Payloads stay raw bytes
+    in the batch (the engine normalizes via the row's cfg at merge)."""
+    rows = [(as_bytes(r[3][6]), as_int(r[3][7]), as_bytes(r[3][8]))
+            for r in recs]  # (cfg, cnt, payload) — parse before mutate
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_TENSOR,
+                      [r[2] for r in recs])
+    bb.tns_rows.extend(
+        (ki0 + i, r[1], r[2], cnt, cfg, payload)
+        for i, (r, (cfg, cnt, payload)) in enumerate(zip(recs, rows)))
+    bb.n_rows += len(rows)
+
+
+@columnar("deltensor")
+def _enc_deltensor(bb, recs) -> None:
+    bb.add_del_keys([r[0] for r in recs], S.ENC_TENSOR,
+                    [r[2] for r in recs])
+
+
 @columnar("delcnt")
 def _enc_delcnt(bb, recs) -> None:
     """Counter delete: key tombstone + each listed slot's delete-observed
@@ -1187,8 +1400,17 @@ def _senc_elem_rems(enc: int):
     return enc_fn
 
 
+def _senc_tset(bb, recs, nodeid):
+    ki0 = bb.add_keys([r[0] for r in recs], S.ENC_TENSOR,
+                      [r[1] for r in recs])
+    bb.tns_rows.extend((ki0 + i, nodeid, r[1], r[3], r[2], r[4])
+                       for i, r in enumerate(recs))
+    bb.n_rows += len(recs)
+
+
 SERVE_ENCODERS[b"set"] = _senc_set
 SERVE_ENCODERS[b"cntset"] = _senc_cntset
+SERVE_ENCODERS[b"tset"] = _senc_tset
 SERVE_ENCODERS[b"sadd"] = _senc_elem_adds(S.ENC_SET, with_vals=False)
 SERVE_ENCODERS[b"hset"] = _senc_elem_adds(S.ENC_DICT, with_vals=True)
 SERVE_ENCODERS[b"srem"] = _senc_elem_rems(S.ENC_SET)
@@ -1204,7 +1426,7 @@ SERVE_ENCODERS[b"hdel"] = _senc_elem_rems(S.ENC_DICT)
 # whose uuids must stay ordered with the pending run's).
 SERVE_KEY_SCOPED_READS = frozenset(
     (b"get", b"smembers", b"hget", b"hgetall", b"lrange", b"llen",
-     b"ttl", b"desc", b"mvget"))
+     b"ttl", b"desc", b"mvget", b"tensor.get", b"tensor.stat"))
 
 _INT0 = Int(0)
 
@@ -1334,6 +1556,100 @@ def _plan_srem(coal, items):
 @serve_plan("hdel")
 def _plan_hdel(coal, items):
     return _plan_elem_update(coal, items, b"hdel", S.ENC_DICT, False)
+
+
+def _plan_tensor_common(coal, items, key, cfg, meta, payload, cnt):
+    """Shared tail of the tensor planners (callers hold the validated
+    meta): the payload-size check is the last demote gate; everything
+    after mutates (tick + buffer)."""
+    if len(payload) != meta.nbytes:
+        return None  # per-command path raises the exact op error
+    uuid = coal.tick()
+    coal.add(b"tset", (key, uuid, cfg, cnt, payload),
+             [items[1], Bulk(cfg), Int(cnt), Bulk(payload)])
+    return OK
+
+
+@serve_plan("tensor.set")
+def _plan_tensor_set(coal, items):
+    # op twin: tensor_set_command — config parse/validation and the
+    # payload-size check all demote (the per-command path raises the
+    # exact error); a run-created key's config lands in the run overlay
+    # (coal.tns) so later SET/MERGE in the same run validate against it
+    from ..crdt import tensor as T
+    n = len(items)
+    if n < 6 or n > 7:
+        return None
+    try:
+        key = as_bytes(items[1])
+        strat_s = as_bytes(items[2]).decode("utf-8", "replace")
+        dtype_s = as_bytes(items[3]).decode("utf-8", "replace")
+        shape_s = as_bytes(items[4]).decode("utf-8", "replace")
+        payload = as_bytes(items[5])
+        cnt = as_int(items[6]) if n > 6 else 1
+    except CstError:
+        return None
+    if cnt < 1:
+        return None  # per-command path raises the exact count error
+    default_strat, max_elems = _tensor_knobs()
+    try:
+        # cap applied below, only when the key is genuinely NEW — the
+        # op twin exempts existing keys (config is creation-fixed)
+        meta = T.parse_meta(strat_s, dtype_s, shape_s,
+                            default_strat=default_strat,
+                            max_elems=1 << 62)
+    except T.TensorConfigError:
+        return None
+    cfg = T.pack_config(meta)
+    kid = coal.resolve_key(key, S.ENC_TENSOR)
+    if kid is coal.CONFLICT:
+        return None
+    if kid < 0 and key not in coal.tns and meta.elems > max_elems:
+        return None  # new key over the cap: exact op error per-command
+    if kid >= 0:
+        landed = coal.ks.tensor_meta_of(kid)
+        if landed is None or T.pack_config(landed) != cfg:
+            return None  # config mismatch: exact op error per-command
+    else:
+        prev = coal.tns.get(key)
+        if prev is not None and prev != cfg:
+            return None
+        if len(payload) != meta.nbytes:
+            return None  # demote BEFORE recording the run overlay
+        coal.tns[key] = cfg
+    return _plan_tensor_common(coal, items, key, cfg, meta, payload, cnt)
+
+
+@serve_plan("tensor.merge")
+def _plan_tensor_merge(coal, items):
+    # op twin: tensor_merge_command — the key must already exist as a
+    # tensor (landed, or created earlier in this run)
+    from ..crdt import tensor as T
+    n = len(items)
+    if n < 3 or n > 4:
+        return None
+    try:
+        key = as_bytes(items[1])
+        payload = as_bytes(items[2])
+        cnt = as_int(items[3]) if n > 3 else 1
+    except CstError:
+        return None
+    if cnt < 1:
+        return None  # per-command path raises the exact count error
+    kid = coal.resolve_key(key, S.ENC_TENSOR)
+    if kid is coal.CONFLICT:
+        return None
+    if kid >= 0:
+        meta = coal.ks.tensor_meta_of(kid)
+        if meta is None:
+            return None
+        cfg = T.pack_config(meta)
+    else:
+        cfg = coal.tns.get(key)
+        if cfg is None:
+            return None  # absent key: exact no-such-key error
+        meta = T.unpack_config(cfg)
+    return _plan_tensor_common(coal, items, key, cfg, meta, payload, cnt)
 
 
 @serve_plan("hset")

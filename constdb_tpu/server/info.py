@@ -143,6 +143,16 @@ def _section_stats(node, out):
         v = getattr(node.engine, gauge, None)
         if v is not None:
             out.append((gauge, v))
+    # tensor-register family (crdt/tensor.py): merge routing counts +
+    # device payload-pool residency; per-strategy merge wins and the
+    # host payload gauge live in the Keyspace section
+    for gauge in ("tns_dev_rows", "tns_host_rows"):
+        v = getattr(node.engine, gauge, None)
+        if v is not None:
+            out.append((gauge, v))
+    v = getattr(node.engine, "_tns_bytes", None)
+    if v is not None:
+        out.append(("tns_pool_bytes", v))
     out.append(("engine", node.engine.name))
     degraded = getattr(node.engine, "degraded", None)
     if degraded:
@@ -211,15 +221,20 @@ def _section_keyspace(node, out):
     n = ks.keys.n
     out.append(("keys", n))
     if n:
-        counts = np.bincount(ks.keys.enc[:n].astype(np.int64), minlength=8)
+        counts = np.bincount(ks.keys.enc[:n].astype(np.int64), minlength=16)
         out.append(("counters", int(counts[S.ENC_COUNTER])))
         out.append(("registers", int(counts[S.ENC_BYTES])))
         out.append(("dicts", int(counts[S.ENC_DICT])))
         out.append(("sets", int(counts[S.ENC_SET])))
         out.append(("multivalues", int(counts[S.ENC_MV])))
         out.append(("lists", int(counts[S.ENC_LIST])))
+        out.append(("tensors", int(counts[S.ENC_TENSOR])))
     out.append(("counter_slots", ks.cnt.n))
     out.append(("element_rows", ks.el.n - ks.el_dead))
+    out.append(("tensor_slots", ks.tns.n))
+    out.append(("tensor_payload_bytes", ks.tns_bytes))
+    for name, cnt in sorted(ks.tns_merges_by_strat.items()):
+        out.append((f"tensor_merges_{name.replace('-', '_')}", cnt))
     out.append(("pending_tombstones", len(ks.garbage)))
 
 

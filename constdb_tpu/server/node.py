@@ -311,6 +311,21 @@ class Node:
             if stale is None or stale(families):
                 self.ensure_flushed()
 
+    def tensor_read(self, kid: int):
+        """One tensor key's strategy reduction, DEVICE-FIRST: a steady
+        resident engine reduces straight from its payload pools —
+        dirty payloads never round-trip through the host, which is the
+        tensor family's reason to exist (the TENSOR.GET path;
+        commands.execute narrows its flush for exactly this).  Other
+        engines flush the tensor plane narrowly and run the host
+        reference reduction."""
+        engine = self.engine
+        if getattr(engine, "steady", False) and \
+                getattr(engine, "resident", False):
+            return engine.tensor_read_many(self.ks, (kid,))[kid]
+        self.ensure_flushed_for(("tns",))
+        return self.ks.tensor_read(kid)
+
     def canonical(self) -> dict:
         self.ensure_flushed()
         return self.ks.canonical()

@@ -101,9 +101,9 @@ class ServeCoalescer:
     CONFLICT = CONFLICT
 
     __slots__ = ("node", "max_run", "nodeid", "ks", "regs", "cnts", "els",
-                 "_keys", "_pending_keys", "_buf", "_log", "_pending",
-                 "_planned", "_lat_pending", "_sample_every", "_now",
-                 "_cur_uuid")
+                 "tns", "_keys", "_pending_keys", "_buf", "_log",
+                 "_pending", "_planned", "_lat_pending", "_sample_every",
+                 "_now", "_cur_uuid")
 
     def __init__(self, node, max_run: int = 512,
                  sample_every: int | None = None,
@@ -122,6 +122,7 @@ class ServeCoalescer:
         self.regs: dict = {}    # key -> (rv_t, rv_node)
         self.cnts: dict = {}    # key -> [visible_sum, my_slot_total]
         self.els: dict = {}     # key -> {member -> visible?}
+        self.tns: dict = {}     # key -> packed cfg of run-created tensors
         # the pending run
         self._pending_keys: set = set()  # keys with un-landed rows
         self._buf: dict = {}    # rewrite name -> encoder recs
@@ -230,8 +231,10 @@ class ServeCoalescer:
         whose arguments do not parse are simply not seeded — their
         planner demotes them as usual."""
         node = self.node
-        if getattr(node.engine, "needs_flush", False):
-            node.ensure_flushed()
+        # narrow barrier: the probes below read the key/reg/cnt/el
+        # planes only — resident TENSOR payload pools stay put (their
+        # stamps are host-authoritative and nothing here reads payloads)
+        node.ensure_flushed_for(("env", "reg", "cnt", "el"))
         ks = self.ks
         reg_keys: list = []
         cnt_keys: list = []
@@ -340,6 +343,7 @@ class ServeCoalescer:
         self.regs.clear()
         self.cnts.clear()
         self.els.clear()
+        self.tns.clear()
         self.ks = self.node.ks
         self.nodeid = self.node.node_id
 
@@ -411,6 +415,7 @@ class ServeCoalescer:
             self.regs.pop(key, None)
             self.cnts.pop(key, None)
             self.els.pop(key, None)
+            self.tns.pop(key, None)
             return
         self._reset_caches()
 
@@ -430,8 +435,9 @@ class ServeCoalescer:
             kid, e = ent
             return kid if e == enc else CONFLICT
         node = self.node
-        if getattr(node.engine, "needs_flush", False):
-            node.ensure_flushed()
+        # narrow barrier (see _preprobe): key resolution reads the key
+        # table only — tensor payload pools stay resident
+        node.ensure_flushed_for(("env", "reg", "cnt", "el"))
         ks = self.ks
         kid = ks.lookup(key)
         if kid >= 0:

@@ -81,3 +81,16 @@ class Columns:
         allocator-true accounting for INFO (reference src/lib.rs:63-78
         exposes jemalloc's allocated gauge; this is the store-exact part)."""
         return sum(getattr(self, "_" + name).nbytes for name in self._spec)
+
+
+class TensorCols(Columns):
+    """Tensor contributor slots — the envelope half of the tensor plane
+    (crdt/tensor.py): one row per (key, writer node), holding the LWW
+    stamp (`uuid`), the avg-strategy contribution count (`cnt`), and the
+    writer node id.  Payload arrays live in the keyspace's row-aligned
+    `tns_payload` side list (and, under a resident engine, in the device
+    payload pools of engine/tpu.py)."""
+
+    def __init__(self) -> None:
+        super().__init__({"kid": np.int64, "node": np.int64,
+                          "uuid": np.int64, "cnt": np.int64}, cap=256)

@@ -64,6 +64,7 @@ _SEED_ENV = np.uint64(0x1B873593A5A5A5A5)
 _SEED_CNT = np.uint64(0x2545F4914F6CDD1D)
 _SEED_EL = np.uint64(0x632BE59BD9B4E019)
 _SEED_DEL = np.uint64(0x9E6C63D0876A9A47)
+_SEED_TNS = np.uint64(0x7FEB352D243F6A88)
 
 # the negotiated shard axis: the SAME crc32 partition
 # store/sharded_keyspace.py shards by, at its maximum width, so any
@@ -144,6 +145,26 @@ def _el_hashes(ks: KeySpace, kcrc: np.ndarray
                        add_t, ks.el.add_node[live], del_norm)
 
 
+def _tns_hashes(ks: KeySpace, kcrc: np.ndarray
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """(owning kid, hash) per tensor contributor slot holding a real
+    write.  Payload BYTES are deliberately absent, by the same argument
+    as register values (module docstring): a slot is an LWW register
+    whose (node, uuid) stamp identifies the winning write, and one
+    write has one payload — hashing the stamp is hashing the payload,
+    with zero O(payload) passes per exchange."""
+    from ..crdt.semantics import NEUTRAL_T
+    n = ks.tns.n
+    if not n:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=_U64)
+    live = np.nonzero(ks.tns.uuid[:n] != NEUTRAL_T)[0]
+    if not len(live):
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=_U64)
+    kid = ks.tns.kid[live]
+    return kid, _chain(_SEED_TNS, kcrc[kid], ks.tns.node[live],
+                       ks.tns.uuid[live], ks.tns.cnt[live])
+
+
 def _del_hashes(ks: KeySpace) -> tuple[np.ndarray, np.ndarray]:
     """(key crc, hash) per key-tombstone record, in dict order (aligned
     with `list(ks.key_deletes)`)."""
@@ -173,6 +194,10 @@ def state_digest_matrix(ks: KeySpace, fanout: int,
             kid, h = _el_hashes(ks, kcrc)
             if len(kid):
                 np.add.at(flat, kb[kid], h)
+        if ks.tns.n:
+            kid, h = _tns_hashes(ks, kcrc)
+            if len(kid):
+                np.add.at(flat, kb[kid], h)
     if ks.key_deletes:
         dcrc, h = _del_hashes(ks)
         np.add.at(flat, _buckets(dcrc, fanout, leaves), h)
@@ -196,6 +221,10 @@ def _key_accum(ks: KeySpace) -> np.ndarray:
             np.add.at(acc, kid, h)
         if ks.el.n:
             kid, h = _el_hashes(ks, kcrc)
+            if len(kid):
+                np.add.at(acc, kid, h)
+        if ks.tns.n:
+            kid, h = _tns_hashes(ks, kcrc)
             if len(kid):
                 np.add.at(acc, kid, h)
     return acc

@@ -40,15 +40,16 @@ from typing import Iterator, Optional
 import numpy as np
 
 from ..crdt import semantics as S
+from ..crdt import tensor as T
 from ..errors import InvalidType
 from ..utils.native_tables import I64Dict, StrTable
-from .columns import Columns
+from .columns import Columns, TensorCols
 
 _I64 = np.int64
 
 # the CRDT planes a resident merge engine mirrors — the ONE definition the
 # command table, the version setter, and the engine all derive from
-FAMILIES = ("env", "reg", "cnt", "el")
+FAMILIES = ("env", "reg", "cnt", "el", "tns")
 
 
 class _KeyCols(Columns):
@@ -148,6 +149,24 @@ class KeySpace:
         # unserialized, interleaved (cache, n) field writes could pair
         # a small-capacity array with a larger synced count
         self._crc_lock = threading.Lock()
+
+        # tensor plane (crdt/tensor.py): contributor slots — one row per
+        # (key, writer node) — with the LWW stamp/count columns in `tns`
+        # and the payload arrays row-aligned in `tns_payload`.  Config is
+        # creation-fixed per key (`tns_meta`); `tns_index` maps
+        # (kid << NODE_RANK_BITS) | rank -> row.  Rows are never
+        # compacted (slots persist across key tombstones — the envelope
+        # ct/dt rule decides visibility, add-wins like registers).
+        self.tns = TensorCols()
+        self.tns_payload: list[Optional[np.ndarray]] = []
+        self.tns_index = I64Dict(256)
+        self.tns_meta: dict[int, T.TensorMeta] = {}
+        self.tns_rows_by_kid: dict[int, list[int]] = {}
+        self._tns_synced = 0
+        # running payload-byte gauge (INFO: exact without an O(rows) walk)
+        self.tns_bytes = 0
+        # slot-merge WINS by strategy name (INFO: merges by strategy)
+        self.tns_merges_by_strat: dict[str, int] = {}
 
         # key-level tombstone record for snapshot DELETES + GC
         # (parity: reference db.rs `deletes` map)
@@ -753,6 +772,144 @@ class KeySpace:
         self.el_index.put(combo, row)
         return row
 
+    # -------------------------------------------------------------- tensors
+    # The two-layer tensor register (crdt/tensor.py): per-(key, node)
+    # contributor slots merge as LWW on uuid (the payload and count ride
+    # the winner — exactly the counter-slot rule with an object payload),
+    # and reads reduce the live contributor set with the key's registered
+    # strategy in canonical (node, uuid) order.  `tensor_merge_row` is
+    # the ONE per-row reference implementation: the op path, the CPU
+    # engine, and the host micro strategy all call it; the device micro
+    # path (engine/tpu.py) folds + scatters the very same decisions in
+    # batch and is differential-tested byte-identical.
+
+    def tensor_get_or_create(self, key: bytes, cfg: bytes,
+                             uuid: int) -> int:
+        """Existing tensor key (enc- and config-checked) or a fresh one
+        whose config is fixed from `cfg` (packed TensorMeta)."""
+        kid, _created = self.get_or_create(key, S.ENC_TENSOR, uuid)
+        meta = self.tns_meta.get(kid)
+        if meta is None:
+            self.tns_meta[kid] = T.unpack_config(cfg)
+        elif T.pack_config(meta) != bytes(cfg):
+            raise T.TensorConfigError(
+                "tensor config mismatch: shape/dtype/strategy are fixed "
+                "at key creation")
+        return kid
+
+    def tensor_meta_of(self, kid: int) -> Optional[T.TensorMeta]:
+        return self.tns_meta.get(kid)
+
+    def tensor_slot_row(self, kid: int, node: int) -> int:
+        """Existing or fresh (neutral) contributor slot row."""
+        combo = (kid << self.NODE_RANK_BITS) | self.rank_of(node)
+        row = self.tns_index.get(combo, -1)
+        if row < 0:
+            row = self.tns.append(kid=kid, node=node, uuid=self.NEUTRAL_T,
+                                  cnt=0)
+            self.tns_payload.append(None)
+            self.tns_index.put(combo, row)
+        return row
+
+    def tensor_assign_payload(self, row: int, arr: np.ndarray) -> None:
+        """Replace a slot's payload array, keeping the byte gauge exact
+        (the device flush path writes downloaded rows through here)."""
+        old = self.tns_payload[row]
+        if old is not None:
+            self.tns_bytes -= old.nbytes
+        self.tns_payload[row] = arr
+        self.tns_bytes += arr.nbytes
+
+    def tensor_slot_set(self, kid: int, node: int, uuid: int, cnt: int,
+                        payload: np.ndarray) -> bool:
+        """LWW-assign one contributor slot (op path == merge path; the
+        strict > keeps equal-uuid re-delivery idempotent — one node's
+        uuids are unique per write, so an equal stamp IS the same
+        write).  `payload` must already be the meta-normalized array."""
+        row = self.tensor_slot_row(kid, node)
+        if uuid <= int(self.tns.uuid[row]):
+            return False
+        self.tns.uuid[row] = uuid
+        self.tns.cnt[row] = cnt
+        self.tensor_assign_payload(row, payload)
+        return True
+
+    def tensor_count_merge(self, meta: T.TensorMeta, n: int = 1) -> None:
+        """Bump the per-strategy merge gauge (INFO).  Counted once per
+        VALIDATED delivered contribution — not per LWW win — so the
+        gauge reads the same whichever engine or routing processed the
+        rows (the device path folds intra-batch duplicates before its
+        win test, a per-win count would depend on routing)."""
+        name = meta.strat_name
+        self.tns_merges_by_strat[name] = \
+            self.tns_merges_by_strat.get(name, 0) + n
+
+    def tensor_merge_row(self, kid: int, node: int, uuid: int, cnt: int,
+                         cfg: bytes, payload) -> bool:
+        """State-merge one foreign contributor row (the per-row
+        reference both engines' batch paths must match).  Config
+        mismatches and malformed payloads are skipped with a log —
+        snapshot-merge semantics, like type conflicts."""
+        meta = self.tns_meta.get(kid)
+        try:
+            T.check_count(cnt)
+            if meta is None:
+                meta = T.unpack_config(cfg)
+                self.tns_meta[kid] = meta
+            elif T.pack_config(meta) != bytes(cfg):
+                raise T.TensorConfigError("tensor config mismatch")
+            arr = T.payload_array(meta, payload)
+        except T.TensorConfigError as e:
+            import logging
+            logging.getLogger(__name__).error(
+                "skipping tensor row for kid %d: %s", kid, e)
+            return False
+        self.tensor_count_merge(meta)
+        return self.tensor_slot_set(kid, node, uuid, cnt, arr)
+
+    def _sync_tns_lists(self) -> None:
+        n = self.tns.n
+        if self._tns_synced < n:
+            by_kid = self.tns_rows_by_kid
+            for off, kid in enumerate(
+                    self.tns.kid[self._tns_synced:n].tolist()):
+                by_kid.setdefault(kid, []).append(self._tns_synced + off)
+            self._tns_synced = n
+
+    def tensor_contrib_rows(self, kid: int) -> list[int]:
+        """Slot rows of one key holding a real write, in canonical
+        (node, uuid) ascending order — THE reduction order every
+        strategy uses (crdt/tensor.py canonical_order)."""
+        self._sync_tns_lists()
+        # membership comes from the STAMP column alone (host-
+        # authoritative): under a resident engine a merged slot's host
+        # payload stays stale until flush, but the slot is already a
+        # contributor — the device read serves its payload from the pool
+        rows = [r for r in self.tns_rows_by_kid.get(kid, ())
+                if int(self.tns.uuid[r]) != self.NEUTRAL_T]
+        rows.sort(key=lambda r: (int(self.tns.node[r]),
+                                 int(self.tns.uuid[r])))
+        return rows
+
+    def tensor_contribs(self, kid: int) -> list[tuple]:
+        """[(node, uuid, cnt, payload)] in canonical order (STAT /
+        snapshot / canonical)."""
+        return [(int(self.tns.node[r]), int(self.tns.uuid[r]),
+                 int(self.tns.cnt[r]), self.tns_payload[r])
+                for r in self.tensor_contrib_rows(kid)]
+
+    def tensor_read(self, kid: int) -> Optional[np.ndarray]:
+        """Host reference read: the key's strategy reduced over the
+        contributor set in canonical order (flat [elems] array; callers
+        reshape via the meta).  None when no contribution landed yet."""
+        meta = self.tns_meta.get(kid)
+        rows = self.tensor_contrib_rows(kid)
+        if meta is None or not rows:
+            return None
+        mat = np.stack([self.tns_payload[r] for r in rows])
+        return T.reduce_rows(meta.strat, mat, self.tns.cnt[rows],
+                             self.tns.uuid[rows], self.tns.node[rows])
+
     # ------------------------------------------------------------------- GC
 
     def gc(self, horizon: int) -> int:
@@ -870,6 +1027,12 @@ class KeySpace:
                 content = frozenset(self.counter_slots(kid))
             elif enc == S.ENC_BYTES:
                 content = self.register_state(kid)
+            elif enc == S.ENC_TENSOR:
+                meta = self.tns_meta.get(kid)
+                cfg = T.pack_config(meta) if meta is not None else b""
+                content = (cfg, frozenset(
+                    (node, uuid, cnt, p.tobytes())
+                    for node, uuid, cnt, p in self.tensor_contribs(kid)))
             else:
                 # a del_t at or below add_t is semantically inert (visibility
                 # and every future max-merge are unchanged by zeroing it), and
@@ -890,6 +1053,15 @@ class KeySpace:
         if enc == S.ENC_COUNTER:
             d["slots"] = sorted(self.counter_slots(kid))
             d["sum"] = self.counter_sum(kid)
+        elif enc == S.ENC_TENSOR:
+            meta = self.tns_meta.get(kid)
+            if meta is not None:
+                d["strategy"] = meta.strat_name
+                d["dtype"] = T.DTYPE_NAMES[meta.dtype_code]
+                d["shape"] = meta.shape
+            d["contributors"] = [(n_, u, c)
+                                 for n_, u, c, _p in
+                                 self.tensor_contribs(kid)]
         elif enc == S.ENC_BYTES:
             val, t, node = self.register_state(kid)
             d["value"], d["vtime"], d["vnode"] = val, t, node
@@ -905,7 +1077,7 @@ class KeySpace:
         reference src/lib.rs:63-78 leans on jemalloc the same way)."""
         return {
             "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
-                              + self.el.nbytes()
+                              + self.el.nbytes() + self.tns.nbytes()
                               + sum(a.nbytes for _, a
                                     in self.cnt_rank_rows.values())
                               # hash-mode ranks: ~16B/entry estimate
@@ -915,6 +1087,8 @@ class KeySpace:
             "counter_slots": self.cnt.n,
             "element_rows": self.el.n,
             "element_rows_dead": self.el_dead,
+            "tensor_slots": self.tns.n,
+            "tensor_payload_bytes": self.tns_bytes,
             "interned_members": len(self.member_index),
             "key_tombstones": len(self.key_deletes),
             "garbage_queue": len(self.garbage),

@@ -160,6 +160,18 @@ def extract_shard(batch: ColumnarBatch, sids: np.ndarray,
             setattr(sub, col,
                     np.ascontiguousarray(np.asarray(getattr(batch, col))[em]))
 
+    if len(batch.tns_ki):
+        tki = np.asarray(batch.tns_ki)
+        tm = np.nonzero(sids[tki] == shard)[0]
+        if len(tm):
+            sub.tns_ki = posmap[tki[tm]]
+            for col in ("tns_node", "tns_uuid", "tns_cnt"):
+                setattr(sub, col, np.ascontiguousarray(
+                    np.asarray(getattr(batch, col))[tm]))
+            idx = tm.tolist()
+            sub.tns_cfg = [batch.tns_cfg[i] for i in idx]
+            sub.tns_payload = [batch.tns_payload[i] for i in idx]
+
     if batch.del_keys:
         if del_sids is None:
             raise ValueError(
@@ -179,7 +191,7 @@ def keyspace_state_bytes(ks: KeySpace):
     planes.  Stricter than canonical(): the differential tests pin the
     sharded paths BYTE-identical to the single-keyspace path, not merely
     semantically equal."""
-    n, c, e = ks.keys.n, ks.cnt.n, ks.el.n
+    n, c, e, t = ks.keys.n, ks.cnt.n, ks.el.n, ks.tns.n
     return (
         {name: ks.keys.col(name)[:n].tobytes()
          for name in ("enc", "ct", "mt", "dt", "expire", "rv_t", "rv_node",
@@ -188,8 +200,12 @@ def keyspace_state_bytes(ks: KeySpace):
          for name in ("kid", "node", "val", "uuid", "base", "base_t")},
         {name: ks.el.col(name)[:e].tobytes()
          for name in ("kid", "add_t", "add_node", "del_t")},
+        {name: ks.tns.col(name)[:t].tobytes()
+         for name in ("kid", "node", "uuid", "cnt")},
         list(ks.key_bytes), list(ks.reg_val), list(ks.el_member),
-        list(ks.el_val), dict(ks.key_deletes), sorted(ks.garbage),
+        list(ks.el_val),
+        [None if p is None else p.tobytes() for p in ks.tns_payload],
+        dict(ks.key_deletes), sorted(ks.garbage),
     )
 
 

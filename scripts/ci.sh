@@ -103,6 +103,34 @@ print("resident smoke verified: snapshot",
 EOF
 
 echo
+echo "== tensor smoke (bench --mode tensor, pallas-interpret) =="
+# tiny oracle-verified run of the tensor-register family with the
+# reduce kernels forced through the Pallas interpreter: device-resident
+# merges + reads must stay BIT-identical to the host reference (the
+# canonical-order law) and the steady path must actually engage
+# (dev_rounds_resident / tns_dev_rows) — the differential suite proper
+# runs inside tier-1 (tests/test_tensor_family.py).
+JAX_PLATFORMS=cpu CONSTDB_BENCH_TNS_KEYS=8 CONSTDB_BENCH_TNS_ELEMS=4096 \
+CONSTDB_BENCH_TNS_ROUNDS=6 CONSTDB_BENCH_TNS_BATCH=32 \
+CONSTDB_BENCH_TNS_REPS=1 CONSTDB_BENCH_TNS_STRATS=avg,trimmed-mean \
+CONSTDB_BENCH_FOLD=pallas-interpret \
+    timeout -k 10 300 python bench.py --mode tensor \
+    > /tmp/_ci_tensor.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_tensor.json"))
+assert out["verified"], "tensor smoke failed oracle verification"
+for leg in out["curve"]:
+    assert leg["dev_rounds_resident"] > 0, \
+        f"tensor steady path never engaged ({leg['strategy']})"
+    assert leg["tns_dev_rows"] > 0 and leg["tns_host_rows"] == 0, \
+        f"tensor rows did not ride the device path ({leg['strategy']})"
+    assert not leg["pallas_broken"], "pallas tensor kernels fell back"
+print("tensor smoke verified:",
+      [(leg["strategy"], leg["speedup"]) for leg in out["curve"]])
+EOF
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

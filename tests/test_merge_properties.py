@@ -9,18 +9,27 @@ reference defects") are exactly what these properties catch.
 import os
 import random
 
+import numpy as np
 import pytest
 
 from constdb_tpu.crdt import (ENC_BYTES, ENC_COUNTER, ENC_DICT, ENC_LIST,
-                              ENC_MV, ENC_SET)
+                              ENC_MV, ENC_SET, ENC_TENSOR)
+from constdb_tpu.crdt import tensor as TS
 from constdb_tpu.engine import CpuMergeEngine, batch_from_keyspace
 from constdb_tpu.engine.tpu import TpuMergeEngine
 from constdb_tpu.store import KeySpace
 
 KEYS = [b"cnt:%d" % i for i in range(4)] + [b"reg:%d" % i for i in range(4)] + \
        [b"set:%d" % i for i in range(3)] + [b"dic:%d" % i for i in range(3)] + \
-       [b"mvr:%d" % i for i in range(2)] + [b"lst:%d" % i for i in range(2)]
+       [b"mvr:%d" % i for i in range(2)] + [b"lst:%d" % i for i in range(2)] + \
+       [b"tns:%d" % i for i in range(len(TS.STRATEGY_IDS))]
 MEMBERS = [b"m%d" % i for i in range(6)]
+# one tensor key per registered strategy, so EVERY strategy's
+# delivered-set semantics replay through the property suite below
+TNS_CFGS = {
+    b"tns:%d" % i: TS.pack_config(TS.TensorMeta(sid, 0, (6,)))
+    for i, sid in enumerate(sorted(TS.STRATEGY_IDS.values()))
+}
 # MV siblings / list entries are element rows keyed by opaque bytes (clock
 # serializations / LSEQ positions); merge-wise any byte-string member works
 MV_CLOCKS = [b"1:%d" % i for i in range(1, 4)] + [b"2:%d" % i for i in range(1, 4)]
@@ -30,7 +39,7 @@ LIST_POS = [bytes([0, s, 0, 0, 0, 0, 0, 0, 0, n]) for s in (10, 20, 30)
 
 def enc_for(key: bytes) -> int:
     return {b"c": ENC_COUNTER, b"r": ENC_BYTES, b"s": ENC_SET, b"d": ENC_DICT,
-            b"m": ENC_MV, b"l": ENC_LIST}[key[:1]]
+            b"m": ENC_MV, b"l": ENC_LIST, b"t": ENC_TENSOR}[key[:1]]
 
 
 def gen_store(seed: int, node: int, n_ops: int = 120) -> KeySpace:
@@ -42,6 +51,17 @@ def gen_store(seed: int, node: int, n_ops: int = 120) -> KeySpace:
         key = rng.choice(KEYS)
         enc = enc_for(key)
         uuid = (rng.randrange(1, 40) << 22) | rng.randrange(0, 3)
+        if enc == ENC_TENSOR:
+            # contributor-slot write (op rule: LWW per (key, node)); the
+            # payload derives from (node, uuid) so any two replicas that
+            # deliver the same write hold the same bytes
+            kid = ks.tensor_get_or_create(key, TNS_CFGS[key], uuid)
+            pay = np.arange(6, dtype=np.float32) * node + np.float32(
+                uuid % 97)
+            ks.tensor_slot_set(kid, node, uuid,
+                               1 + uuid % 5, pay)
+            ks.updated_at(kid, uuid)
+            continue
         kid, _created = ks.get_or_create(key, enc, uuid)
         op = rng.random()
         if enc == ENC_COUNTER:
@@ -135,6 +155,52 @@ def test_convergence_all_orders(engine, seed):
     results = {tuple(sorted(merged(engine, *perm).items()))
                for perm in itertools.permutations(stores)}
     assert len(results) == 1
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tensor_reads_deterministic_across_orders_and_engines(engine, seed):
+    """Canonical-order determinism pin: the visible tensor VALUE (the
+    strategy reduction — float math included) is a pure function of the
+    delivered contribution set.  Any delivery order, any engine (CPU
+    reference, resident XLA, resident Pallas-interpret, device reads)
+    produces bit-identical reads for every registered strategy."""
+    import itertools
+
+    stores = [gen_store(seed + i * 50, node=i + 1, n_ops=60)
+              for i in range(3)]
+    reads = set()
+    for perm in itertools.permutations(stores):
+        acc = KeySpace()
+        for s in perm:
+            engine.merge(acc, batch_from_keyspace(s))
+        got = tuple(
+            (key, None if (r := acc.tensor_read(acc.lookup(key))) is None
+             else r.tobytes())
+            for key in sorted(TNS_CFGS))
+        reads.add(got)
+    assert len(reads) == 1
+    want = reads.pop()
+    for backend in ("xla", "pallas-interpret"):
+        eng = TpuMergeEngine(resident=True, steady=True, warmup=0,
+                             dense_fold=backend)
+        acc = KeySpace()
+        for s in stores:
+            eng.merge_many(acc, [batch_from_keyspace(s)])
+        kids = {key: acc.lookup(key) for key in sorted(TNS_CFGS)}
+        dev = eng.tensor_read_many(acc, [k for k in kids.values()
+                                         if k >= 0])
+        got = tuple(
+            (key, None if kids[key] < 0 or dev[kids[key]] is None
+             else dev[kids[key]].tobytes())
+            for key in sorted(TNS_CFGS))
+        assert got == want, backend
+        eng.flush(acc)
+        host = tuple(
+            (key, None if kids[key] < 0 or
+             (r := acc.tensor_read(kids[key])) is None else r.tobytes())
+            for key in sorted(TNS_CFGS))
+        assert host == want, backend
+        eng.close()
 
 
 def test_type_conflict_skipped(engine):

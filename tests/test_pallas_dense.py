@@ -252,3 +252,157 @@ def test_segment_sum_scratch_cap():
     with pytest.raises(ValueError):
         PD.segment_sum(jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int64),
                        n_seg=PD.SEGMENT_SUM_MAX_SEG + 1, interpret=INTERPRET)
+
+# ---------------------------------------------------- pre-split planes
+# The retired PR 8 follow-up: LWW pair planes live PRE-SPLIT as hi/lo
+# 32-bit pairs between micro rounds (scatter_pair_src_split), so the
+# steady path pays no O(plane) int64<->hi/lo pass per call.  The int64
+# wrapper (scatter_pair_src) — which every test above still drives —
+# splits/joins around the SAME kernel, so the pad-collision and
+# randomized differentials pin the split kernel too; the cases below
+# additionally pin the CHAINED form (state stays split across rounds)
+# and the engine's split-cache lifecycle.
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_scatter_split_chained_rounds(seed):
+    """Several rounds over the SAME planes with the state kept in split
+    form throughout (joined only at the end) — bit-identical to the
+    per-round host reference and to the int64 XLA twin chain."""
+    rng = np.random.default_rng(seed)
+    sp = 32
+    p = rng.integers(-(1 << 60), 1 << 60, sp).astype(np.int64)
+    s = rng.integers(-(1 << 40), 1 << 40, sp).astype(np.int64)
+    src = np.full(sp, -1, np.int32)
+    p_hi, p_lo = PD.split_plane(jnp.array(p))
+    s_hi, s_lo = PD.split_plane(jnp.array(s))
+    src_d = jnp.array(src)
+    want_p, want_s, want_src = p.copy(), s.copy(), src.copy()
+    base = 0
+    for _ in range(5):
+        n = int(rng.integers(1, sp))
+        idx = np.sort(rng.choice(sp, n, replace=False)).astype(np.int32)
+        bp = rng.integers(-(1 << 60), 1 << 60, n).astype(np.int64)
+        bs = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+        np2 = PD._pow2(n)
+        pad = TpuMergeEngine._scatter_pad_row(idx.astype(np.int64), n, sp) \
+            if np2 > n else 0
+        p_hi, p_lo, s_hi, s_lo, src_d = PD.scatter_pair_src_split(
+            p_hi, p_lo, s_hi, s_lo, src_d,
+            jnp.array(_pad1(idx, np2, pad)),
+            jnp.array(_pad1(bp, np2, NEUTRAL_T)),
+            jnp.array(_pad1(bs, np2, NEUTRAL_T)),
+            np.int32(base), interpret=True)
+        want_p, want_s, want_src = _host_scatter_ref(
+            want_p, want_s, want_src, idx, bp, bs, base)
+        base += np2
+    np.testing.assert_array_equal(
+        np.asarray(PD.join_plane(p_hi, p_lo)), want_p)
+    np.testing.assert_array_equal(
+        np.asarray(PD.join_plane(s_hi, s_lo)), want_s)
+    np.testing.assert_array_equal(np.asarray(src_d), want_src)
+
+
+def test_engine_split_cache_steady_state():
+    """The engine keeps pair planes split BETWEEN micro rounds under a
+    Pallas backend (res['split'] populated, int64 cols stale-by-design)
+    and still flushes/reads exactly the host-engine results."""
+    from constdb_tpu.engine.base import ColumnarBatch
+    from constdb_tpu.engine.cpu import CpuMergeEngine
+    from constdb_tpu.store import KeySpace
+
+    rng = np.random.default_rng(5)
+
+    def batch(u0):
+        b = ColumnarBatch()
+        n = 12
+        b.keys = [b"r%02d" % rng.integers(6) for _ in range(n)]
+        uu = (np.arange(n, dtype=np.int64) + u0) << 22
+        b.key_enc = np.full(n, 3, np.int8)  # ENC_BYTES
+        b.key_ct = uu.copy()
+        b.key_mt = uu.copy()
+        b.key_dt = np.zeros(n, np.int64)
+        b.key_expire = np.zeros(n, np.int64)
+        b.reg_val = [b"v%d" % (u0 + i) for i in range(n)]
+        b.reg_t = uu
+        b.reg_node = np.full(n, 1, np.int64)
+        b.rows_unique_per_slot = False
+        return b
+
+    ref = KeySpace()
+    cpu = CpuMergeEngine()
+    dev = KeySpace()
+    eng = TpuMergeEngine(resident=True, steady=True, warmup=0,
+                         dense_fold="pallas-interpret")
+    for r in range(4):
+        b1, b2 = batch(100 + 20 * r), batch(100 + 20 * r)
+        b2.keys = list(b1.keys)
+        b2.reg_val = list(b1.reg_val)
+        cpu.merge_many(ref, [b1])
+        eng.merge_many(dev, [b2])
+        if r:
+            res = eng._res.get("reg")
+            assert res is not None and res.get("split"), \
+                "pair planes not kept split between micro rounds"
+    eng.flush(dev)
+    assert dev.canonical() == ref.canonical()
+    eng.close()
+
+
+def test_recompute_sums_joins_split_cache():
+    """A bulk counter catch-up (whole-plane cnt mirror, dirty=None)
+    followed by steady micro rounds leaves the val/uuid truth in the
+    split cache; the flush-time device segment-sum must JOIN it before
+    re-deriving cnt_sum, or counters serve pre-merge totals (found by
+    review: canonical() matched while cnt_sum was stale)."""
+    from constdb_tpu.engine.base import ColumnarBatch
+    from constdb_tpu.engine.cpu import CpuMergeEngine
+    from constdb_tpu.store import KeySpace
+
+    def cnt_batch(totals, u0, unique):
+        b = ColumnarBatch()
+        n = len(totals)
+        b.keys = [b"c%02d" % i for i in range(n)]
+        uu = (np.arange(n, dtype=np.int64) + u0) << 22
+        b.key_enc = np.zeros(n, np.int8)  # ENC_COUNTER
+        b.key_ct = uu.copy()
+        b.key_mt = uu.copy()
+        b.key_dt = np.zeros(n, np.int64)
+        b.key_expire = np.zeros(n, np.int64)
+        b.reg_val = [None] * n
+        b.reg_t = np.zeros(n, np.int64)
+        b.reg_node = np.zeros(n, np.int64)
+        b.cnt_ki = np.arange(n, dtype=np.int64)
+        b.cnt_node = np.full(n, 7, np.int64)
+        b.cnt_val = np.asarray(totals, dtype=np.int64)
+        b.cnt_uuid = uu
+        b.cnt_base = np.zeros(n, np.int64)
+        b.cnt_base_t = np.full(n, NEUTRAL_T, np.int64)
+        b.rows_unique_per_slot = unique
+        return b
+
+    ref = KeySpace()
+    cpu = CpuMergeEngine()
+    dev = KeySpace()
+    # the production shape is dense_fold="auto" RESOLVING to pallas (a
+    # real TPU backend): host-combine staging stays on (env rides host
+    # mode — no env mirror, so nothing flushes between the bulk round
+    # and the micro rounds) while the scatter kernels run Pallas.  On
+    # this CPU box auto resolves to xla, so pin the resolution.
+    eng = TpuMergeEngine(resident=True, steady=True, warmup=0,
+                         dense_fold="auto")
+    eng._fold_backend = lambda: "pallas-interpret"
+    # bulk catch-up: whole-plane cnt mirror (dirty=None)
+    b1, b2 = (cnt_batch([100, 101, 102, 103], 10, True) for _ in range(2))
+    cpu.merge_many(ref, [b1])
+    eng.merge_many(dev, [b2])
+    # steady micro rounds: winners land in the split pair cache
+    for r in range(3):
+        t = [200 + 10 * r + i for i in range(4)]
+        m1, m2 = (cnt_batch(t, 50 + 10 * r, False) for _ in range(2))
+        cpu.merge_many(ref, [m1])
+        eng.merge_many(dev, [m2])
+    eng.flush(dev)
+    np.testing.assert_array_equal(dev.keys.cnt_sum[:4], ref.keys.cnt_sum[:4])
+    assert dev.canonical() == ref.canonical()
+    eng.close()

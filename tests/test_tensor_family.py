@@ -1,0 +1,691 @@
+"""Tensor-valued registers (crdt/tensor.py): strategy reductions,
+commands, coalescers, resident device pools, snapshot + digest coverage.
+
+The load-bearing pin is the canonical-order law: every strategy reduces
+contributors in ascending (node, uuid) order with a FIXED sequential
+operation chain, so host (numpy), XLA, and Pallas-interpret reads are
+bit-identical — float non-associativity cannot diverge replicas or
+engines.  Every differential below compares with array_equal /
+canonical equality, never approx.
+"""
+
+import numpy as np
+import pytest
+
+from constdb_tpu.crdt import semantics as S
+from constdb_tpu.crdt import tensor as T
+from constdb_tpu.engine.base import ColumnarBatch, batch_from_keyspace
+from constdb_tpu.engine.cpu import CpuMergeEngine
+from constdb_tpu.engine.tpu import TpuMergeEngine
+from constdb_tpu.replica.coalesce import CoalescingApplier
+from constdb_tpu.replica.manager import ReplicaMeta
+from constdb_tpu.resp.message import Arr, Bulk, Int, NoReply
+from constdb_tpu.server.node import Node
+from constdb_tpu.store.keyspace import KeySpace
+
+STRATS = sorted(T.STRATEGY_IDS)
+
+
+def cmd(*parts) -> Arr:
+    return Arr([p if isinstance(p, (Bulk, Int))
+                else Bulk(p if isinstance(p, bytes)
+                          else str(p).encode()) for p in parts])
+
+
+def payload(rng, elems, dtype=np.float32):
+    return (rng.standard_normal(elems) * 5).astype(dtype)
+
+
+def make_batch(rows, cfg, elems):
+    """One op-stream micro-batch of tensor rows:
+    rows = [(key_i, node, uuid, cnt, payload bytes)]."""
+    b = ColumnarBatch()
+    n = len(rows)
+    b.keys = [b"t%04d" % r[0] for r in rows]
+    b.key_enc = np.full(n, S.ENC_TENSOR, np.int8)
+    uu = np.fromiter((r[2] for r in rows), dtype=np.int64, count=n)
+    b.key_ct = uu.copy()
+    b.key_mt = uu.copy()
+    b.key_dt = np.zeros(n, np.int64)
+    b.key_expire = np.zeros(n, np.int64)
+    b.reg_val = [None] * n
+    b.reg_t = np.zeros(n, np.int64)
+    b.reg_node = np.zeros(n, np.int64)
+    b.tns_ki = np.arange(n, dtype=np.int64)
+    b.tns_node = np.fromiter((r[1] for r in rows), dtype=np.int64, count=n)
+    b.tns_uuid = uu
+    b.tns_cnt = np.fromiter((r[3] for r in rows), dtype=np.int64, count=n)
+    b.tns_cfg = [cfg] * n
+    b.tns_payload = [r[4] for r in rows]
+    b.rows_unique_per_slot = False
+    return b
+
+
+def gen_rows(rng, n_rows, n_keys, n_nodes, elems, u0=1):
+    rows = []
+    u = u0
+    for _ in range(n_rows):
+        u += int(rng.integers(1, 4))
+        rows.append((int(rng.integers(n_keys)),
+                     int(rng.integers(1, n_nodes + 1)), u << 22,
+                     int(rng.integers(1, 6)),
+                     payload(rng, elems).tobytes()))
+    return rows, u
+
+
+# ------------------------------------------------------------- reductions
+
+
+@pytest.mark.parametrize("strat", STRATS)
+@pytest.mark.parametrize("n", [1, 2, 3, 8])
+def test_reduce_twins_bit_identical(strat, n):
+    """Host (numpy) vs XLA vs Pallas-interpret reductions: identical
+    bits for every strategy and contributor count — incl. n=8 whose
+    trimmed divisor (6) is the first non-pow2 (the constant-divisor
+    reciprocal rewrite this pins)."""
+    import jax.numpy as jnp
+
+    from constdb_tpu.ops import dense as D
+    from constdb_tpu.ops import pallas_dense as PD
+
+    rng = np.random.default_rng(T.STRATEGY_IDS[strat] * 10 + n)
+    G, K, Kp = 4, 100, 512
+    sid = T.STRATEGY_IDS[strat]
+    mat = (rng.standard_normal((G, n, K)) * 9).astype(np.float32)
+    cnts = rng.integers(1, 9, size=(G, n)).astype(np.int64)
+    uuids = rng.integers(1, 1000, size=(G, n))
+    nodes = np.tile(np.arange(n), (G, 1)) + 1
+    host = np.stack([T.reduce_rows(sid, mat[g], cnts[g], uuids[g],
+                                   nodes[g]) for g in range(G)])
+    if sid == T.STRAT_LWW:
+        return  # lww picks a row — no float chain to twin
+    matp = np.zeros((G, n, Kp), np.float32)
+    matp[:, :, :K] = mat
+    cf = cnts.astype(np.float32)
+    div = np.float32(n if n <= 2 else n - 2)
+    md, cd = jnp.asarray(matp), jnp.asarray(cf)
+    if sid == T.STRAT_AVG:
+        tots = np.empty((G, 1), np.float32)
+        for g in range(G):
+            t = np.float32(cf[g, 0])
+            for i in range(1, n):
+                t = t + np.float32(cf[g, i])
+            tots[g, 0] = t
+        wm = D.tensor_scale(md, cd)
+        xla = np.asarray(D.tensor_div(
+            D.tensor_reduce(wm, cd, div, strat=T.STRAT_SUM, n=n),
+            jnp.asarray(tots)))[:, :K]
+        pal = np.asarray(D.tensor_div(
+            PD.tensor_reduce(wm, cd, div, strat=T.STRAT_SUM, n=n,
+                             interpret=True), jnp.asarray(tots)))[:, :K]
+    else:
+        xla = np.asarray(D.tensor_reduce(md, cd, div, strat=sid,
+                                         n=n))[:, :K]
+        pal = np.asarray(PD.tensor_reduce(md, cd, div, strat=sid, n=n,
+                                          interpret=True))[:, :K]
+    assert np.array_equal(host, xla)
+    assert np.array_equal(host, pal)
+
+
+def test_take_reduce_fused_matches_two_step():
+    """The fused pool-gather reductions (tensor_take_reduce /
+    tensor_take_scale+tensor_sum_div) equal the two-step twins and the
+    host chain bit for bit."""
+    import jax.numpy as jnp
+
+    from constdb_tpu.ops import dense as D
+
+    rng = np.random.default_rng(3)
+    g, n, Kp = 5, 8, 512
+    buf = (rng.standard_normal((64, Kp)) * 7).astype(np.float32)
+    idx = rng.choice(64, g * n, replace=False).astype(np.int32)
+    cnts = rng.integers(1, 9, size=(g, n)).astype(np.float32)
+    mat = buf[idx].reshape(g, n, Kp)
+    bufd, idxd, cd = jnp.asarray(buf), jnp.asarray(idx), jnp.asarray(cnts)
+    for strat in (T.STRAT_SUM, T.STRAT_MAXMAG, T.STRAT_TRIMMED):
+        div = np.float32(n - 2)
+        host = np.stack([T.reduce_rows(strat, mat[j], cnts[j],
+                                       np.arange(n), np.arange(n))
+                         for j in range(g)])
+        fused = np.asarray(D.tensor_take_reduce(bufd, idxd, div,
+                                                strat=strat, n=n, g=g))
+        assert np.array_equal(host, fused), strat
+    # avg: fused gather+scale then fused sum+div
+    tots = np.empty((g, 1), np.float32)
+    for j in range(g):
+        t = np.float32(cnts[j, 0])
+        for i in range(1, n):
+            t = t + np.float32(cnts[j, i])
+        tots[j, 0] = t
+    host = np.stack([T.reduce_rows(T.STRAT_AVG, mat[j], cnts[j],
+                                   np.arange(n), np.arange(n))
+                     for j in range(g)])
+    wm = D.tensor_take_scale(bufd, idxd, cd, n=n, g=g)
+    fused = np.asarray(D.tensor_sum_div(wm, jnp.asarray(tots), n=n))
+    assert np.array_equal(host, fused)
+
+
+def test_config_pack_roundtrip_and_errors():
+    meta = T.TensorMeta(T.STRAT_AVG, 1, (3, 5))
+    assert T.unpack_config(T.pack_config(meta)) == meta
+    with pytest.raises(T.TensorConfigError):
+        T.unpack_config(b"\xff\x00\x01" + b"\x04\x00\x00\x00")
+    with pytest.raises(T.TensorConfigError):
+        T.parse_meta("nope", "f32", "8")
+    with pytest.raises(T.TensorConfigError):
+        T.parse_meta("sum", "f32", "1024", max_elems=512)
+    m = T.parse_meta("-", "f64", "4x4", default_strat="maxmag")
+    assert m.strat == T.STRAT_MAXMAG and m.elems == 16
+    # dims must fit the wire config's u32 fields — an unbounded dim
+    # would escape as OverflowError past the command error boundary
+    with pytest.raises(T.TensorConfigError):
+        T.parse_meta("sum", "f32", str(1 << 32), max_elems=1 << 62)
+    with pytest.raises(T.TensorConfigError):  # rank > pack_config's byte
+        T.parse_meta("sum", "f32", "x".join(["1"] * 300))
+    with pytest.raises(T.TensorConfigError):
+        T.check_count(0)
+
+
+# ------------------------------------------- engine differential (micro)
+
+
+@pytest.mark.parametrize("strat", STRATS)
+@pytest.mark.parametrize("backend", ["xla", "pallas-interpret"])
+def test_resident_micro_differential(strat, backend):
+    """Resident device micro merges + device reads vs the CPU reference:
+    canonical state AND per-round reads bit-identical, with the steady
+    path actually engaged (the routing gauge the ci smoke also reads)."""
+    rng = np.random.default_rng(11)
+    elems = 96
+    cfg = T.pack_config(T.TensorMeta(T.STRATEGY_IDS[strat], 0, (elems,)))
+    ref = KeySpace()
+    cpu = CpuMergeEngine()
+    dev = KeySpace()
+    eng = TpuMergeEngine(resident=True, steady=True, warmup=0,
+                         dense_fold=backend)
+    u = 1
+    for _ in range(8):
+        rows, u = gen_rows(rng, 48, 10, 4, elems, u)
+        b1 = make_batch(rows, cfg, elems)
+        b2 = make_batch(rows, cfg, elems)
+        cpu.merge_many(ref, [b1])
+        eng.merge_many(dev, [b2])
+        got = eng.tensor_read_many(dev, range(dev.keys.n))
+        for kid in range(ref.keys.n):
+            want = ref.tensor_read(kid)
+            assert np.array_equal(want, got[kid]), (strat, kid)
+    assert eng.tns_dev_rows > 0 and eng.tns_host_rows == 0
+    assert eng.dev_rounds_resident > 0
+    eng.flush(dev)
+    assert dev.canonical() == ref.canonical()
+    # post-flush host reads equal the device reads that preceded them
+    for kid in range(dev.keys.n):
+        assert np.array_equal(dev.tensor_read(kid), got[kid])
+    eng.close()
+
+
+def test_resident_steady_off_routes_host():
+    """CONSTDB_RESIDENT=0 semantics (steady=False): tensor rows take the
+    host strategy, no pools, same results."""
+    rng = np.random.default_rng(13)
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_SUM, 0, (32,)))
+    rows, _ = gen_rows(rng, 64, 6, 3, 32)
+    ref = KeySpace()
+    CpuMergeEngine().merge_many(ref, [make_batch(rows, cfg, 32)])
+    dev = KeySpace()
+    eng = TpuMergeEngine(resident=True, steady=False)
+    eng.merge_many(dev, [make_batch(rows, cfg, 32)])
+    eng.flush(dev)
+    assert eng.tns_dev_rows == 0 and eng.tns_host_rows == len(rows)
+    assert not eng._tns_pools
+    assert dev.canonical() == ref.canonical()
+    eng.close()
+
+
+def test_config_mismatch_and_bad_payload_skip_rows():
+    """Config-mismatched and wrong-size rows drop with a log on BOTH
+    engines (snapshot-merge semantics), never poisoning the batch."""
+    elems = 16
+    good = T.pack_config(T.TensorMeta(T.STRAT_SUM, 0, (elems,)))
+    other = T.pack_config(T.TensorMeta(T.STRAT_AVG, 0, (elems,)))
+    rng = np.random.default_rng(7)
+    rows = [(0, 1, 10 << 22, 1, payload(rng, elems).tobytes()),
+            (0, 2, 11 << 22, 1, payload(rng, elems).tobytes()),
+            (1, 1, 12 << 22, 1, payload(rng, elems).tobytes())]
+    stores = []
+    for make in (CpuMergeEngine,
+                 lambda: TpuMergeEngine(resident=True, steady=True,
+                                        warmup=0)):
+        b = make_batch(rows, good, elems)
+        b.tns_cfg = [good, other, good]        # row 1: config mismatch
+        b.tns_payload[2] = b.tns_payload[2][:-4]  # row 2: short payload
+        ks = KeySpace()
+        eng = make()
+        eng.merge_many(ks, [b])
+        if hasattr(eng, "flush"):
+            eng.flush(ks)
+        assert ks.tns_merges_by_strat.get("sum", 0) == 1
+        stores.append(ks)
+    assert stores[0].canonical() == stores[1].canonical()
+
+
+def test_pool_cap_flush_and_op_write_invalidation():
+    """The CONSTDB_TENSOR_POOL_MB cap flushes + drops pools mid-stream,
+    and an op-path tensor write (fam_ver bump) drops clean pools —
+    both keep results identical to the reference."""
+    rng = np.random.default_rng(23)
+    elems = 64
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_MAXMAG, 0, (elems,)))
+    ref = KeySpace()
+    cpu = CpuMergeEngine()
+    dev = KeySpace()
+    eng = TpuMergeEngine(resident=True, steady=True, warmup=0)
+    eng.tns_pool_cap = 1 << 14  # trip the cap every couple of rounds
+    u = 1
+    for r in range(6):
+        rows, u = gen_rows(rng, 32, 6, 3, elems, u)
+        cpu.merge_many(ref, [make_batch(rows, cfg, elems)])
+        eng.merge_many(dev, [make_batch(rows, cfg, elems)])
+        if r == 3:
+            # op-path write between rounds: flush-before-touch, then
+            # the version bump must drop the (clean) pools
+            eng.flush(dev)
+            u += 1
+            op_pay = payload(rng, elems)
+            for ks in (dev, ref):
+                kid = ks.tensor_get_or_create(b"t0002", cfg, u << 22)
+                ks.tensor_slot_set(kid, 9, u << 22, 1, op_pay)
+            dev.touch("tns")
+    eng.flush(dev)
+    assert dev.canonical() == ref.canonical()
+    eng.close()
+
+
+# ------------------------------------------------------------- commands
+
+
+def mesh_pair():
+    a = Node(node_id=1, engine=CpuMergeEngine())
+    b = Node(node_id=2, engine=CpuMergeEngine())
+    return a, b
+
+
+def replay(a, b, done):
+    for u in a.repl_log.uuids():
+        if u in done:
+            continue
+        e = a.repl_log.at(u)
+        b.apply_replicated(e.name, e.args, a.node_id, e.uuid)
+        done.add(u)
+
+
+def test_command_roundtrip_and_replication():
+    rng = np.random.default_rng(31)
+    a, b = mesh_pair()
+    p1 = payload(rng, 8).tobytes()
+    p2 = payload(rng, 8).tobytes()
+    assert a.execute(cmd(b"tensor.set", b"m", b"avg", b"f32", b"8",
+                         Bulk(p1), b"3")).val == b"OK"
+    assert a.execute(cmd(b"tensor.merge", b"m", Bulk(p2))).val == b"OK"
+    # one node = one slot: the second write LWW-replaced the first
+    got = a.execute(cmd(b"tensor.get", b"m"))
+    assert got.val == np.frombuffer(p2, np.float32).tobytes()
+    st = a.execute(cmd(b"tensor.stat", b"m"))
+    assert st.items[0].val == b"avg" and st.items[1].val == b"f32"
+    assert st.items[3].val == 1  # one contributor
+    done = set()
+    replay(a, b, done)
+    assert a.canonical() == b.canonical()
+    # second writer on b flows back as a second contributor
+    p3 = payload(rng, 8).tobytes()
+    b.execute(cmd(b"tensor.merge", b"m", Bulk(p3), b"2"))
+    for u in b.repl_log.uuids():
+        e = b.repl_log.at(u)
+        a.apply_replicated(e.name, e.args, b.node_id, e.uuid)
+    assert a.canonical() == b.canonical()
+    assert len(a.ks.tensor_contribs(a.ks.lookup(b"m"))) == 2
+
+
+def test_command_errors_and_config_fixed_at_creation():
+    rng = np.random.default_rng(37)
+    a, _ = mesh_pair()
+    p = payload(rng, 8).tobytes()
+    a.execute(cmd(b"tensor.set", b"k", b"sum", b"f32", b"8", Bulk(p)))
+    r = a.execute(cmd(b"tensor.set", b"k", b"avg", b"f32", b"8", Bulk(p)))
+    assert b"mismatch" in r.val  # strategy is creation-fixed
+    r = a.execute(cmd(b"tensor.merge", b"k", Bulk(p[:-4])))
+    assert b"bytes" in r.val
+    r = a.execute(cmd(b"tensor.merge", b"absent", Bulk(p)))
+    assert b"no such tensor" in r.val
+    r = a.execute(cmd(b"tensor.set", b"k2", b"nope", b"f32", b"8",
+                      Bulk(p)))
+    assert b"unknown tensor strategy" in r.val
+    a.execute(cmd(b"set", b"reg", b"v"))
+    r = a.execute(cmd(b"tensor.merge", b"reg", Bulk(p)))
+    assert b"WRONGTYPE" in r.val
+    # a config-LESS tensor key (a replicated deltensor for a never-seen
+    # key materializes the tombstoned row only): TENSOR.MERGE must give
+    # the clean no-such-key error, not crash on the absent meta
+    a.apply_replicated(b"deltensor", [Bulk(b"ghost")], 9, 99 << 22)
+    r = a.execute(cmd(b"tensor.merge", b"ghost", Bulk(p)))
+    assert b"no such tensor" in r.val
+    # ...and TENSOR.SET repairs it by installing the config
+    r = a.execute(cmd(b"tensor.set", b"ghost", b"sum", b"f32", b"8",
+                      Bulk(p)))
+    assert r.val == b"OK"
+    # a dim >= 2^32 errors cleanly even when the key name already
+    # exists (the existing-key path lifts the elems cap but must not
+    # lift the wire-format bound)
+    r = a.execute(cmd(b"tensor.set", b"reg", b"sum", b"f32",
+                      str(1 << 32).encode(), Bulk(p)))
+    assert b"2^32" in r.val, r
+    # non-positive counts would poison avg reads with 0/0 — rejected
+    r = a.execute(cmd(b"tensor.merge", b"ghost", Bulk(p), b"0"))
+    assert b"count" in r.val, r
+    r = a.execute(cmd(b"tensor.set", b"k9", b"avg", b"f32", b"8",
+                      Bulk(p), b"-2"))
+    assert b"count" in r.val, r
+    # a malformed replicated count skips the row on BOTH engine paths
+    # (snapshot-merge semantics) instead of landing the poison
+    cfg8 = T.pack_config(T.TensorMeta(T.STRAT_AVG, 0, (8,)))
+    for make in (CpuMergeEngine,
+                 lambda: TpuMergeEngine(resident=True, steady=True,
+                                        warmup=0)):
+        ks = KeySpace()
+        eng = make()
+        b0 = make_batch([(0, 1, 10 << 22, 0, p),      # count 0: skip
+                         (0, 2, 11 << 22, 2, p)], cfg8, 8)
+        b0.tns_cnt = np.array([0, 2], np.int64)
+        eng.merge_many(ks, [b0])
+        if hasattr(eng, "flush"):
+            eng.flush(ks)
+        assert len(ks.tensor_contribs(0)) == 1
+
+
+def test_del_tombstones_and_add_wins_resurrect():
+    rng = np.random.default_rng(41)
+    a, b = mesh_pair()
+    p = payload(rng, 8).tobytes()
+    from constdb_tpu.resp.message import NIL
+    a.execute(cmd(b"tensor.set", b"k", b"lww", b"f32", b"8", Bulk(p)))
+    assert a.execute(cmd(b"del", b"k")).val == 1
+    assert a.execute(cmd(b"tensor.get", b"k")) is NIL
+    p2 = payload(rng, 8).tobytes()
+    a.execute(cmd(b"tensor.merge", b"k", Bulk(p2)))
+    assert a.execute(cmd(b"tensor.get", b"k")).val == \
+        np.frombuffer(p2, np.float32).tobytes()
+    done = set()
+    replay(a, b, done)
+    assert a.canonical() == b.canonical()
+
+
+# ----------------------------------------------------------- coalescers
+
+
+def test_replication_coalescer_differential():
+    """tset frames through the coalescing applier (batch=N) vs the
+    exact per-frame path (batch=1), on the CPU engine AND the resident
+    device engine — identical canonical exports."""
+    rng = np.random.default_rng(43)
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_TRIMMED, 0, (48,)))
+    frames = []
+    prev = 0
+    u = 0
+    for _ in range(300):
+        u += int(rng.integers(1, 4))
+        key = b"t%02d" % rng.integers(8)
+        frames.append([Bulk(b"replicate"), Int(9), Int(prev),
+                       Int(u << 22), Bulk(b"tset"), Bulk(key), Bulk(cfg),
+                       Int(int(rng.integers(1, 4))),
+                       Bulk(payload(rng, 48).tobytes())])
+        prev = u << 22
+        if rng.random() < 0.05:  # scalar tensor delete coalesces too
+            u += 1
+            frames.append([Bulk(b"replicate"), Int(9), Int(prev),
+                           Int(u << 22), Bulk(b"deltensor"), Bulk(key)])
+            prev = u << 22
+
+    def run(make_engine, batch):
+        node = Node(node_id=1, engine=make_engine())
+        ap = CoalescingApplier(node, ReplicaMeta("x:0"),
+                               max_frames=batch, max_latency=10)
+        for f in frames:
+            ap.apply(f)
+        ap.flush()
+        node.ensure_flushed()
+        return node
+
+    base = run(CpuMergeEngine, 1)
+    assert run(CpuMergeEngine, 64).canonical() == base.canonical()
+    n3 = run(lambda: TpuMergeEngine(resident=True, steady=True,
+                                    warmup=0), 64)
+    assert n3.canonical() == base.canonical()
+    assert n3.engine.tns_dev_rows > 0
+    n3.engine.close()
+
+
+def test_serve_planner_differential():
+    """TENSOR.SET/MERGE through the serve coalescer vs the per-command
+    path under the same stepping clock: byte-identical replies, repl
+    log, and canonical export; demotions (mismatch/absent/short) raise
+    the exact op errors in order."""
+    from constdb_tpu.resp.codec import encode_into
+    from constdb_tpu.server.serve import ServeCoalescer
+
+    def stepping_clock():
+        t = [1_700_000_000_000]
+
+        def clock():
+            t[0] += 1
+            return t[0]
+        return clock
+
+    def workload():
+        rng = np.random.default_rng(47)
+        msgs = []
+        for i in range(120):
+            key = b"t%02d" % rng.integers(6)
+            r = rng.random()
+            if r < 0.45:
+                msgs.append(cmd(b"tensor.set", key, b"avg", b"f32",
+                                b"16", Bulk(payload(rng, 16).tobytes()),
+                                b"2"))
+            elif r < 0.75:
+                msgs.append(cmd(b"tensor.merge", key,
+                                Bulk(payload(rng, 16).tobytes())))
+            elif r < 0.82:
+                msgs.append(cmd(b"tensor.get", key))       # scoped read
+            elif r < 0.87:
+                msgs.append(cmd(b"tensor.stat", key))
+            elif r < 0.92:  # demote: wrong config for an existing key
+                msgs.append(cmd(b"tensor.set", key, b"sum", b"f32",
+                                b"16", Bulk(payload(rng, 16).tobytes())))
+            elif r < 0.96:  # demote: short payload
+                msgs.append(cmd(b"tensor.merge", key, Bulk(b"xx")))
+            else:           # barrier: unrelated write
+                msgs.append(cmd(b"set", b"r%d" % i, b"v"))
+        return msgs
+
+    # coalesced node
+    nc = Node(node_id=1, clock=stepping_clock(), engine=CpuMergeEngine())
+    coal = ServeCoalescer(nc, max_run=32)
+    out_c = bytearray()
+    msgs = workload()
+    for lo in range(0, len(msgs), 24):  # chunked like drained pipelines
+        coal.run_chunk(msgs[lo:lo + 24], out_c)
+    # per-command node
+    np_ = Node(node_id=1, clock=stepping_clock(),
+               engine=CpuMergeEngine())
+    out_p = bytearray()
+    for m in workload():
+        reply = np_.execute(m)
+        if not isinstance(reply, NoReply):
+            encode_into(out_p, reply)
+    assert bytes(out_c) == bytes(out_p)
+    assert nc.canonical() == np_.canonical()
+    assert list(nc.repl_log.uuids()) == list(np_.repl_log.uuids())
+    assert nc.stats.serve_msgs_coalesced > 0
+
+
+def test_tensor_get_serves_from_device_without_flush():
+    """The production read path: TENSOR.GET on a steady resident engine
+    reduces from the payload pools — no flush, no dirty-row download —
+    and still returns the exact host-reference bytes.  (Found by
+    review: the device read originally had no production call site.)"""
+    rng = np.random.default_rng(71)
+    node = Node(node_id=1,
+                engine=TpuMergeEngine(resident=True, steady=True,
+                                      warmup=0))
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_AVG, 0, (32,)))
+    rows, _ = gen_rows(rng, 24, 4, 3, 32)
+    node.merge_batches([make_batch(rows, cfg, 32)])
+    eng = node.engine
+    assert eng.needs_flush and eng.tns_dev_rows == len(rows)
+    got = node.execute(cmd(b"tensor.get", b"t0001"))
+    # the read did NOT flush: payload truth stayed on device
+    assert eng.needs_flush and eng.flush_rows_downloaded == 0
+    # interleaved single-key reads each keep a cached group structure
+    node.execute(cmd(b"tensor.get", b"t0002"))
+    node.execute(cmd(b"tensor.get", b"t0001"))
+    assert len(eng._tns_read_cache["by_kids"]) == 2
+    st = node.execute(cmd(b"tensor.stat", b"t0001"))
+    assert eng.flush_rows_downloaded == 0
+    assert st.items[0].val == b"avg"
+    # reference: an identical CPU-engine store
+    ref = KeySpace()
+    CpuMergeEngine().merge_many(ref, [make_batch(rows, cfg, 32)])
+    want = ref.tensor_read(ref.lookup(b"t0001"))
+    assert got.val == want.tobytes()
+    # a non-tensor command still takes the blanket flush barrier
+    node.execute(cmd(b"get", b"t0001"))
+    assert not eng.needs_flush
+    eng.close()
+
+
+# ------------------------------------------------- snapshot/digest/info
+
+
+def test_snapshot_roundtrip_and_chunking(tmp_path):
+    from constdb_tpu.persist.snapshot import (NodeMeta, batch_chunks,
+                                              dump_keyspace,
+                                              load_snapshot)
+
+    rng = np.random.default_rng(53)
+    ks = KeySpace()
+    cfg64 = T.pack_config(T.TensorMeta(T.STRAT_AVG, 1, (8, 8)))
+    for i in range(24):
+        kid = ks.tensor_get_or_create(b"t%02d" % i, cfg64, (i + 1) << 22)
+        for nd in (1, 2):
+            ks.tensor_slot_set(kid, nd, (i + nd + 2) << 22,
+                               int(rng.integers(1, 4)),
+                               payload(rng, 64, np.float64))
+            ks.updated_at(kid, (i + nd + 2) << 22)
+    kid, _ = ks.get_or_create(b"s", S.ENC_SET, 5 << 22)
+    ks.elem_add(kid, b"m", None, 5 << 22, 1)
+    path = str(tmp_path / "t.snap")
+    dump_keyspace(path, ks, NodeMeta(node_id=1), chunk_keys=5)
+    ks2 = KeySpace()
+    load_snapshot(path, ks2, CpuMergeEngine())
+    assert ks.canonical() == ks2.canonical()
+    # chunked merge (tensor rows re-pointed per chunk) converges too
+    ks3 = KeySpace()
+    eng = CpuMergeEngine()
+    for c in batch_chunks(batch_from_keyspace(ks), 7):
+        eng.merge(ks3, c)
+    assert ks.canonical() == ks3.canonical()
+    # f64 device reads equal the host (XLA twin path)
+    dev = KeySpace()
+    teng = TpuMergeEngine(resident=True, steady=True, warmup=0)
+    for c in batch_chunks(batch_from_keyspace(ks), 7):
+        teng.merge_many(dev, [c])
+    got = teng.tensor_read_many(dev, range(dev.keys.n))
+    for kid2 in range(dev.keys.n):
+        want = dev_want = ks2.tensor_read(ks2.lookup(
+            dev.key_bytes[kid2]))
+        if want is None:
+            assert got[kid2] is None
+        else:
+            assert np.array_equal(dev_want, got[kid2])
+    teng.close()
+
+
+def test_digest_covers_tensor_plane():
+    from constdb_tpu.store import digest as DG
+
+    rng = np.random.default_rng(59)
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_SUM, 0, (16,)))
+
+    def build():
+        ks = KeySpace()
+        r = np.random.default_rng(59)
+        for i in range(30):
+            kid = ks.tensor_get_or_create(b"t%02d" % i, cfg,
+                                          (i + 1) << 22)
+            ks.tensor_slot_set(kid, 1, (i + 2) << 22, 1, payload(r, 16))
+            ks.updated_at(kid, (i + 2) << 22)
+        return ks
+
+    a, b = build(), build()
+    m1 = DG.state_digest_matrix(a, 64, 4)
+    assert np.array_equal(m1, DG.state_digest_matrix(b, 64, 4))
+    # a tensor-slot divergence flags its bucket; the bucket export
+    # converges the peer
+    b.tensor_slot_set(b.lookup(b"t07"), 2, 999 << 22, 1,
+                      payload(rng, 16))
+    m2 = DG.state_digest_matrix(b, 64, 4)
+    diff = (m1 != m2).reshape(-1)
+    assert diff.any()
+    CpuMergeEngine().merge(a, DG.export_bucket_batch(b, 64, 4, diff))
+    assert np.array_equal(DG.state_digest_matrix(a, 64, 4), m2)
+    # level-2 stamps see it too
+    tbl = DG.KeyStampTable(b, 64, 4, diff)
+    idx = DG.stamp_mismatch_indices(build(), tbl.crcs, tbl.stamps)
+    assert len(idx) >= 1
+
+
+def test_info_gauges_and_stats():
+    rng = np.random.default_rng(61)
+    node = Node(node_id=1,
+                engine=TpuMergeEngine(resident=True, steady=True,
+                                      warmup=0))
+    p = payload(rng, 16).tobytes()
+    node.execute(cmd(b"tensor.set", b"a", b"avg", b"f32", b"16",
+                     Bulk(p), b"2"))
+    node.execute(cmd(b"tensor.set", b"b", b"maxmag", b"f32", b"16",
+                     Bulk(p)))
+    # a coalesced tset lands through the engine (device routing gauge)
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_AVG, 0, (16,)))
+    rows = [(0, 7, 10_000 << 22, 1, p)]
+    node.merge_batches([make_batch(rows, cfg, 16)])
+    info = node.execute(cmd(b"info")).val.decode()
+    assert "tensors:3" in info
+    assert "tensor_slots:" in info
+    assert "tensor_merges_avg:" in info
+    assert "tensor_merges_maxmag:1" in info
+    assert "tns_dev_rows:" in info and "tns_pool_bytes:" in info
+    got = int(info.split("tensor_payload_bytes:")[1].split("\r\n")[0])
+    node.ensure_flushed()
+    assert node.ks.tns_bytes == sum(
+        pl.nbytes for pl in node.ks.tns_payload if pl is not None)
+    assert got >= 0
+    node.engine.close()
+
+
+def test_extract_shard_routes_tensor_rows():
+    from constdb_tpu.store.sharded_keyspace import (extract_shard,
+                                                    shard_ids)
+
+    rng = np.random.default_rng(67)
+    cfg = T.pack_config(T.TensorMeta(T.STRAT_SUM, 0, (8,)))
+    rows, _ = gen_rows(rng, 40, 12, 3, 8)
+    b = make_batch(rows, cfg, 8)
+    sids = shard_ids(b.keys, 2)
+    ref = KeySpace()
+    CpuMergeEngine().merge(ref, b)
+    parts = [extract_shard(b, sids, None, s) for s in (0, 1)]
+    assert sum(len(p.tns_ki) for p in parts) == len(b.tns_ki)
+    merged = KeySpace()
+    eng = CpuMergeEngine()
+    for p in parts:
+        eng.merge(merged, p)
+    assert merged.canonical() == ref.canonical()
