@@ -703,6 +703,371 @@ def stream_main(args) -> None:
 
 
 # --------------------------------------------------------------------------
+# --mode stream --wire: the replication WIRE itself, socket to socket.
+# The stream mode above replays frames straight into the applier — it
+# measures the apply path with the transport already paid.  The wire
+# legs run the REAL push loop against a real socket pair and a receiver
+# driving the real intake, interleaved: batch wire (REPLBATCH columnar
+# runs, replica/wire.py) vs per-frame wire (the pre-PR byte stream) vs
+# the intra-node apply baseline, every leg oracle-verified against the
+# per-frame CPU replay, with wire bytes + encode/decode split per leg —
+# and a 3-node mesh differential (batch-wire nodes + one per-frame
+# node) that must converge byte-identically under mixed traffic.
+
+
+def frames_to_entries(frames) -> list:
+    """Recorded REPLICATE frames -> (uuid, name, args) repl-log rows."""
+    from constdb_tpu.resp.message import as_bytes, as_int
+
+    return [(as_int(items[3]), as_bytes(items[4]), list(items[5:]))
+            for items in frames]
+
+
+def _timed_wire_codec():
+    """Wrap the wire codec entry points with perf counters (bench-only:
+    the product pays no timing overhead).  Returns (acc, restore)."""
+    import constdb_tpu.replica.wire as wire_mod
+
+    enc0, dec0 = wire_mod.build_wire_batch, wire_mod.decode_wire_batch
+    acc = {"enc": 0.0, "dec": 0.0}
+
+    def enc(*a, **k):
+        t = time.perf_counter()
+        r = enc0(*a, **k)
+        acc["enc"] += time.perf_counter() - t
+        return r
+
+    def dec(*a, **k):
+        t = time.perf_counter()
+        r = dec0(*a, **k)
+        acc["dec"] += time.perf_counter() - t
+        return r
+
+    wire_mod.build_wire_batch = enc
+    wire_mod.decode_wire_batch = dec
+
+    def restore():
+        wire_mod.build_wire_batch = enc0
+        wire_mod.decode_wire_batch = dec0
+
+    return acc, restore
+
+
+async def _wire_replay(entries, batching: bool, wire_batch: int,
+                       apply_batch: int, latency_s: float):
+    """One socket-to-socket leg: the real `_push_loop` streams a filled
+    repl_log over a socketpair; the receiver drives the real intake
+    (per-frame coalescer + REPLBATCH apply).  Returns the receiver
+    node, wall seconds (push start -> watermark covers the last op),
+    the pusher node (wire counters), and the REPLACK count."""
+    import socket
+    import types
+
+    from constdb_tpu.replica.coalesce import CoalescingApplier
+    from constdb_tpu.replica.link import (CAP_BATCH_STREAM, PARTSYNC,
+                                          REPLACK, REPLBATCH, REPLICATE,
+                                          ReplicaLink)
+    from constdb_tpu.replica.manager import ReplicaMeta
+    from constdb_tpu.resp.codec import make_parser
+    from constdb_tpu.resp.message import as_bytes, as_int
+    from constdb_tpu.server.node import Node
+
+    loop = asyncio.get_running_loop()
+    pusher = Node(node_id=99, repl_log_cap=1 << 40)
+    for uuid, name, args in entries:
+        pusher.repl_log.push(uuid, name, args)
+    last = entries[-1][0]
+    app = types.SimpleNamespace(node=pusher, heartbeat=0.2,
+                                reconnect_delay=1.0, handshake_timeout=5.0,
+                                work_dir=".", wire_batch=wire_batch,
+                                wire_latency=0.005)
+    meta = ReplicaMeta(addr="bench-wire:1")
+    link = ReplicaLink(app, meta)
+    link._peer_caps = CAP_BATCH_STREAM if batching else 0
+    s_push, s_pull = socket.socketpair()
+    push_reader, push_writer = await asyncio.open_connection(sock=s_push)
+    pull_reader, pull_writer = await asyncio.open_connection(sock=s_pull)
+    recv = Node(node_id=1)
+    rmeta = ReplicaMeta("bench-wire:0")
+    applier = CoalescingApplier(recv, rmeta, max_frames=apply_batch,
+                                max_latency=latency_s, now=loop.time)
+    acks = 0
+
+    async def receiver() -> None:
+        nonlocal acks
+        parser = make_parser()
+        while rmeta.uuid_he_sent < last:
+            msg = parser.next_msg()
+            if msg is None:
+                if applier.pending:
+                    applier.flush()  # stream idle: land now
+                    continue  # re-check the watermark BEFORE blocking —
+                    # a tail landed by this flush must end the leg now,
+                    # not a pusher heartbeat later (which would charge
+                    # an asymmetric ~0.2s penalty to the per-frame leg)
+                data = await pull_reader.read(1 << 16)
+                if not data:
+                    raise ConnectionError("wire leg: EOF")
+                parser.feed(data)
+                continue
+            items = msg.items
+            kind = as_bytes(items[0]).lower()
+            if kind == REPLICATE:
+                applier.apply(items)
+            elif kind == REPLBATCH:
+                applier.apply_wire_batch(items)
+            elif kind == REPLACK:
+                acks += 1
+                if len(items) > 3:
+                    applier.observe_beacon(as_int(items[3]))
+            elif kind != PARTSYNC:
+                raise AssertionError(f"unexpected wire frame {kind!r}")
+
+    t0 = loop.time()
+    push_task = asyncio.create_task(link._push_loop(push_writer,
+                                                    peer_resume=0))
+    try:
+        await asyncio.wait_for(receiver(), timeout=600)
+        wall = loop.time() - t0
+    finally:
+        push_task.cancel()
+        for w in (push_writer, pull_writer):
+            try:
+                w.close()
+            except (ConnectionError, OSError):
+                pass
+    recv.ensure_flushed()
+    return recv, wall, pusher, acks
+
+
+async def _wire_mesh_differential(work_dir: str) -> dict:
+    """3-node mesh, one node pinned to the per-frame wire: mixed
+    write/DEL/membership traffic from every node must converge all
+    three to the identical canonical export (the deterministic twin
+    lives in tests/test_repl_capabilities.py)."""
+    import random as _random
+
+    from constdb_tpu.resp.codec import RespParser, encode_msg as _enc
+    from constdb_tpu.resp.message import Arr, Bulk
+    from constdb_tpu.server.io import start_node
+    from constdb_tpu.server.node import Node
+
+    class _Cli:
+        def __init__(self):
+            self.parser = RespParser()
+
+        async def connect(self, addr):
+            host, port = addr.rsplit(":", 1)
+            self.reader, self.writer = await asyncio.open_connection(
+                host, int(port))
+            return self
+
+        async def cmd(self, *parts):
+            self.writer.write(_enc(Arr([
+                Bulk(p if isinstance(p, bytes) else str(p).encode())
+                for p in parts])))
+            await self.writer.drain()
+            while True:
+                msg = self.parser.next_msg()
+                if msg is not None:
+                    return msg
+                data = await asyncio.wait_for(self.reader.read(1 << 16), 10)
+                if not data:
+                    raise ConnectionError("EOF")
+                self.parser.feed(data)
+
+        async def close(self):
+            self.writer.close()
+
+    apps = []
+    for i in range(3):
+        node = Node(node_id=i + 1, alias=f"w{i + 1}")
+        apps.append(await start_node(node, host="127.0.0.1", port=0,
+                                     work_dir=work_dir, heartbeat=0.15,
+                                     reconnect_delay=0.25, gc_interval=0.2))
+    apps[2].wire_batch = 1  # the per-frame node, pinned pre-handshake
+    out = {"converged": False, "batches": 0, "perframe_node_batches": 0}
+    try:
+        clients = [await _Cli().connect(a.advertised_addr) for a in apps]
+        await clients[0].cmd("meet", apps[1].advertised_addr)
+        await clients[0].cmd("meet", apps[2].advertised_addr)
+        rng = _random.Random(31)
+        for i in range(300):
+            c = clients[i % 3]
+            r = rng.random()
+            k = f"k{rng.randrange(50)}"
+            if r < 0.35:
+                await c.cmd("set", "r" + k, f"v{i}")
+            elif r < 0.55:
+                await c.cmd("incrby", "c" + k, rng.randrange(1, 9))
+            elif r < 0.75:
+                await c.cmd("sadd", "s" + k, f"m{rng.randrange(12)}")
+            elif r < 0.88:
+                await c.cmd("hset", "h" + k, "f1", f"v{i}")
+            else:
+                await c.cmd("del", "r" + k)
+        # pipelined burst so runs form on the capable pair
+        c0 = clients[0]
+        for i in range(300):
+            c0.writer.write(_enc(Arr([Bulk(b"set"),
+                                      Bulk(b"burst%d" % i),
+                                      Bulk(b"v" * 12)])))
+        await c0.writer.drain()
+        got = 0
+        while got < 300:
+            if c0.parser.next_msg() is not None:
+                got += 1
+                continue
+            data = await asyncio.wait_for(c0.reader.read(1 << 16), 10)
+            if not data:
+                raise ConnectionError("EOF")
+            c0.parser.feed(data)
+        deadline = asyncio.get_running_loop().time() + 60
+        while asyncio.get_running_loop().time() < deadline:
+            canons = [a.node.canonical() for a in apps]
+            if all(c == canons[0] for c in canons[1:]):
+                out["converged"] = True
+                break
+            await asyncio.sleep(0.05)
+        out["batches"] = sum(a.node.stats.repl_wire_batches_out
+                             for a in apps[:2])
+        out["perframe_node_batches"] = \
+            apps[2].node.stats.repl_wire_batches_out + \
+            apps[2].node.stats.repl_wire_batches_in
+        for c in clients:
+            await c.close()
+    finally:
+        for a in apps:
+            await a.close()
+    return out
+
+
+def wire_main(args) -> None:
+    """`bench.py --mode stream --wire`: the batch wire protocol end to
+    end over real sockets.  Emits ONE JSON line (BENCH_r14)."""
+    import tempfile
+
+    n_frames = int(os.environ.get("CONSTDB_BENCH_FRAMES", 100_000))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_STREAM_KEYS", 20_000))
+    apply_batch = int(os.environ.get("CONSTDB_BENCH_APPLY_BATCH", 4096))
+    latency_s = float(os.environ.get("CONSTDB_BENCH_APPLY_LATENCY_MS",
+                                     1000.0)) / 1000.0
+    wire_batch = int(os.environ.get("CONSTDB_BENCH_WIRE_BATCH", 512))
+    reps = int(os.environ.get("CONSTDB_BENCH_WIRE_REPS", 3))
+
+    ensure_native()
+    if args.frame_log and os.path.exists(args.frame_log):
+        frames = load_frame_log(args.frame_log)
+    else:
+        frames = make_frame_log(n_frames, n_keys)
+        if args.frame_log:
+            save_frame_log(args.frame_log, frames)
+    entries = frames_to_entries(frames)
+    per_frame_wire_bytes = sum(
+        len(encode_msg_frame(items)) for items in frames)
+    print(f"[bench] wire legs: {len(frames)} frames, per-frame wire "
+          f"{per_frame_wire_bytes:,} B "
+          f"({per_frame_wire_bytes / len(frames):.1f} B/op)",
+          file=sys.stderr)
+
+    # oracle: the per-frame CPU replay of the same log
+    base_node, _, _ = replay_stream(frames, CpuMergeEngine,
+                                    apply_batch=1, latency_s=1.0)
+    want = base_node.canonical()
+
+    # intra-node baseline: the coalesced apply path with no socket
+    intra_wall = float("inf")
+    for _ in range(reps):
+        _, w_, _ = replay_stream(frames, CpuMergeEngine,
+                                 apply_batch=apply_batch,
+                                 latency_s=latency_s)
+        intra_wall = min(intra_wall, w_)
+
+    best = {True: None, False: None}
+    for _ in range(reps):
+        for batching in (True, False):
+            acc, restore = _timed_wire_codec()
+            try:
+                recv, wall, pusher, acks = asyncio.run(_wire_replay(
+                    entries, batching, wire_batch, apply_batch, latency_s))
+            finally:
+                restore()
+            leg = {
+                "leg": "batch-wire" if batching else "per-frame-wire",
+                "wall_s": round(wall, 3),
+                "fps": round(len(frames) / wall, 1),
+                "wire_bytes": pusher.stats.repl_wire_bytes_out,
+                "bytes_per_op": round(
+                    pusher.stats.repl_wire_bytes_out / len(frames), 1),
+                "batches": pusher.stats.repl_wire_batches_out,
+                "batch_frames": pusher.stats.repl_wire_batch_frames_out,
+                "encode_s": round(acc["enc"], 3),
+                "decode_s": round(acc["dec"], 3),
+                "replacks": acks,
+                "coalesce_flushes": recv.stats.repl_coalesce_flushes,
+                "apply_barriers": recv.stats.repl_apply_barriers,
+                "wire_demotions": recv.stats.repl_wire_demotions,
+                "diffs": compare_canonical(recv.canonical(), want),
+            }
+            prev = best[batching]
+            if leg["diffs"]:
+                best[batching] = leg  # a diverging rep always surfaces
+            elif prev is None or (prev["diffs"] == 0
+                                  and wall < prev["wall_s"]):
+                best[batching] = leg
+            print(f"[bench] {leg['leg']}: {leg['wall_s']}s = "
+                  f"{leg['fps']:,.0f} frames/s, "
+                  f"{leg['wire_bytes']:,} wire B "
+                  f"({leg['bytes_per_op']} B/op), {leg['batches']} "
+                  f"batches, enc {leg['encode_s']}s dec "
+                  f"{leg['decode_s']}s, {leg['replacks']} acks "
+                  f"({'OK' if leg['diffs'] == 0 else 'MISMATCH'})",
+                  file=sys.stderr)
+
+    batch_leg, frame_leg = best[True], best[False]
+    with tempfile.TemporaryDirectory(prefix="constdb-wire-mesh") as td:
+        mesh = asyncio.run(_wire_mesh_differential(td))
+    print(f"[bench] mesh differential: converged={mesh['converged']}, "
+          f"{mesh['batches']} batches on the capable pair, "
+          f"{mesh['perframe_node_batches']} on the per-frame node",
+          file=sys.stderr)
+
+    verified = batch_leg["diffs"] == 0 and frame_leg["diffs"] == 0 and \
+        mesh["converged"] and mesh["perframe_node_batches"] == 0
+    out = {
+        "metric": "wire_stream_apply_frames_per_sec",
+        "value": batch_leg["fps"],
+        "unit": "frames/sec",
+        "mode": "stream-wire",
+        "frames": len(frames),
+        "stream_keys": n_keys,
+        "wire_batch": wire_batch,
+        "apply_batch": apply_batch,
+        "legs": [batch_leg, frame_leg],
+        "speedup_vs_per_frame_wire": round(
+            batch_leg["fps"] / frame_leg["fps"], 2),
+        "wire_bytes_ratio": round(
+            frame_leg["wire_bytes"] / batch_leg["wire_bytes"], 2),
+        "intra_node_fps": round(len(frames) / intra_wall, 1),
+        "mesh_differential": mesh,
+        "engine": "cpu-hostbatch",
+        "backend": "none",
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
+
+
+def encode_msg_frame(items) -> bytes:
+    from constdb_tpu.resp.codec import encode_msg
+    from constdb_tpu.resp.message import Arr
+
+    return encode_msg(Arr(items))
+
+
+# --------------------------------------------------------------------------
 # --mode tensor: tensor-valued registers — the first family designed
 # device-first (crdt/tensor.py).  A stream of contribution micro-batches
 # (the coalescer flush shape: a few hundred rows, rows_unique=False)
@@ -1844,6 +2209,12 @@ def main() -> None:
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
+    ap.add_argument("--wire", action="store_true",
+                    help="stream mode: run the socket-to-socket WIRE "
+                    "legs instead of the in-process apply replay — "
+                    "batch wire (REPLBATCH) vs per-frame wire vs the "
+                    "intra-node baseline, plus a 3-node mesh "
+                    "differential (BENCH_r14)")
     ap.add_argument("--resident", default=None,
                     help="snapshot/stream modes: comma list of 0|1 legs "
                     "(e.g. 0,1) — interleaves device-resident vs "
@@ -1855,7 +2226,10 @@ def main() -> None:
                     "instead of the coalesced-vs-per-command comparison")
     args, _ = ap.parse_known_args()
     if args.mode == "stream":
-        stream_main(args)
+        if args.wire:
+            wire_main(args)
+        else:
+            stream_main(args)
         return
     if args.mode == "serve":
         if args.serve_shards:

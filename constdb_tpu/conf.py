@@ -109,6 +109,14 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_APPLY_LATENCY_MS", "5",
            "max ms a coalesced replicate frame may wait before its "
            "batch is force-flushed (idle streams flush immediately)"),
+    EnvVar("CONSTDB_WIRE_BATCH", "512",
+           "max repl-log ops group-encoded into one REPLBATCH wire "
+           "frame on the push path; 1 = the byte-exact per-frame "
+           "stream (and the capability is not advertised)"),
+    EnvVar("CONSTDB_WIRE_LATENCY_MS", "5",
+           "max ms a drained op may sit in the push loop's aggregated "
+           "wire buffer before a socket flush (idle cycles flush "
+           "immediately, so a lone write is never delayed)"),
     EnvVar("CONSTDB_SERVE_BATCH", "512",
            "max pipelined client commands the serve path plans into one "
            "columnar merge; 1 = the exact per-command path"),

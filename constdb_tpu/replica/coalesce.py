@@ -75,6 +75,7 @@ Deliberate deviations from the per-frame path, both narrow:
 
 from __future__ import annotations
 
+import logging
 import time
 from itertools import chain
 from typing import Callable, Optional
@@ -86,10 +87,34 @@ from ..errors import CstError, ReplicateCommandsLost
 from ..resp.message import as_bytes, as_int
 from ..server.commands import (COLUMNAR_ENCODERS, KEY_SCOPED_BARRIERS,
                                NotColumnar, STATE_FREE_BARRIERS)
+from ..server.events import EVENT_PULL_LANDED
 
 _I64 = np.int64
 
 _ENC_ERRORS = (NotColumnar, CstError, IndexError)
+
+log = logging.getLogger(__name__)
+
+
+def apply_key_delete_rule(ks, b: ColumnarBatch, check) -> None:
+    """The element-plane key-delete rule, against the LIVE dt of exactly
+    the checked keys: an element add whose uuid predates its key's
+    delete time materializes tombstoned (`sadd`/`hset`/`lins` op twin —
+    see the module docstring).  `check` is the per-element-row mark the
+    add-side encoders leave (None = nothing marked).  Shared by the
+    coalescer's flush and the wire-batch decoder (replica/wire.py),
+    which must evaluate it against the RECEIVING store."""
+    if check is None or not check.any():
+        return
+    kis = np.unique(b.el_ki[check])
+    dts = ks.key_delete_times(list(map(b.keys.__getitem__, kis.tolist())))
+    if dts.any():
+        dt_by_ki = np.zeros(len(b.keys), dtype=_I64)
+        dt_by_ki[kis] = dts
+        row_dt = dt_by_ki[b.el_ki]
+        kill = check & (b.el_add_t < row_dt)
+        if kill.any():
+            b.el_del_t = np.where(kill, row_dt, b.el_del_t)
 
 
 class BatchBuilder:
@@ -222,19 +247,9 @@ class BatchBuilder:
                 np.fromiter(cols[5], dtype=_I64, count=nr), counts)
             check = np.repeat(
                 np.fromiter(cols[6], dtype=bool, count=nr), counts)
-            if check.any():
-                # the key-delete rule, against the LIVE dt of exactly the
-                # checked keys (not the whole batch key list)
-                kis = np.unique(b.el_ki[check])
-                dts = self.ks.key_delete_times(
-                    list(map(self.keys.__getitem__, kis.tolist())))
-                if dts.any():
-                    dt_by_ki = np.zeros(n, dtype=_I64)
-                    dt_by_ki[kis] = dts
-                    row_dt = dt_by_ki[b.el_ki]
-                    kill = check & (b.el_add_t < row_dt)
-                    if kill.any():
-                        b.el_del_t = np.where(kill, row_dt, b.el_del_t)
+            # the key-delete rule, against the LIVE dt of exactly the
+            # checked keys (not the whole batch key list)
+            apply_key_delete_rule(self.ks, b, check)
         if self.tns_rows:
             nt = len(self.tns_rows)
             cols = list(zip(*self.tns_rows))
@@ -304,6 +319,9 @@ class CoalescingApplier:
     async def aapply(self, items: list) -> None:
         self.apply(items)
 
+    async def aabatch(self, items: list) -> None:
+        self.apply_wire_batch(items)
+
     async def aflush(self) -> None:
         self.flush()
 
@@ -349,6 +367,72 @@ class CoalescingApplier:
                 (not f & 31 and
                  self._now() - self._first_ts >= self.max_latency):
             self.flush()
+
+    def apply_wire_batch(self, items: list) -> None:
+        """One REPLBATCH frame — a pusher-side group-encoded run of
+        consecutive encodable ops (replica/wire.py).  Delivery
+        bookkeeping runs ONCE for the whole run: any pending per-frame
+        buffer flushes first (stream order), dup/gap checks compare the
+        batch header to the cursor, the decoded ColumnarBatch lands
+        through `Node.merge_stream_batch`, and the watermark advances
+        over the batch only after landing (watermark-after-land).  A
+        batch that overlaps the cursor (reconnect redelivery) re-merges
+        whole — every op in it is an idempotent merge by the same
+        argument the redelivery note in the module docstring makes.
+
+        A payload that fails to decode is LOUD: the link tears down
+        (CstError), the peer meta stops advertising CAP_BATCH_STREAM, so
+        the redelivery window arrives as ordinary per-frame frames —
+        demotion, never silent desync."""
+        meta = self.meta
+        if len(items) < 6:
+            raise CstError(f"{meta.addr}: malformed replbatch frame")
+        origin = as_int(items[1])
+        first_prev = as_int(items[2])
+        last = as_int(items[3])
+        n = as_int(items[4])
+        payload = as_bytes(items[5])
+        if n < 1 or last <= first_prev:
+            raise CstError(f"{meta.addr}: bad replbatch header")
+        if self._frames:
+            self.flush()  # stream order: buffered frames land first
+        cursor = self.cursor
+        if last <= cursor:
+            return  # duplicate batch (reconnect overlap) — idempotent skip
+        if first_prev > cursor:
+            raise ReplicateCommandsLost(
+                f"{meta.addr}: gap {cursor} -> {first_prev}")
+        node = self.node
+        if node.reset_epoch != self._epoch:
+            # a state wipe landed since this stream was installed: these
+            # ops describe pre-wipe state (see flush)
+            self._pending_beacon = 0
+            return
+        from . import wire
+        try:
+            wb = wire.decode_wire_batch(payload, node.ks, origin,
+                                        first_prev)
+            if wb.n_frames != n:
+                raise wire.WireFormatError(
+                    f"header says {n} frames, payload holds {wb.n_frames}")
+        except wire.WireFormatError as e:
+            st = node.stats
+            st.repl_wire_demotions += 1
+            meta.batch_wire_off = True
+            log.error(
+                "replbatch from %s is malformed (%s); demoting this "
+                "peer's stream to per-frame delivery and resyncing from "
+                "the landed watermark", meta.addr, e)
+            raise CstError(
+                f"{meta.addr}: malformed replbatch payload") from None
+        st = node.stats
+        st.cmds_replicated += n
+        st.repl_wire_batches_in += 1
+        st.repl_wire_batch_frames_in += n
+        node.hlc.observe(last)
+        node.merge_stream_batch(wb, n)
+        self.cursor = last
+        self._advance(last, wake=True)
 
     def observe_beacon(self, beacon: int) -> None:
         """REPLACK drained-stream beacon: may only advance the pull
@@ -426,7 +510,7 @@ class CoalescingApplier:
                 # per-frame path's behavior for malformed frames
                 node.stats.repl_apply_barriers += 1
                 node.apply_replicated(name, r[3][5:], r[1], r[2])
-        self._advance(self.cursor)
+        self._advance(self.cursor, wake=frames - len(failures) >= 2)
 
     def _barrier(self, name: bytes, items: list, origin: int,
                  uuid: int) -> None:
@@ -453,10 +537,19 @@ class CoalescingApplier:
         if not self._frames:
             self._advance(uuid)
 
-    def _advance(self, uuid: int) -> None:
+    def _advance(self, uuid: int, wake: bool = False) -> None:
+        """Watermark-after-land.  `wake`: this land covered a genuine
+        BATCH (a multi-frame flush or a wire batch) — wake the push loop
+        to REPLACK it now, one ack per covering batch.  Single-frame
+        lands (barriers, trickle traffic) do NOT wake: their acks ride
+        the heartbeat exactly as before, because a per-land wake there
+        IS an ack per frame — the cadence this satellite removes — and
+        each wake costs every link a scheduler round trip."""
         beacon, self._pending_beacon = self._pending_beacon, 0
         w = max(uuid, beacon)
         if w > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = w
+            if wake:
+                self.node.events.trigger(EVENT_PULL_LANDED)
         if beacon > self.cursor:
             self.cursor = beacon

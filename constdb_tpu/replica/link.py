@@ -15,6 +15,14 @@ Wire protocol (RESP frames on one TCP stream, symmetric after handshake):
     *[fullsync, size, repl_last_uuid]  + `size` raw snapshot bytes
     *[partsync]
     *[replicate, origin_nodeid, prev_uuid, uuid, cmd, args...]
+    *[replbatch, origin_nodeid, first_prev_uuid, last_uuid, n, payload]
+      — a RUN of n consecutive encodable ops, group-encoded once into a
+      columnar payload (replica/wire.py); only sent to peers that
+      advertised CAP_BATCH_STREAM, under the CONSTDB_WIRE_BATCH /
+      CONSTDB_WIRE_LATENCY_MS dual bound.  Non-encodable ops
+      (membership, key-scoped sweeps, malformed) break runs and ship as
+      ordinary per-frame barriers; CONSTDB_WIRE_BATCH=1 degenerates to
+      the byte-exact per-frame stream, as does any peer without the bit.
     *[replack, uuid, now_ms]
   delta anti-entropy (both peers advertise CAP_DELTA_SYNC; pusher-driven):
     *[digest, token, 0, fanout, leaves, rollup]       per-shard rollups
@@ -62,10 +70,13 @@ import numpy as np
 
 from ..errors import CstError, ReplicateCommandsLost
 from ..persist.snapshot import SectionDemux, batch_chunks
-from ..resp.codec import RespParser, encode_msg, make_parser
+from ..resp.codec import RespParser, encode_into, encode_msg, make_parser
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
-from ..server.events import EVENT_REPLICA_ACKED, EVENT_REPLICATED
+from ..server.commands import COLUMNAR_ENCODERS
+from ..server.events import (EVENT_PULL_LANDED, EVENT_REPLICA_ACKED,
+                             EVENT_REPLICATED)
 from ..utils.hlc import now_ms
+from . import wire
 from .manager import ReplicaMeta
 
 if TYPE_CHECKING:
@@ -77,6 +88,7 @@ SYNC = b"sync"
 FULLSYNC = b"fullsync"
 PARTSYNC = b"partsync"
 REPLICATE = b"replicate"
+REPLBATCH = b"replbatch"
 REPLACK = b"replack"
 DIGEST = b"digest"
 DIGESTACK = b"digestack"
@@ -89,22 +101,75 @@ DELTASYNC = b"deltasync"
 # meshes, recreating exactly the resurrection scenario it prevents).
 CAP_FULLSYNC_RESET = 1   # honors FULLSYNC's 4th (state-wipe) field
 CAP_DELTA_SYNC = 2       # answers digest frames / applies deltasync
-MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC
+CAP_BATCH_STREAM = 4     # decodes REPLBATCH columnar run frames
+MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC | CAP_BATCH_STREAM
 
 
-def my_caps(app) -> int:
+def my_caps(app, meta=None) -> int:
     """The capability bitmask this node advertises in SYNC handshakes.
     CONSTDB_DELTA_SYNC=0 removes CAP_DELTA_SYNC so the kill switch
     disables BOTH legs: we never initiate deltas (push-loop gate) and
     conforming peers never ask us digest questions (no capability), so
-    the node pays no responder-side digest folds either."""
+    the node pays no responder-side digest folds either.
+    CAP_BATCH_STREAM follows the same discipline — CONSTDB_WIRE_BATCH=1
+    stops both sending batches (push-loop gate) and inviting them — and
+    is additionally withheld when this node cannot or must not receive
+    them: a shard-per-core receiver applies per-key inside the workers
+    (server/serve_shards.py ShardApplier), CONSTDB_APPLY_BATCH=1 pins
+    the whole replication intake to the exact per-frame apply path (a
+    REPLBATCH would route through the columnar merge engine the pin
+    exists to bypass), and a peer that once shipped a malformed payload
+    is pinned to per-frame delivery (`meta.batch_wire_off`,
+    replica/coalesce.py apply_wire_batch)."""
     caps = MY_CAPS
     if not getattr(app, "delta_sync", True):
         caps &= ~CAP_DELTA_SYNC
+    if wire_batch_limit(app) <= 1 or apply_batch_limit(app) <= 1 or \
+            getattr(app, "serve_plane", None) is not None or \
+            (meta is not None and getattr(meta, "batch_wire_off", False)):
+        caps &= ~CAP_BATCH_STREAM
     return caps
 
 
+def apply_batch_limit(app) -> int:
+    """The node's replication-apply coalescing bound (<= 1 = the exact
+    per-frame apply path, replica/coalesce.py)."""
+    ab = getattr(app, "apply_batch", None)
+    if ab is None:
+        from ..conf import env_int
+        return env_int("CONSTDB_APPLY_BATCH", 512)
+    return ab
+
+
+def wire_batch_limit(app) -> int:
+    """Max frames per REPLBATCH run (1 = the exact per-frame stream)."""
+    wb = getattr(app, "wire_batch", None)
+    if wb is None:
+        from ..conf import env_int
+        return env_int("CONSTDB_WIRE_BATCH", 512)
+    return wb
+
+
+def wire_latency_of(app) -> float:
+    """Seconds a drained frame may sit in the push loop's aggregated
+    wire buffer before a socket flush is forced (idle cycles always
+    flush at their end, so a lone write never waits this long)."""
+    wl = getattr(app, "wire_latency", None)
+    if wl is None:
+        from ..conf import env_float
+        return env_float("CONSTDB_WIRE_LATENCY_MS", 5.0) / 1000.0
+    return wl
+
+
 _READ_CHUNK = 1 << 16
+# push-loop wire buffer: flush to the socket at this many buffered bytes
+# (backpressure bound; the latency bound is CONSTDB_WIRE_LATENCY_MS)
+_WIRE_FLUSH_BYTES = 1 << 18
+# per-frame drain unit (the legacy 64-frame drain cadence)
+_RUN_FRAMES = 64
+# runs shorter than this ship per-frame: a 1-op REPLBATCH buys no batch
+# bookkeeping and costs header + payload framing over the plain frame
+_MIN_WIRE_RUN = 2
 
 
 class ReplicaLink:
@@ -176,6 +241,72 @@ class ReplicaLink:
         st.repl_out_bytes += len(data)
         writer.write(data)
 
+    def _flush_wire(self, writer, out: bytearray) -> bytearray:
+        """One aggregated steady-state stream write — a drain cycle's
+        frames in one transport call instead of one per frame (the PR 5
+        reply-buffer swap: ownership moves to the transport, which
+        copies only what it cannot send immediately).  Counted into
+        `repl_wire_bytes_out` so the bench's wire-bytes-per-op compare
+        sees ONLY stream frames, not snapshots or acks."""
+        self.node.stats.repl_wire_bytes_out += len(out)
+        self._write(writer, out)
+        return bytearray()
+
+    def _encode_frames(self, out: bytearray, run: list) -> None:
+        """The per-frame REPLICATE encoding, byte-exact with the pre-PR
+        stream — the ONE definition both the legacy-peer branch and the
+        demoted-run fallback share (the byte-exactness pin in
+        tests/test_wire_batch.py covers every caller through it)."""
+        nid = self.node.node_id
+        for e in run:
+            encode_into(out, Arr([
+                Bulk(REPLICATE), Int(nid), Int(e.prev_uuid), Int(e.uuid),
+                Bulk(e.name), *e.args]))
+
+    def _encode_wire_run(self, out: bytearray, run: list,
+                         cursor: int) -> int:
+        """Encode one drained run into `out`: maximal sub-runs of
+        consecutive encodable ops become REPLBATCH frames
+        (replica/wire.py), everything else — barriers, sub-runs below
+        _MIN_WIRE_RUN, runs the codec demotes — ships as the exact
+        per-frame REPLICATE frames.  Returns the advanced cursor."""
+        node = self.node
+        nid = node.node_id
+        st = node.stats
+        enc_has = COLUMNAR_ENCODERS.__contains__
+        i, n = 0, len(run)
+        while i < n:
+            j = i
+            while j < n and enc_has(run[j].name):
+                j += 1
+            if j - i >= _MIN_WIRE_RUN:
+                sub = run[i:j]
+                payload = wire.build_wire_batch(sub, nid)
+                if payload is not None:
+                    encode_into(out, Arr([
+                        Bulk(REPLBATCH), Int(nid), Int(sub[0].prev_uuid),
+                        Int(sub[-1].uuid), Int(len(sub)),
+                        Bulk(payload)]))
+                    st.repl_wire_batches_out += 1
+                    st.repl_wire_batch_frames_out += len(sub)
+                    i = j
+                    cursor = sub[-1].uuid
+                    continue
+                # demotion must be LOUD: count it and log it — a codec
+                # that silently lags the encoder table would erase the
+                # whole batching win without tripping a single test
+                x = st.extra
+                x["repl_wire_encode_demotions"] = \
+                    x.get("repl_wire_encode_demotions", 0) + 1
+                log.warning(
+                    "push %s: wire codec demoted a run of %d encodable "
+                    "ops to per-frame delivery", self.meta.addr, j - i)
+            stop = j if j > i else i + 1
+            self._encode_frames(out, run[i:stop])
+            cursor = run[stop - 1].uuid
+            i = stop
+        return cursor
+
     async def _close_conn(self) -> None:
         w, self._writer = self._writer, None
         if w is not None:
@@ -210,7 +341,8 @@ class ReplicaLink:
                 Bulk(SYNC), Int(0), Int(self.node.node_id),
                 Bulk(self.node.alias.encode()),
                 Bulk(self.app.advertised_addr.encode()),
-                Int(self.meta.uuid_he_sent), Int(my_caps(self.app))])))
+                Int(self.meta.uuid_he_sent),
+                Int(my_caps(self.app, self.meta))])))
             await writer.drain()
             parser = make_parser()
             msg = await _read_msg(reader, parser,
@@ -324,7 +456,14 @@ class ReplicaLink:
         observability while this connection is still the live one."""
         node = self.node
         meta = self.meta
-        consumer = node.events.new_consumer(EVENT_REPLICATED)
+        # EVENT_PULL_LANDED wakes this loop when OUR pull side lands a
+        # batch of the peer's stream, so the REPLACK below goes out once
+        # per covering batch instead of a heartbeat later
+        consumer = node.events.new_consumer(
+            EVENT_REPLICATED | EVENT_PULL_LANDED)
+        wire_batch = wire_batch_limit(self.app)
+        wire_latency = wire_latency_of(self.app)
+        loop = asyncio.get_running_loop()
         try:
             synced = False  # peer_resume not yet honored
             cursor = 0
@@ -396,12 +535,35 @@ class ReplicaLink:
                     synced = True
                     meta.needs_full = False
 
-                sent = 0
-                while (e := node.repl_log.next_after(cursor)) is not None:
-                    if e.prev_uuid > cursor:
+                # Drain the log in RUNS, frames aggregated into ONE wire
+                # buffer per socket flush (the PR 5 reply-buffer swap, on
+                # the push side) under a dual bound: _WIRE_FLUSH_BYTES
+                # (backpressure) and the wire latency (bytes keep moving
+                # through a long catch-up drain).  An idle cycle always
+                # flushes at its end, so a lone write ships immediately
+                # with the exact per-frame latency.  Runs of consecutive
+                # encodable ops group-encode into REPLBATCH frames when
+                # the peer can decode them; everything else — legacy
+                # peers, CONSTDB_WIRE_BATCH=1, barriers, demoted runs —
+                # is the byte-exact per-frame stream.
+                batching = wire_batch > 1 and \
+                    bool(self._peer_caps & CAP_BATCH_STREAM)
+                out = bytearray()
+                t_flush = loop.time()
+                while True:
+                    # byte-capped runs: the flush bound below must get a
+                    # chance to engage BEFORE a backlog of huge values
+                    # is encoded into one frame/buffer (a lone oversized
+                    # entry still ships whole, as per-frame always did)
+                    run = node.repl_log.run_after(
+                        cursor, wire_batch if batching else _RUN_FRAMES,
+                        _WIRE_FLUSH_BYTES)
+                    if not run:
+                        break
+                    if run[0].prev_uuid > cursor:
                         # the ring evicted past our cursor while this loop
-                        # yielded (the drain below): streaming `e` would
-                        # hand the peer a gap, blow up its pull loop
+                        # yielded (the drain below): streaming the run
+                        # would hand the peer a gap, blow up its pull loop
                         # (ReplicateCommandsLost) and force a teardown +
                         # redial + snapshot over a FRESH connection.
                         # Recover IN PLACE instead: stop here and let the
@@ -415,13 +577,18 @@ class ReplicaLink:
                             "push %s: repl_log evicted past send cursor "
                             "mid-stream; resyncing in place", meta.addr)
                         break
-                    self._write(writer, encode_msg(Arr([
-                        Bulk(REPLICATE), Int(node.node_id), Int(e.prev_uuid),
-                        Int(e.uuid), Bulk(e.name), *e.args])))
-                    cursor = e.uuid
-                    sent += 1
-                    if sent % 64 == 0:
+                    if batching:
+                        cursor = self._encode_wire_run(out, run, cursor)
+                    else:
+                        self._encode_frames(out, run)
+                        cursor = run[-1].uuid
+                    if len(out) >= _WIRE_FLUSH_BYTES or \
+                            loop.time() - t_flush >= wire_latency:
+                        out = self._flush_wire(writer, out)
                         await writer.drain()  # backpressure + yield
+                        t_flush = loop.time()
+                if out:
+                    out = self._flush_wire(writer, out)
                 if self._writer is writer:
                     meta.uuid_i_sent = cursor  # observability (INFO)
                 if not node.repl_log.can_resume_from(cursor):
@@ -772,6 +939,14 @@ class ReplicaLink:
             kind = as_bytes(items[0]).lower()
             if kind == REPLICATE:
                 await applier.aapply(items)
+            elif kind == REPLBATCH:
+                # a group-encoded run: per-batch intake (dup/gap/cursor/
+                # beacon once), decoded batch straight into the merge
+                # engine (replica/coalesce.py apply_wire_batch).  Only
+                # negotiated streams carry these — a ShardApplier (which
+                # never advertises CAP_BATCH_STREAM) raises the protocol
+                # error that tears this link down.
+                await applier.aabatch(items)
             elif kind == REPLACK:
                 uuid = as_int(items[1])
                 if uuid > self.meta.uuid_i_acked:

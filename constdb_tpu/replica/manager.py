@@ -59,6 +59,13 @@ class ReplicaMeta:
     # fullsync reset flag, the peer wipes keyspace + repl_log before the
     # merge) so the peer's stale keys cannot resurrect mesh-wide.
     needs_full: bool = field(default=False, compare=False)
+    # runtime flag (not replicated): this peer once sent us a REPLBATCH
+    # payload we could not decode (replica/coalesce.py apply_wire_batch)
+    # — stop advertising CAP_BATCH_STREAM to it, so every re-handshake
+    # delivers the redelivery window (and everything after) as ordinary
+    # per-frame REPLICATE frames.  Sticky for the process lifetime: a
+    # peer that ships one malformed batch will ship another.
+    batch_wire_off: bool = field(default=False, compare=False)
 
     @property
     def alive(self) -> bool:

@@ -1083,6 +1083,15 @@ def ttl_command(node, ctx, args):
 # exact per-key path as a coalescer BARRIER.  An encoder raising
 # NotColumnar or any CstError makes the coalescer fall back to that
 # same per-key path, so error behavior is byte-identical too.
+#
+# This table is ALSO the batch wire protocol's vocabulary: the push
+# loop group-encodes runs of consecutive entries whose names appear
+# here into REPLBATCH frames (replica/wire.py), and the wire codec
+# re-derives every envelope column from the row patterns these
+# encoders emit.  A new encoder whose rows fall outside those patterns
+# still replicates correctly — the codec demotes its runs to per-frame
+# frames, loudly — but extend replica/wire.py alongside it to keep the
+# batched path's coverage.
 # ====================================================================
 
 class NotColumnar(Exception):

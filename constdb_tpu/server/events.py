@@ -16,6 +16,9 @@ from typing import Optional
 EVENT_REPLICATED = 1       # a new entry hit the repl_log
 EVENT_REPLICA_ACKED = 2    # a peer advanced an ack watermark
 EVENT_DELETED = 4          # a key-level tombstone was recorded
+EVENT_PULL_LANDED = 8      # a peer-stream batch landed (pull watermark
+#                            advanced): push loops wake to REPLACK once
+#                            per covering batch instead of per heartbeat
 
 
 class EventsConsumer:

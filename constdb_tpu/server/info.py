@@ -93,6 +93,17 @@ def _section_stats(node, out):
     out.append(("repl_frames_coalesced", st.repl_frames_coalesced))
     out.append(("repl_coalesce_flushes", st.repl_coalesce_flushes))
     out.append(("repl_apply_barriers", st.repl_apply_barriers))
+    # batch wire protocol (replica/wire.py REPLBATCH): aggregated
+    # steady-state stream bytes out, group-encoded runs sent/received
+    # (with the op frames they covered), and receiver-side payload
+    # decode failures — each one pins that peer to per-frame delivery
+    out.append(("repl_wire_bytes_out", st.repl_wire_bytes_out))
+    out.append(("repl_wire_batches_out", st.repl_wire_batches_out))
+    out.append(("repl_wire_batch_frames_out",
+                st.repl_wire_batch_frames_out))
+    out.append(("repl_wire_batches_in", st.repl_wire_batches_in))
+    out.append(("repl_wire_batch_frames_in", st.repl_wire_batch_frames_in))
+    out.append(("repl_wire_demotions", st.repl_wire_demotions))
     # anti-entropy resyncs this node pushed: digest-negotiated deltas
     # vs full snapshots (replica/link.py; the demotion counter rides
     # `extra` as repl_delta_demotions, with shard ids in the log)

@@ -50,6 +50,8 @@ class ServerApp:
                  ingest_shard_min_bytes: int = 64 << 20,
                  apply_batch: Optional[int] = None,
                  apply_latency: Optional[float] = None,
+                 wire_batch: Optional[int] = None,
+                 wire_latency: Optional[float] = None,
                  serve_batch: Optional[int] = None,
                  serve_shards: Optional[int] = None,
                  delta_sync: Optional[bool] = None,
@@ -97,6 +99,18 @@ class ServerApp:
         # node to the exact per-frame path.
         self.apply_batch = apply_batch
         self.apply_latency = apply_latency
+        # batch wire protocol bounds for the push path (replica/link.py
+        # + replica/wire.py): ops per REPLBATCH run and the aggregated
+        # wire buffer's flush latency.  None = the CONSTDB_WIRE_BATCH /
+        # CONSTDB_WIRE_LATENCY_MS env defaults; wire_batch=1 pins this
+        # node to the byte-exact per-frame stream in BOTH directions
+        # (it stops advertising CAP_BATCH_STREAM too — my_caps).
+        from ..conf import env_float as _env_float, env_int as _env_int
+        self.wire_batch = _env_int("CONSTDB_WIRE_BATCH", 512) \
+            if wire_batch is None else wire_batch
+        self.wire_latency = \
+            (_env_float("CONSTDB_WIRE_LATENCY_MS", 5.0) / 1000.0) \
+            if wire_latency is None else wire_latency
         # client-path coalescing (server/serve.py): max pipelined
         # commands planned into one columnar micro-merge.  None = the
         # CONSTDB_SERVE_BATCH env default; <= 1 pins every connection to
@@ -479,7 +493,7 @@ class ServerApp:
         writer.write(encode_msg_arr([
             Bulk(SYNC), Int(1), Int(node.node_id), Bulk(node.alias.encode()),
             Bulk(self.advertised_addr.encode()), Int(meta.uuid_he_sent),
-            Int(my_caps(self))]))
+            Int(my_caps(self, meta))]))
         link = meta.link if isinstance(meta.link, ReplicaLink) else \
             ReplicaLink(self, meta)
         link.adopt(reader, writer, parser, peer_resume, peer_caps=peer_caps)

@@ -42,6 +42,18 @@ class NodeStats:
     repl_frames_coalesced: int = 0
     repl_coalesce_flushes: int = 0
     repl_apply_barriers: int = 0
+    # columnar wire protocol (replica/wire.py REPLBATCH): steady-state
+    # stream bytes written by the push loop's aggregated flushes (frames
+    # only — snapshots/acks ride repl_out_bytes), batch frames
+    # sent/received with the op runs they covered, and receiver-side
+    # payload decode failures (each one demotes that peer to per-frame
+    # delivery, loudly)
+    repl_wire_bytes_out: int = 0
+    repl_wire_batches_out: int = 0
+    repl_wire_batch_frames_out: int = 0
+    repl_wire_batches_in: int = 0
+    repl_wire_batch_frames_in: int = 0
+    repl_wire_demotions: int = 0
     # anti-entropy resyncs SENT by this node's push legs
     # (replica/link.py): digest-negotiated deltas vs full snapshots,
     # the delta payload bytes that replaced them, and digest rounds run

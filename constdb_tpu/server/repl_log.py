@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
 from collections import deque
+from itertools import islice
 from typing import Optional
 
 from ..resp.message import Arr, Bulk, Msg, msg_size
@@ -120,6 +121,42 @@ class ReplLog:
         """The oldest entry with uuid > `uuid` (the next frame to push)."""
         i = bisect_right(self._uuids, uuid)
         return self._entries[i] if i < len(self._entries) else None
+
+    def run_after(self, uuid: int, max_n: int,
+                  max_bytes: Optional[int] = None) -> list:
+        """The RUN of up to `max_n` consecutive entries after `uuid` —
+        the batch wire protocol's drain unit (replica/link.py push
+        loop).  Equivalent to `max_n` chained `next_after` calls, in one
+        O(i + max_n) slice instead of `max_n` bisects; entries in a run
+        are gap-free by construction (the ring only evicts from the
+        left, and this snapshot is taken synchronously).  `max_bytes`
+        additionally cuts the run once the cumulative entry sizes pass
+        it (always keeping at least one entry) so a backlog of huge
+        values cannot balloon one wire frame — the transport
+        backpressure bound the per-frame path got from its 64-frame
+        drain cadence."""
+        entries = self._entries
+        n = len(entries)
+        i = bisect_right(self._uuids, uuid)
+        if i >= n:
+            return []
+        # rotate instead of islice-from-zero: a steady-state cursor sits
+        # at the TAIL of the ring, where islice would walk the whole
+        # deque per call; rotation costs O(min(i, n - i)) — cheap at
+        # both ends, where every real cursor lives
+        entries.rotate(-i)
+        # cap at n - i: the rotation parks the first i entries at the
+        # BACK, and an uncapped islice would wrap onto them
+        run = list(islice(entries, 0, min(max_n, n - i)))
+        entries.rotate(i)
+        if max_bytes is not None:
+            total = 0
+            for k, e in enumerate(run):
+                total += e.size
+                if total > max_bytes and k:
+                    del run[k:]
+                    break
+        return run
 
     def at(self, uuid: int) -> Optional[ReplEntry]:
         """Exact-uuid lookup (REPLLOG AT — reference server.rs:318-350)."""
@@ -247,6 +284,37 @@ class MergedReplLog:
         if best is not None and not self._visible(best.uuid):
             return None
         return best
+
+    def run_after(self, uuid: int, max_n: int,
+                  max_bytes: Optional[int] = None) -> list:
+        """The maximal SINGLE-SEGMENT run after `uuid` that preserves
+        the merged HLC order: start at the globally smallest visible
+        uuid > `uuid`, extend within that entry's segment while every
+        further entry stays below BOTH the floor and every other
+        segment's next pending uuid.  Concatenated runs therefore
+        replay to exactly the per-op merged stream (`next_after`
+        repeated) — the property the batch wire protocol's run tests
+        pin — while shard-per-core serving feeds whole per-shard runs
+        to the batch path without re-sorting per op."""
+        cands = []
+        for s in self.segments:
+            e = s.next_after(uuid)
+            if e is not None:
+                cands.append((e.uuid, s))
+        if not cands:
+            return []
+        cands.sort(key=lambda c: c[0])
+        best_seg = cands[0][1]
+        bound = cands[1][0] if len(cands) > 1 else None
+        f = self.floor()
+        if f is not None:
+            bound = f if bound is None else min(bound, f)
+        run = best_seg.run_after(uuid, max_n, max_bytes)
+        if bound is not None:
+            for k, e in enumerate(run):
+                if e.uuid >= bound:
+                    return run[:k]
+        return run
 
     def at(self, uuid: int) -> Optional[ReplEntry]:
         for s in self.segments:

@@ -42,13 +42,13 @@ import logging
 import time
 from typing import Optional
 
-from ..errors import ReplicateCommandsLost
+from ..errors import CstError, ReplicateCommandsLost
 from ..resp.codec import encode_into
 from ..resp.message import Arr, Bulk, NoReply, as_bytes, as_int
 from ..store.sharded_keyspace import MAX_SHARDS, shard_of
 from .commands import (CMD_CTRL, CMD_REPL_ONLY, COMMANDS,
                        STATE_FREE_BARRIERS, shard_routable)
-from .events import EVENT_DELETED, EVENT_REPLICATED
+from .events import EVENT_DELETED, EVENT_PULL_LANDED, EVENT_REPLICATED
 from .repl_log import MergedReplLog
 
 log = logging.getLogger(__name__)
@@ -481,6 +481,16 @@ class ShardApplier:
                  self._now() - self._first_ts >= self.max_latency):
             await self.aflush()
 
+    async def aabatch(self, items: list) -> None:
+        """REPLBATCH on a sharded receiver is a protocol violation: this
+        node never advertises CAP_BATCH_STREAM (replica/link.py my_caps)
+        because frames apply per-key inside the worker owning their
+        shard — there is no single keyspace for a decoded batch to merge
+        into.  A peer that sends one anyway loses the connection loudly
+        and redelivers per-frame from the landed watermark."""
+        raise CstError(f"{self.meta.addr}: replbatch frame on a sharded "
+                       "receiver (capability was never advertised)")
+
     def observe_beacon(self, beacon: int) -> None:
         if self._frames:
             if beacon > max(self.cursor, self._pending_beacon):
@@ -525,12 +535,17 @@ class ShardApplier:
                 node.events.trigger(EVENT_DELETED)
             self.plane._fold_stats(s, stats)
         node.hlc.observe(self.cursor)
-        self._advance(self.cursor)
+        self._advance(self.cursor, wake=frames >= 2)
 
-    def _advance(self, uuid: int) -> None:
+    def _advance(self, uuid: int, wake: bool = False) -> None:
+        # `wake` discipline mirrors replica/coalesce.py _advance: only a
+        # genuine multi-frame land wakes push loops to REPLACK it now;
+        # trickle lands keep their heartbeat-cadence acks
         beacon, self._pending_beacon = self._pending_beacon, 0
         w = max(uuid, beacon)
         if w > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = w
+            if wake:
+                self.node.events.trigger(EVENT_PULL_LANDED)
         if beacon > self.cursor:
             self.cursor = beacon

@@ -68,6 +68,31 @@ print("resync smoke verified:",
 EOF
 
 echo
+echo "== wire smoke (bench --mode stream --wire) =="
+# tiny oracle-verified run of the batch wire protocol over a real
+# socket pair: REPLBATCH legs vs the per-frame wire on the same frame
+# log, both receivers byte-identical to the per-frame CPU oracle, the
+# 3-node mesh differential converged, and the columnar payload actually
+# paying for itself on the wire (the differential suite proper runs
+# inside tier-1 — tests/test_wire_batch.py / test_repl_capabilities.py)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_FRAMES=5000 CONSTDB_BENCH_WIRE_REPS=1 \
+    timeout -k 10 300 python bench.py --mode stream --wire \
+    > /tmp/_ci_wire.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_wire.json"))
+assert out["verified"], "wire smoke failed oracle verification"
+assert out["wire_bytes_ratio"] >= 2.0, \
+    f"columnar wire stopped paying: {out['wire_bytes_ratio']}x bytes"
+assert out["mesh_differential"]["converged"], "wire mesh diverged"
+assert out["legs"][0]["wire_demotions"] == 0, "wire codec demoted"
+print("wire smoke verified:",
+      f"{out['speedup_vs_per_frame_wire']}x frames/s,",
+      f"{out['wire_bytes_ratio']}x wire bytes,",
+      f"batch leg {out['legs'][0]['fps']} fps")
+EOF
+
+echo
 echo "== resident smoke (pallas-interpret snapshot + stream) =="
 # tiny oracle-verified runs of the device-resident steady path with the
 # Pallas kernels forced through the interpreter: a kernel that drifts

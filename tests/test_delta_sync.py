@@ -465,6 +465,10 @@ def test_midstream_falloff_resyncs_by_delta(tmp_path):
     gapped frame — and the loopback puller must converge."""
     async def main():
         node, app, link = _mk_link(tmp_path, cap=100_000)
+        # flush+drain per 64-frame run (the pre-wire-buffer cadence):
+        # this test's eviction is rigged to fire at drain #1, which must
+        # land MID-backlog for the horizon to pass the send cursor
+        app.wire_latency = 0.0
         for i in range(100):
             _log_write(node, i)
         link._peer_caps = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC

@@ -194,6 +194,158 @@ def test_caps_exchanged_end_to_end(tmp_path):
     asyncio.run(main())
 
 
+# ------------------------------------------- batch wire (CAP_BATCH_STREAM)
+
+
+def test_mixed_version_peer_gets_per_frame_stream_both_directions(tmp_path):
+    """Mixed-version pin for CAP_BATCH_STREAM: one node pinned to the
+    per-frame wire (wire_batch=1 — the pre-PR build's behavior, and the
+    CONSTDB_WIRE_BATCH=1 degenerate) meshes with a capable node.  The
+    capable node must never send a REPLBATCH frame (the peer did not
+    advertise the bit) and the pinned node never does either (kill
+    switch disables both legs) — the stream is per-frame in BOTH
+    directions, and the mesh still converges.  The byte-exactness of
+    that per-frame stream is pinned at the unit level in
+    tests/test_wire_batch.py (test_legacy_peer_stream_is_byte_exact)."""
+    from cluster_util import Client, close_cluster, converge, make_cluster
+    from constdb_tpu.replica.link import CAP_BATCH_STREAM
+
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        apps[1].wire_batch = 1  # pre-handshake: the bit is never offered
+        try:
+            c0 = await Client().connect(apps[0].advertised_addr)
+            c1 = await Client().connect(apps[1].advertised_addr)
+            await c0.cmd("meet", apps[1].advertised_addr)
+            for i in range(120):
+                await c0.cmd("set", f"a{i}", "x" * 24)
+                await c1.cmd("sadd", f"s{i % 7}", f"m{i}")
+            await converge(apps, timeout=30.0)
+            for app in apps:
+                st = app.node.stats
+                assert st.repl_wire_batches_out == 0, \
+                    "a REPLBATCH frame reached a per-frame stream"
+                assert st.repl_wire_batches_in == 0
+                assert st.repl_wire_demotions == 0
+            # the capable node really did see the bit withheld
+            links = [m.link for m in apps[0].node.replicas.live_peers()
+                     if m.link is not None and m.link.connected]
+            assert links and all(
+                not (lk._peer_caps & CAP_BATCH_STREAM) for lk in links)
+            await c0.close()
+            await c1.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
+
+
+def test_capable_mesh_actually_ships_batches(tmp_path):
+    """Control for the mixed-version pin: two capable nodes DO ride the
+    batch wire under a pipelined write burst, and converge."""
+    from cluster_util import Client, close_cluster, converge, make_cluster
+    from constdb_tpu.resp.codec import encode_msg
+
+    async def read_replies(c: "Client", n: int) -> None:
+        got = 0
+        while got < n:
+            if c.parser.next_msg() is not None:
+                got += 1
+                continue
+            data = await asyncio.wait_for(c.reader.read(1 << 16), 10.0)
+            assert data, "EOF mid-pipeline"
+            c.parser.feed(data)
+
+    async def main():
+        apps = await make_cluster(2, str(tmp_path))
+        try:
+            c0 = await Client().connect(apps[0].advertised_addr)
+            await c0.cmd("meet", apps[1].advertised_addr)
+            # pipelined burst: the repl_log backlog forms runs
+            for chunk in range(6):
+                for i in range(50):
+                    c0.writer.write(encode_msg(Arr([
+                        Bulk(b"set"), Bulk(b"k%d-%d" % (chunk, i)),
+                        Bulk(b"v" * 16)])))
+                await c0.writer.drain()
+                await read_replies(c0, 50)
+            await converge(apps, timeout=30.0)
+            assert apps[0].node.stats.repl_wire_batches_out > 0, \
+                "no REPLBATCH frames on a capable mesh under load"
+            assert apps[1].node.stats.repl_wire_batch_frames_in > 0
+            assert apps[1].node.stats.repl_wire_demotions == 0
+            await c0.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
+
+
+def test_mesh_differential_batch_vs_perframe_node(tmp_path):
+    """3-node mesh differential: two batch-wire nodes + one per-frame
+    node under mixed write/DEL/membership traffic converge to the
+    byte-identical canonical export (the BENCH_r14 acceptance's mesh
+    leg, deterministic form)."""
+    import random
+    from cluster_util import Client, close_cluster, converge, \
+        full_mesh, make_cluster
+
+    async def main():
+        apps = await make_cluster(3, str(tmp_path))
+        apps[2].wire_batch = 1  # the per-frame node
+        try:
+            clients = [await Client().connect(a.advertised_addr)
+                       for a in apps]
+            await clients[0].cmd("meet", apps[1].advertised_addr)
+            await clients[0].cmd("meet", apps[2].advertised_addr)
+            await full_mesh(apps, timeout=30.0)
+            rng = random.Random(23)
+            for i in range(240):
+                c = clients[i % 3]
+                r = rng.random()
+                k = f"k{rng.randrange(40)}"
+                if r < 0.35:
+                    await c.cmd("set", "r" + k, f"v{i}")
+                elif r < 0.55:
+                    await c.cmd("incrby", "c" + k, rng.randrange(1, 9))
+                elif r < 0.75:
+                    await c.cmd("sadd", "s" + k, f"m{rng.randrange(12)}")
+                elif r < 0.85:
+                    await c.cmd("hset", "h" + k, "f1", f"v{i}")
+                elif r < 0.95:
+                    await c.cmd("del", "r" + k)
+                else:
+                    # membership chatter exercises the barrier plane
+                    await c.cmd("replicas")
+            # a pipelined burst backs the repl_log up so runs actually
+            # form (awaited round-trips drain the log one op at a time)
+            from constdb_tpu.resp.codec import encode_msg
+            c0 = clients[0]
+            for i in range(200):
+                c0.writer.write(encode_msg(Arr([
+                    Bulk(b"set"), Bulk(b"burst%d" % i), Bulk(b"v" * 12)])))
+            await c0.writer.drain()
+            got = 0
+            while got < 200:
+                if c0.parser.next_msg() is not None:
+                    got += 1
+                    continue
+                data = await asyncio.wait_for(c0.reader.read(1 << 16), 10.0)
+                assert data, "EOF mid-burst"
+                c0.parser.feed(data)
+            await converge(apps, timeout=45.0)
+            # the batch wire actually carried the capable pairs' stream
+            assert sum(a.node.stats.repl_wire_batches_out
+                       for a in apps[:2]) > 0
+            assert apps[2].node.stats.repl_wire_batches_out == 0
+            assert apps[2].node.stats.repl_wire_batches_in == 0
+            for a in apps:
+                assert a.node.stats.repl_wire_demotions == 0
+            for c in clients:
+                await c.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
+
+
 # --------------------------------------------------- watermark adoption
 
 def test_merge_records_watermarks_opt_in():
