@@ -2049,14 +2049,19 @@ def resync_main(args) -> None:
                                    / max(row["delta_wall_s"], 1e-9), 2)
 
             # oracle: both pullers converged to the pusher, on an
-            # independent canonical subsample + the full-state digest
+            # independent canonical subsample + the digest matrix,
+            # whose mod-2^64 fold is exactly the chaos oracle's scalar
+            # digest (store/digest.py full_state_digest) — derived from
+            # the already-computed matrices, not a second keyspace scan
             want = pusher.ks.canonical(keys=sample)
             wmat = state_digest_matrix(pusher.ks, DIGEST_FANOUT, leaves)
+            wsum = int(wmat.sum(dtype=np.uint64))
             ok = True
             for name, (ks, _eng) in pullers.items():
                 got = ks.canonical(keys=sample)
-                dok = bool((state_digest_matrix(
-                    ks, DIGEST_FANOUT, leaves) == wmat).all())
+                pmat = state_digest_matrix(ks, DIGEST_FANOUT, leaves)
+                dok = bool((pmat == wmat).all()) and \
+                    int(pmat.sum(dtype=np.uint64)) == wsum
                 cok = compare_canonical(got, want) == 0
                 ok = ok and dok and cok
                 print(f"[bench] frac={frac} verify {name}: canonical "

@@ -152,13 +152,20 @@ async def _dump_plane_snapshot(app: ServerApp, cfg: Config) -> None:
     from ..persist.snapshot import write_snapshot_file
 
     node = app.node
+    # watermarks (own repl_last AND the per-peer records) are captured
+    # BEFORE the worker exports: frames landing mid-export end up in the
+    # state but above every recorded watermark (harmless redelivery).
+    # Captured after, a record would claim pull coverage the exported
+    # state lacks, and a boot restore adopting it would skip those
+    # frames' redelivery forever (persist/share.py has the long form).
     repl_last = node.repl_log.landed_last_uuid
+    records = node.replicas.records()
     captures = await node.serve_plane.export_batches()
     meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                     addr=app.advertised_addr, repl_last_uuid=repl_last)
     await asyncio.to_thread(
         write_snapshot_file, cfg.snapshot_path, meta,
-        node.replicas.records(), captures,
+        records, captures,
         chunk_keys=cfg.snapshot_chunk_keys,
         compress_level=cfg.snapshot_compress_level, fsync=True)
 

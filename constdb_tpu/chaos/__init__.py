@@ -1,0 +1,57 @@
+"""Convergence-under-chaos certification harness (ROADMAP item 5).
+
+Every hot path in this build has a faster variant negotiated by
+capability bits — coalesced apply, serve coalescing/sharding, delta
+resync, resident device planes, the REPLBATCH wire — and each ships with
+a differential suite pinning byte-identity on CLEAN runs.  This package
+is the production-readiness gate on top: it certifies that all of those
+paths still CONVERGE under partitions, frame reordering, duplicated
+delivery, mid-stream and mid-frame connection kills, process crashes
+(cold and warm), clock jitter, and mixed-version peers — at once, on
+every capability-matrix cell.
+
+Shape of the harness:
+
+  * `plane.FaultPlane` — a seeded fault plane wrapping EVERY inter-node
+    transport (ServerApp.peer_connector: replica links are always the
+    dialing side of their stream, so wrapping dials covers the mesh).
+    It splits each direction into protocol frames (raw FULLSYNC/
+    DELTASYNC payload windows stay atomic with their headers) and
+    applies scripted or seeded faults per directed edge: partitions
+    (full/asymmetric), delay, reorder, duplication, mid-frame
+    truncation + kill, targeted REPLBATCH payload corruption.
+  * `cluster.ChaosCluster` — node lifecycle: per-cell engine/capability
+    configs, deterministic per-node HLC clocks with scripted jitter
+    (`ChaosClock`), and the crash primitives (`restart_cold` /
+    `restart_warm`) the old tests/test_chaos.py helpers grew into.
+  * `oracle` — the invariant oracle: an op JOURNAL tapping every node's
+    origin stream (ReplLog.on_append) feeds a CPU-engine reference
+    export every node must match byte-identically; a continuous MONITOR
+    pins per-link watermark/beacon monotonicity while faults are live;
+    post-convergence checks pin digest-matrix agreement, no-resurrection
+    of retired keys/members, GC drain, and fault accounting (INFO
+    demotion/refusal/reconnect counters vs the faults actually
+    injected).
+  * `scenario` — the Scenario DSL: seed + node specs + a scripted
+    fault/op schedule.  A scenario's decision stream (ops, targets,
+    fault choices, backoff jitter) is a pure function of its seed, so
+    any failure replays from the printed seed; `certify_scenario` is
+    the acceptance schedule (partition + reorder + duplicate +
+    mid-stream kill + clock jitter + one mixed-version peer) and
+    `matrix_cells` enumerates the capability sweep it must pass on.
+
+CLI: `python -m constdb_tpu.chaos [--seed N] [--cells a,b,...] [--all]`
+(scripts/ci.sh runs the fixed-seed representative cells as its chaos
+smoke stage).
+"""
+
+from .plane import FaultPlane
+from .cluster import ChaosClock, ChaosCluster, NodeSpec
+from .oracle import InvariantMonitor, OpJournal
+from .scenario import (Cell, Scenario, certify_scenario, matrix_cells,
+                       run_scenario, smoke_cells, soak_scenario)
+
+__all__ = ["FaultPlane", "ChaosClock", "ChaosCluster", "NodeSpec",
+           "InvariantMonitor", "OpJournal", "Cell", "Scenario",
+           "certify_scenario", "matrix_cells", "run_scenario",
+           "smoke_cells", "soak_scenario"]

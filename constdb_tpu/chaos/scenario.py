@@ -1,0 +1,465 @@
+"""Scenario DSL + the certification schedules.
+
+A `Scenario` is (seed, capability cell, step list).  Steps are plain
+data — `("ops", n)`, `("partition", a, b, sym)`, `("crash", i, style)`,
+`("clock_jump", i, ms)`, … — so a schedule prints, diffs, and replays;
+every random choice (op mix, targets, fault decisions, backoff jitter)
+derives from the seed, so a failing run's printed seed IS its repro.
+
+`certify_scenario` is the acceptance schedule the ISSUE names: one
+scripted run combining partitions (full and asymmetric), frame
+reorder/duplication/delay, a mid-frame truncation kill, connection
+kills, cold+warm process crashes, clock jitter (forward and backward),
+a targeted REPLBATCH corruption, and one mixed-version peer — ending in
+the full invariant oracle (convergence to the CPU reference, digest
+agreement, watermark monotonicity, no-resurrection, GC drain, fault
+accounting).  `matrix_cells` enumerates the capability sweep it must
+pass on: wire batch x delta sync x serve shards x resident engine.
+
+`soak_scenario` generates a randomized schedule from its seed for the
+slow soak; any failure reports `[chaos seed=N]` and
+`run_scenario(soak_scenario(N))` replays that exact schedule.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..resp.message import Arr, Int
+from .cluster import ChaosCluster, Client, NodeSpec
+from .oracle import (InvariantMonitor, OpJournal, certify_state,
+                     check_fault_accounting)
+from .plane import FaultPlane
+
+
+@dataclass
+class Cell:
+    """One capability-matrix cell: which negotiated fast paths are ON
+    for the non-legacy nodes."""
+
+    wire: bool = True       # REPLBATCH columnar wire (CAP_BATCH_STREAM)
+    delta: bool = True      # digest-driven delta resync (CAP_DELTA_SYNC)
+    shards: int = 1         # serve workers per node (1 = single loop)
+    engine: str = "cpu"     # cpu | xla | xla-resident
+
+    @property
+    def name(self) -> str:
+        return (f"wire{int(self.wire)}-delta{int(self.delta)}"
+                f"-shards{self.shards}-{self.engine}")
+
+    def specs(self, n: int = 3, mixed_idx: Optional[int] = None
+              ) -> list[NodeSpec]:
+        """Node configs for this cell.  `mixed_idx` plays the
+        mixed-version peer: wire batching and delta sync OFF, so its
+        handshakes advertise neither capability and every stream it
+        touches must negotiate down correctly."""
+        out = []
+        for i in range(n):
+            if i == mixed_idx:
+                out.append(NodeSpec(engine="cpu", wire_batch=1,
+                                    delta_sync=False))
+            else:
+                out.append(NodeSpec(
+                    engine=self.engine,
+                    wire_batch=None if self.wire else 1,
+                    delta_sync=None if self.delta else False,
+                    serve_shards=self.shards))
+        return out
+
+
+def matrix_cells() -> list[Cell]:
+    """The full capability sweep.  Sharded cells collapse the wire
+    dimension (a shard-per-core receiver never advertises
+    CAP_BATCH_STREAM, and in an all-sharded mesh nobody does) and pin
+    the worker engine (serve workers run the cpu spec), so the sweep is
+    12 cells, not a blind 16."""
+    cells = []
+    for engine in ("cpu", "xla", "xla-resident"):
+        for wire in (True, False):
+            for delta in (True, False):
+                cells.append(Cell(wire=wire, delta=delta, shards=1,
+                                  engine=engine))
+    for delta in (True, False):
+        cells.append(Cell(wire=False, delta=delta, shards=2,
+                          engine="cpu"))
+    return cells
+
+
+def smoke_cells() -> list[Cell]:
+    """One representative cell per negotiated fast path (the CI chaos
+    smoke): everything-on, everything-off (pure legacy paths), the
+    resident engine, and the sharded serving plane."""
+    return [Cell(), Cell(wire=False, delta=False),
+            Cell(engine="xla-resident"), Cell(shards=2, wire=False)]
+
+
+@dataclass
+class Scenario:
+    seed: int
+    cell: Cell = field(default_factory=Cell)
+    steps: list = field(default_factory=list)
+    n_nodes: int = 3
+    mixed_idx: Optional[int] = 2   # which node plays the legacy peer
+    ops_per_burst: int = 30
+    converge_timeout: float = 45.0
+
+    @property
+    def name(self) -> str:
+        return f"seed={self.seed} cell={self.cell.name}"
+
+
+def certify_scenario(seed: int, cell: Optional[Cell] = None,
+                     ops: int = 30) -> Scenario:
+    """The acceptance schedule (see module docstring).  Node 2 is the
+    mixed-version peer; faults target the 0<->1 edge (both fast-path
+    nodes) and the mesh around node 2."""
+    cell = cell if cell is not None else Cell()
+    steps = [
+        ("ops", ops),
+        # frame-level chaos on the fast-path edge: delay + reorder + dup
+        ("faults", 0, 1, dict(delay=(0.0005, 0.004), reorder=0.25,
+                              dup=0.25)),
+        ("ops", ops * 2),
+    ]
+    if cell.wire and cell.shards == 1:
+        # a corrupt REPLBATCH payload must demote LOUDLY, mid-chaos.
+        # The follow-up burst runs on node 0 ONLY, so its serve path
+        # logs a consecutive encodable run and the 0->1 push loop
+        # group-encodes a REPLBATCH for the one-shot to hit (the
+        # certify step asserts it actually fired).
+        steps += [("corrupt_wire", 0, 1), ("wire_burst", 0, 24),
+                  ("ops", ops // 2)]
+    steps += [
+        ("clear_faults",),
+        # no-resurrection probe setup: the member exists mesh-wide
+        # BEFORE the partition...
+        ("probe_setup",),
+        ("partition", 0, 2, dict(sym=False, kill=False)),  # asymmetric
+        ("ops", ops),
+        ("heal",),
+        # ...then node 2 is FULLY isolated (both edges, connections
+        # killed), the member is retired on the majority side, and node
+        # 2 keeps writing — after the heal the removal must win
+        # everywhere and the member must never resurrect
+        ("partition", 0, 2, dict(sym=True, kill=True)),
+        ("partition", 1, 2, dict(sym=True, kill=True)),
+        ("probe_retire",),
+        ("ops", ops),
+        ("heal",),
+        # mid-stream violence on a live edge
+        ("truncate", 0, 1),
+        ("ops", ops // 2),
+        ("kill_conns", 0, 1),
+        ("ops", ops // 2),
+        # process deaths: cold loses everything in memory, warm loses
+        # only connections
+        ("crash", 1, "cold"),
+        ("ops", ops),
+        ("crash", 0, "warm"),
+        ("ops", ops // 2),
+        # clock jitter: a leap ahead, writes, a step BACK, writes
+        ("clock_jump", 2, 30_000),
+        ("ops", ops // 2),
+        ("clock_jump", 2, -20_000),
+        ("ops", ops // 2),
+        ("certify",),
+    ]
+    return Scenario(seed=seed, cell=cell, steps=steps,
+                    ops_per_burst=ops)
+
+
+def soak_scenario(seed: int, rounds: int = 12, ops: int = 80) -> Scenario:
+    """Randomized soak: `rounds` bursts with seeded fault events drawn
+    between them, always ending in the full oracle.  The schedule is a
+    pure function of `seed` — rebuild with the printed seed to replay."""
+    rng = random.Random(seed ^ 0x5EEDFA17)
+    steps: list = [("ops", ops)]
+    partitioned = False
+    for _ in range(rounds):
+        roll = rng.random()
+        if roll < 0.18 and not partitioned:
+            a, b = rng.sample(range(3), 2)
+            steps.append(("partition", a, b,
+                          dict(sym=rng.random() < 0.7,
+                               kill=rng.random() < 0.7)))
+            partitioned = True
+        elif roll < 0.30 and partitioned:
+            steps.append(("heal",))
+            partitioned = False
+        elif roll < 0.45:
+            a, b = rng.sample(range(3), 2)
+            steps.append(("faults", a, b,
+                          dict(delay=(0.0002, 0.003),
+                               reorder=rng.choice((0.0, 0.2, 0.4)),
+                               dup=rng.choice((0.0, 0.2, 0.4)))))
+        elif roll < 0.55:
+            steps.append(("clear_faults",))
+        elif roll < 0.65:
+            a, b = rng.sample(range(3), 2)
+            steps.append(("kill_conns", a, b))
+        elif roll < 0.72:
+            a, b = rng.sample(range(3), 2)
+            steps.append(("truncate", a, b))
+        elif roll < 0.85:
+            steps.append(("crash", rng.randrange(3),
+                          rng.choice(("cold", "warm"))))
+        else:
+            steps.append(("clock_jump", rng.randrange(3),
+                          rng.choice((-15_000, 10_000, 45_000))))
+        steps.append(("ops", ops))
+    if partitioned:
+        steps.append(("heal",))
+    steps += [("ops", ops), ("certify",)]
+    return Scenario(seed=seed, steps=steps, ops_per_burst=ops,
+                    converge_timeout=90.0)
+
+
+# ---------------------------------------------------------------- workload
+
+
+class _Workload:
+    """Seeded op generator with the bookkeeping the oracle probes need.
+
+    The mix sticks to rewrites that are pure pointwise merges (the
+    journal-replay reference is then exact under ANY delivery order):
+    counter steps + CNTUNDO, register set/del, set add/remove, hash set.
+    Deleted register keys are per-node-exclusive and never rewritten, so
+    "retired stays dead" is a mesh invariant, not a race."""
+
+    def __init__(self, seed: int, n_nodes: int) -> None:
+        self.rng = random.Random(seed ^ 0xC4A05)
+        self.n = n_nodes
+        self.serial = 0
+        self.retired_regs: list[bytes] = []
+        # per-node keys with at least one undoable local counter op
+        self.undoable: list[dict[str, int]] = [dict()
+                                               for _ in range(n_nodes)]
+
+    def clear_undo(self, i: int) -> None:
+        self.undoable[i].clear()  # a cold restart loses the undo log
+
+    async def pipelined_writes(self, cluster: ChaosCluster, i: int,
+                               n: int) -> None:
+        """One pipelined chunk of `n` writes on node `i`: the serve
+        coalescer logs them as one run, so the push loops drain a
+        CONSECUTIVE encodable run — the shape REPLBATCH group-encoding
+        (and the corrupt_wire one-shot) needs; a request-response burst
+        trickles single entries that ship per-frame."""
+        from ..resp.codec import encode_msg
+        from ..resp.message import Arr, Bulk
+        c = await Client().connect(cluster.apps[i].advertised_addr)
+        try:
+            buf = bytearray()
+            for j in range(n):
+                self.serial += 1
+                buf += encode_msg(Arr([
+                    Bulk(b"set"), Bulk(b"wire%d" % (j % 8)),
+                    Bulk(b"v%d" % self.serial)]))
+            c.writer.write(bytes(buf))
+            await c.writer.drain()
+            got = 0
+            while got < n:  # all n replies = the whole chunk landed
+                if c.parser.next_msg() is not None:
+                    got += 1
+                    continue
+                data = await asyncio.wait_for(c.reader.read(1 << 16),
+                                              10.0)
+                if not data:
+                    raise ConnectionError("EOF mid-pipeline")
+                c.parser.feed(data)
+        finally:
+            await c.close()
+
+    async def burst(self, cluster: ChaosCluster, n_ops: int,
+                    only: Optional[set] = None) -> None:
+        rng = self.rng
+        live = [i for i in range(len(cluster.apps))
+                if cluster.apps[i] is not None
+                and (only is None or i in only)]
+        clients = {}
+        try:
+            for i in live:
+                clients[i] = await Client().connect(
+                    cluster.apps[i].advertised_addr)
+            for _ in range(n_ops):
+                i = rng.choice(live)
+                c = clients[i]
+                self.serial += 1
+                die = rng.random()
+                if die < 0.30:
+                    k = f"cnt{rng.randrange(6)}"
+                    r = await c.cmd(rng.choice(("incr", "decr")), k,
+                                    rng.randrange(1, 4))
+                    assert isinstance(r, Int), r
+                    self.undoable[i][k] = self.undoable[i].get(k, 0) + 1
+                elif die < 0.40 and self.undoable[i]:
+                    k = rng.choice(sorted(self.undoable[i]))
+                    r = await c.cmd("cntundo", k)
+                    # an Err here is a real bug: the tracker only names
+                    # keys with a recorded, not-yet-undone local op
+                    assert isinstance(r, Int), (k, r)
+                    left = self.undoable[i][k] - 1
+                    if left:
+                        self.undoable[i][k] = left
+                    else:
+                        del self.undoable[i][k]
+                elif die < 0.60:
+                    await c.cmd("set", f"reg{rng.randrange(8)}",
+                                f"v{self.serial}")
+                elif die < 0.75:
+                    await c.cmd("sadd", f"set{rng.randrange(6)}",
+                                f"m{self.serial % 40}")
+                elif die < 0.85:
+                    k = f"set{rng.randrange(6)}"
+                    # pick drawn UNCONDITIONALLY: the rng stream must not
+                    # depend on the reply, or a replay whose timing
+                    # shifts one membership view would desync the whole
+                    # remaining schedule from its seed
+                    pick = rng.random()
+                    got = await c.cmd("smembers", k)
+                    if isinstance(got, Arr) and got.items:
+                        ms = sorted(b.val for b in got.items)
+                        await c.cmd("srem", k, ms[int(pick * len(ms))])
+                elif die < 0.95:
+                    await c.cmd("hset", f"h{rng.randrange(4)}",
+                                f"f{rng.randrange(6)}", f"v{self.serial}")
+                else:
+                    # retire a per-node-exclusive register: set + del on
+                    # the same node, never touched again
+                    k = f"dead:{i}:{self.serial}".encode()
+                    await c.cmd("set", k, "doomed")
+                    r = await c.cmd("del", k)
+                    assert r == Int(1), (k, r)
+                    self.retired_regs.append(k)
+        finally:
+            for c in clients.values():
+                await c.close()
+
+
+# ------------------------------------------------------------------ runner
+
+
+async def _run_scenario_async(sc: Scenario) -> dict:
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="constdb-chaos-") as work:
+        plane = FaultPlane(sc.seed)
+        journal = OpJournal()
+        cluster = ChaosCluster(work, sc.seed,
+                               sc.cell.specs(sc.n_nodes, sc.mixed_idx),
+                               plane=plane, journal=journal)
+        await cluster.start()
+        monitor = InvariantMonitor(cluster, journal).start()
+        wl = _Workload(sc.seed, sc.n_nodes)
+        probe_member = b"probe-member"
+        stats: dict = {}
+        try:
+            await cluster.meet_all()
+            await cluster.converge(timeout=20.0)
+            for step in sc.steps:
+                kind = step[0]
+                if kind == "ops":
+                    await wl.burst(cluster, step[1])
+                elif kind == "ops_on":
+                    await wl.burst(cluster, step[2], only={step[1]})
+                elif kind == "wire_burst":
+                    await wl.pipelined_writes(cluster, step[1], step[2])
+                elif kind == "faults":
+                    plane.set_faults(step[1], step[2], **step[3])
+                elif kind == "clear_faults":
+                    plane.clear_faults()
+                elif kind == "partition":
+                    plane.partition(step[1], step[2], **step[3])
+                elif kind == "heal":
+                    plane.heal()
+                elif kind == "kill_conns":
+                    plane.kill_connections(step[1], step[2])
+                elif kind == "truncate":
+                    plane.truncate_next(step[1], step[2])
+                elif kind == "corrupt_wire":
+                    plane.corrupt_next_wire(step[1], step[2])
+                elif kind == "crash":
+                    i = step[1]
+                    if step[2] == "cold" or \
+                            cluster.apps[i].node.serve_plane is not None:
+                        await cluster.restart_cold(i)
+                        wl.clear_undo(i)
+                    else:
+                        await cluster.restart_warm(i)
+                elif kind == "clock_jump":
+                    cluster.clock_jump(step[1], step[2])
+                elif kind == "probe_setup":
+                    c = await Client().connect(
+                        cluster.apps[0].advertised_addr)
+                    await c.cmd("sadd", "probe:s", probe_member)
+                    await c.close()
+                    await cluster.converge(timeout=sc.converge_timeout)
+                elif kind == "probe_retire":
+                    # retired on node 0 — node 2 is partitioned away and
+                    # still holds the member until the heal
+                    c = await Client().connect(
+                        cluster.apps[0].advertised_addr)
+                    await c.cmd("srem", "probe:s", probe_member)
+                    await c.close()
+                elif kind == "certify":
+                    plane.clear_faults()
+                    plane.heal()
+                    if any(s[0] == "corrupt_wire" for s in sc.steps):
+                        # the one-shot must have HIT a real REPLBATCH
+                        # (the targeted burst above guarantees traffic)
+                        assert plane.stats.get("wire_corruptions") == 1, \
+                            f"[chaos {sc.name}] wire corruption armed " \
+                            f"but never hit a REPLBATCH frame"
+                    canon = await certify_state(
+                        cluster, journal, timeout=sc.converge_timeout)
+                    _check_probes(sc, cluster, wl, canon, probe_member)
+                    monitor.check()
+                    check_fault_accounting(cluster, plane)
+                    stats["canonical_keys"] = len(canon)
+                else:
+                    raise ValueError(f"unknown scenario step {kind!r}")
+            stats["journal_ops"] = len(journal.ops)
+            stats["plane"] = dict(plane.stats)
+            stats["reconnects"] = sum(
+                a.node.stats.repl_reconnects for a in cluster.apps)
+            return stats
+        except AssertionError:
+            raise
+        except Exception as e:
+            # every failure names the replay seed, whatever its type
+            raise AssertionError(
+                f"[chaos {sc.name}] scenario crashed: {e!r}") from e
+        finally:
+            monitor.stop()
+            await cluster.close()
+
+
+def _check_probes(sc: Scenario, cluster, wl: _Workload, canon: dict,
+                  probe_member: bytes) -> None:
+    """No-resurrection laws over the converged canonical export.  A
+    canonical() entry is (enc, ct, mt, dt, expire, content); element
+    content rows are (member, add_t, add_node, del_t, val)."""
+    for key in wl.retired_regs:
+        ent = canon.get(key)
+        assert ent is None or ent[1] < ent[3], \
+            f"[chaos {sc.name}] retired key {key!r} resurrected: {ent}"
+    s = canon.get(b"probe:s")
+    if s is not None:
+        members = {m for m, _at, _an, dlt, _v in s[5] if dlt == 0}
+        assert probe_member not in members, \
+            f"[chaos {sc.name}] removed member resurrected after " \
+            f"partition heal: {sorted(members)}"
+
+
+def run_scenario(sc: Scenario) -> dict:
+    """Run one scenario to completion (sync wrapper; prints nothing —
+    every failure message carries `[chaos seed=N …]`)."""
+    return asyncio.run(_run_scenario_async(sc))
+
+
+# re-exported for the CLI and tests
+__all__ = ["Cell", "Scenario", "certify_scenario", "soak_scenario",
+           "matrix_cells", "smoke_cells", "run_scenario"]

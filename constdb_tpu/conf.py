@@ -156,6 +156,22 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_TENSOR_STRATEGY", "lww",
            "merge strategy TENSOR.SET uses when the strategy argument "
            "is '-' (lww, sum, avg, maxmag, trimmed-mean)"),
+    EnvVar("CONSTDB_RECONNECT_BASE_MS", "5000",
+           "replica-link reconnect backoff base delay (first retry "
+           "after a drop; doubles per consecutive failure)"),
+    EnvVar("CONSTDB_RECONNECT_FACTOR", "2.0",
+           "replica-link reconnect backoff multiplier per consecutive "
+           "dial failure"),
+    EnvVar("CONSTDB_RECONNECT_MAX_MS", "60000",
+           "replica-link reconnect backoff ceiling — a long partition "
+           "retries at this cadence, never slower"),
+    EnvVar("CONSTDB_RECONNECT_JITTER", "0.2",
+           "replica-link reconnect jitter fraction, derived "
+           "DETERMINISTICALLY from (node_id, peer, attempt) so chaos "
+           "runs replay exactly from their seed"),
+    EnvVar("CONSTDB_UNDO_WINDOW", "4096",
+           "locally-originated counter ops kept undoable (CNTUNDO "
+           "looks its target up here; older ops report 'evicted')"),
 )}
 
 

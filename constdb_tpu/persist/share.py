@@ -73,21 +73,27 @@ class SharedDump:
         if plane is not None:
             # shard-per-core node: the workers hold the state.  The
             # LANDED watermark (fences included — after a reset the
-            # segments are empty but the fence is the resume floor) is
-            # captured BEFORE the exports; ops landing during the
-            # export are also in the merged repl_log above it, so the
-            # peer re-applies them over state that already includes
-            # them (idempotent merges, the redelivery class
-            # replica/coalesce.py documents).
+            # segments are empty but the fence is the resume floor) AND
+            # the replica records are captured BEFORE the exports; ops
+            # landing during the export are then in the state but above
+            # every recorded watermark, so the peer re-applies them over
+            # state that already includes them (idempotent merges, the
+            # redelivery class replica/coalesce.py documents).  The
+            # REVERSE order is a real loss: a pull watermark recorded
+            # AFTER the export claims coverage of frames the exported
+            # state never saw, and a receiver adopting it skips their
+            # redelivery forever (found by the chaos harness in the
+            # cold-restart dump, which had the same shape).
             repl_last = node.repl_log.landed_last_uuid
+            records = node.replicas.records()
             captures = await plane.export_batches()
         else:
             node.ensure_flushed()  # device-resident merge state → host
             captures = [batch_from_keyspace(node.ks)]  # on the loop
             repl_last = node.repl_log.last_uuid
+            records = node.replicas.records()
         meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                         addr=app.advertised_addr, repl_last_uuid=repl_last)
-        records = node.replicas.records()
         path = os.path.join(app.work_dir, f"fullsync.{node.node_id}.snapshot")
         # the full-sync stream sends this very file, so the column
         # compression rides the wire end-to-end (conf

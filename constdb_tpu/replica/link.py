@@ -63,7 +63,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-import random
 from typing import Optional, TYPE_CHECKING
 
 import numpy as np
@@ -161,6 +160,35 @@ def wire_latency_of(app) -> float:
     return wl
 
 
+def backoff_delay(base: float, factor: float, cap: float, jitter: float,
+                  node_id: int, addr: str, attempt: int,
+                  salt: int = 0) -> float:
+    """Reconnect delay before dial `attempt` (0-based count of
+    CONSECUTIVE failures): bounded exponential growth with
+    DETERMINISTIC jitter.  The jitter fraction derives from a splitmix64
+    hash of (node_id, peer addr, attempt, salt) — two nodes dialing one
+    returned peer still de-synchronize (no thundering herd), but a chaos
+    scenario's retry cadence is a pure function of its inputs, so a
+    failure replays exactly from its printed seed (random.random() here
+    would make every replay walk a different schedule).  `salt` varies
+    the jitter without touching the exponent (the dial loop feeds its
+    iteration count, so even the flat connected-supervisor cadence
+    drifts apart across nodes — see the lockstep note there)."""
+    d = min(cap, base * (factor ** min(attempt, 32)))
+    if jitter <= 0.0:
+        return d
+    import zlib
+    x = (node_id * 0x9E3779B97F4A7C15 + zlib.crc32(addr.encode())
+         + attempt * 1000003 + salt) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 31
+    frac = (x & 0xFFFF) / 65535.0  # [0, 1]
+    return d * (1.0 - jitter + 2.0 * jitter * frac)
+
+
 _READ_CHUNK = 1 << 16
 # push-loop wire buffer: flush to the socket at this many buffered bytes
 # (backpressure bound; the latency bound is CONSTDB_WIRE_LATENCY_MS)
@@ -206,6 +234,12 @@ class ReplicaLink:
         # DIGESTACK landing inside a FULLSYNC/DELTASYNC byte window
         # would corrupt the peer's spill download
         self._stream_lock = asyncio.Lock()
+        # reconnect observability (INFO repl_link_state/repl_reconnects)
+        # + the backoff ladder's position: consecutive dial failures
+        # since the last live connection
+        self._attempts = 0
+        self._ever_connected = False
+        self.reconnects = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -224,6 +258,20 @@ class ReplicaLink:
     @property
     def connected(self) -> bool:
         return self._serve_task is not None and not self._serve_task.done()
+
+    @property
+    def state(self) -> str:
+        """Link lifecycle for INFO (`repl_link_state`): `connected`, a
+        first `dialing`, or `backoff:N` after N consecutive failures —
+        the previously-implicit retry cadence, made observable (the
+        chaos harness's fault accounting reads it too)."""
+        if self.connected:
+            return "connected"
+        if self.meta.dial_suspended:
+            return "suspended"
+        if self.closing:
+            return "closed"
+        return f"backoff:{self._attempts}" if self._attempts else "dialing"
 
     # ------------------------------------------------------ byte accounting
     # replication traffic counts into the node's net totals plus dedicated
@@ -319,8 +367,17 @@ class ReplicaLink:
     # ----------------------------------------------------------------- dial
 
     async def _dial_loop(self) -> None:
-        """Reconnect-forever with backoff (reference
-        replica/replica.rs:254-271, 5s retry)."""
+        """Reconnect-forever with BOUNDED EXPONENTIAL backoff (the
+        reference retries at a flat 5s — replica/replica.rs:254-271; a
+        flat cadence hammers a recovering peer from the whole mesh at
+        once, and an implicit one is unobservable).  Consecutive
+        failures walk base * factor^n up to the ceiling, with
+        deterministic jitter (`backoff_delay`); any live connection —
+        dialed or adopted — resets the ladder.  While connected this
+        loop is just the reconnect supervisor, polling at the base
+        cadence."""
+        app = self.app
+        it = 0
         while not self.closing and self.meta.alive and \
                 not self.meta.dial_suspended:
             if not self.connected:
@@ -328,14 +385,40 @@ class ReplicaLink:
                     await self._dial_once()
                 except (ConnectionError, OSError, CstError,
                         asyncio.TimeoutError) as e:
-                    log.debug("dial %s failed: %s", self.meta.addr, e)
-            delay = self.app.reconnect_delay
-            await asyncio.sleep(delay * (0.8 + 0.4 * random.random()))
+                    self._attempts += 1
+                    log.debug("dial %s failed (attempt %d): %s",
+                              self.meta.addr, self._attempts, e)
+            it += 1
+            if self.connected:
+                # supervisor cadence: base delay, but still JITTERED
+                # (per iteration) — two peers that dial each other in
+                # the same instant each install their own connection
+                # and close the other's; identical un-jittered sleeps
+                # would redo that collision forever, in lockstep (the
+                # chaos suite's connection-kill test caught exactly
+                # this livelock when the jitter briefly covered only
+                # the failure branch)
+                delay = backoff_delay(
+                    app.reconnect_delay, 1.0, app.reconnect_delay,
+                    app.reconnect_jitter, self.node.node_id,
+                    self.meta.addr, 0, salt=it)
+            else:
+                # _attempts was already bumped for the failure this
+                # sleep follows, so rung 0 — the documented BASE delay
+                # of the first retry — is attempts-1 (a drop without a
+                # failed dial yet leaves attempts at 0: also the base)
+                delay = backoff_delay(
+                    app.reconnect_delay, app.reconnect_factor,
+                    app.reconnect_max, app.reconnect_jitter,
+                    self.node.node_id, self.meta.addr,
+                    max(0, self._attempts - 1), salt=it)
+            await asyncio.sleep(delay)
 
     async def _dial_once(self) -> None:
         host, port = self.meta.addr.rsplit(":", 1)
         epoch0 = self.node.reset_epoch  # watermark snapshot validity fence
-        reader, writer = await asyncio.open_connection(host, int(port))
+        reader, writer = await self.app.open_peer_connection(host,
+                                                             int(port))
         try:
             self._write(writer, encode_msg(Arr([
                 Bulk(SYNC), Int(0), Int(self.node.node_id),
@@ -406,6 +489,14 @@ class ReplicaLink:
 
     def _install(self, reader, writer, parser, peer_resume: int) -> None:
         self.meta.last_seen_ms = now_ms()
+        self._attempts = 0  # any live connection resets the backoff ladder
+        if self._ever_connected:
+            # every re-established connection after the link's first —
+            # dialed or adopted — is one reconnect (INFO repl_reconnects;
+            # the chaos oracle checks this against its injected kills)
+            self.reconnects += 1
+            self.node.stats.repl_reconnects += 1
+        self._ever_connected = True
         self._epoch = self.node.reset_epoch
         self._digest_acks = asyncio.Queue()
         self._digest_cache = None
@@ -603,12 +694,17 @@ class ReplicaLink:
                     # beacon: with the log fully drained, every uuid this
                     # node will EVER stream from now on exceeds its current
                     # HLC — peers may advance their pull watermark to it, so
-                    # idle nodes don't pin the cluster GC horizon at 0
+                    # idle nodes don't pin the cluster GC horizon at 0.
+                    # Item 5 is this node's CLUSTER COVERAGE (the uuid it
+                    # holds every origin's stream up to) — the peer's GC
+                    # gates third-party tombstone collection on it
+                    # (manager.min_uuid; legacy receivers ignore extras).
                     drained = cursor >= node.repl_log.last_uuid
                     beacon = node.hlc.current if drained else 0
                     self._write(writer, encode_msg(Arr([
                         Bulk(REPLACK), Int(meta.uuid_he_sent), Int(now_ms()),
-                        Int(beacon)])))
+                        Int(beacon),
+                        Int(node.replicas.cluster_coverage())])))
                     meta.uuid_he_acked = meta.uuid_he_sent
                     last_ack = now
                 await writer.drain()
@@ -772,13 +868,21 @@ class ReplicaLink:
         meta = self.meta
         if self._digest_acks is None:
             self._digest_acks = asyncio.Queue()
-        # watermark FIRST, digest after: the digested state is then a
+        # watermarks FIRST, digest after: the digested state is then a
         # superset of every op <= repl_last — ops landing in between are
         # in the repl_log above it and replay after the delta, the same
         # redelivery class the shared full-sync dump documents
-        # (persist/share.py; coalesced re-applies are idempotent)
+        # (persist/share.py; coalesced re-applies are idempotent).  The
+        # REPLICA RECORDS are part of the same cut: a third-party frame
+        # landing during the digest rounds below is in our state but in
+        # NO bucket the (already-computed) digests flagged — a record
+        # captured after the awaits would claim its origin's watermark
+        # anyway, and the receiver's adoption would skip the frame's
+        # redelivery forever (found by the chaos harness: one node held
+        # a register's stale LWW loser mesh-wide-acked).
         repl_last = getattr(node.repl_log, "landed_last_uuid",
                             node.repl_log.last_uuid)
+        records = node.replicas.records()
         fanout = DIGEST_FANOUT
         plane = node.serve_plane
         if plane is not None:
@@ -844,7 +948,6 @@ class ReplicaLink:
         nmeta = NodeMeta(node_id=node.node_id, alias=node.alias,
                          addr=getattr(app, "advertised_addr", ""),
                          repl_last_uuid=repl_last)
-        records = node.replicas.records()
         chunk_keys = getattr(app, "snapshot_chunk_keys", 1 << 16)
         level = getattr(app, "snapshot_compress_level", 1)
         if plane is not None:
@@ -952,6 +1055,18 @@ class ReplicaLink:
                 if uuid > self.meta.uuid_i_acked:
                     self.meta.uuid_i_acked = uuid
                     self.node.events.trigger(EVENT_REPLICA_ACKED, uuid)
+                if len(items) > 4:
+                    # peer's cluster coverage (see manager.ReplicaMeta).
+                    # LAST REPORT WINS, decreases included: coverage can
+                    # legitimately REGRESS (a new peer joins the mesh
+                    # and its stream is unpulled; a state wipe), and
+                    # clamping upward would gate tombstone collection on
+                    # a stale too-high value — the unsoundness this
+                    # field exists to close.  Accepting a decrease is
+                    # merely conservative (GC pauses until coverage
+                    # recovers); a reconnect-overlap race delivering an
+                    # old ack late lowers it briefly, same story.
+                    self.meta.coverage = as_int(items[4])
                 if len(items) > 3 and \
                         self._epoch == self.node.reset_epoch:
                     # peer's stream is complete below its beacon.  The

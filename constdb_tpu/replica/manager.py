@@ -66,6 +66,18 @@ class ReplicaMeta:
     # per-frame REPLICATE frames.  Sticky for the process lifetime: a
     # peer that ships one malformed batch will ship another.
     batch_wire_off: bool = field(default=False, compare=False)
+    # runtime (not replicated): the peer's self-reported CLUSTER
+    # COVERAGE — a uuid L such that the peer holds EVERY origin's ops
+    # <= L (REPLACK item 5; -1 = legacy peer, never reported).  Gates
+    # the GC horizon for THIRD-PARTY tombstones: uuid_i_acked only
+    # proves the peer holds MY stream past the horizon, which says
+    # nothing about a tombstone another origin minted — collecting on
+    # acks alone lets a peer that is partitioned from that origin adopt
+    # my watermarks from a later state transfer and silently skip the
+    # delete's op replay forever (found by the chaos harness: the
+    # removed member resurrected on exactly one node, mesh-wide
+    # watermarks all caught up).
+    coverage: int = field(default=-1, compare=False)
 
     @property
     def alive(self) -> bool:
@@ -217,7 +229,29 @@ class ReplicaManager:
             pinning.append(m)
         if not pinning:
             return None
-        return min(min(m.uuid_i_acked, m.uuid_he_sent) for m in pinning)
+        horizon = None
+        for m in pinning:
+            pin = min(m.uuid_i_acked, m.uuid_he_sent)
+            if m.coverage >= 0:
+                # coverage-aware horizon: a third-party tombstone is
+                # collectable only once this peer holds EVERY origin's
+                # stream past it — the property that makes snapshot/
+                # delta watermark ADOPTION sound (see ReplicaMeta.
+                # coverage).  Legacy peers (-1) keep the ack-only bound.
+                pin = min(pin, m.coverage)
+            horizon = pin if horizon is None else min(horizon, pin)
+        return horizon
+
+    def cluster_coverage(self) -> int:
+        """The uuid L this node may advertise as held across EVERY
+        origin stream: min over live peers of the applied pull watermark
+        (uuid_he_sent); our own stream is trivially held.  Advertised in
+        every REPLACK (replica/link.py) so peers' GC horizons can gate
+        third-party tombstone collection on it."""
+        live = self.live_peers()
+        if not live:
+            return 0
+        return min(m.uuid_he_sent for m in live)
 
     # ------------------------------------------------------------- REPLICAS
 

@@ -385,6 +385,10 @@ def _counter_step(node, ctx, args, delta: int) -> Msg:
     kid, _ = node.ks.get_or_create(key, S.ENC_COUNTER, ctx.uuid)
     v, total = node.ks.counter_change(kid, ctx.nodeid, delta, ctx.uuid)
     node.ks.updated_at(kid, ctx.uuid)
+    if not ctx.from_repl:
+        # locally-originated steps are undoable (CNTUNDO); replicated
+        # ones are not ours to invert (single-writer slots)
+        node.undo.record(ctx.uuid, key, delta)
     node.replicate_cmd(ctx.uuid, b"cntset", [Bulk(key), Int(total)])
     return Int(v)
 
@@ -408,6 +412,41 @@ def cntset_command(node, ctx, args):
     node.ks.counter_set_total(kid, ctx.nodeid, total, ctx.uuid)
     node.ks.updated_at(kid, ctx.uuid)
     return NO_REPLY
+
+
+@register("cntundo", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY, families=("env", "cnt"))
+def cntundo_command(node, ctx, args):
+    """`CNTUNDO key [uuid]` — sound inverse-op undo for the PN-counter
+    family only (PAPERS.md, "The Only Undoable CRDTs are Counters"):
+    undo THIS node's counter op `uuid` (or, without one, its newest
+    not-yet-undone local op on `key`) by applying the negated delta as a
+    fresh write.  The inverse replicates as an ordinary absolute-total
+    CNTSET, so it rides every negotiated fast path — coalesced apply,
+    serve planning, the columnar wire, snapshots, digests — like any
+    increment.  The undo is itself recorded, so undoing an undo redoes.
+    Non-counter keys are rejected cleanly: no other family's ops admit a
+    sound inverse (an element re-add is a NEW add, not an un-remove)."""
+    key = args.next_bytes()
+    uuid = args.next_uint() if args.has_more else None
+    ks = node.ks
+    kid = ks.lookup(key)
+    if kid >= 0 and ks.enc_of(kid) != S.ENC_COUNTER:
+        raise CstError("UNDO is only sound for counters "
+                       "(arXiv 2006.10494); this key is not one")
+    target = node.undo.resolve(key, uuid)
+    if target is None:
+        if uuid is not None and node.undo.known(uuid):
+            raise CstError("op already undone or key mismatch")
+        raise CstError("unknown, remote, or evicted counter op: only "
+                       "this node's recent local steps are undoable")
+    t_uuid, delta = target
+    kid, _ = ks.get_or_create(key, S.ENC_COUNTER, ctx.uuid)
+    v, total = ks.counter_change(kid, ctx.nodeid, -delta, ctx.uuid)
+    ks.updated_at(kid, ctx.uuid)
+    node.undo.mark_undone(t_uuid)
+    node.undo.record(ctx.uuid, key, -delta, inverse=True)
+    node.replicate_cmd(ctx.uuid, b"cntset", [Bulk(key), Int(total)])
+    return Int(v)
 
 
 @register("delcnt", CMD_WRITE | CMD_REPL_ONLY | CMD_NO_REPLICATE | CMD_NO_REPLY, families=("env", "cnt"))
@@ -1516,6 +1555,7 @@ def _plan_counter_step(coal, items, sign):
         coal.cnts[key] = st
     st[0] += delta
     st[1] += delta
+    coal.node.undo.record(uuid, key, delta)  # the op twin's CNTUNDO hook
     coal.add(b"cntset", (key, uuid, st[1]), [items[1], Int(st[1])])
     return Int(st[0])
 
@@ -1528,6 +1568,45 @@ def _plan_incr(coal, items):
 @serve_plan("decr")
 def _plan_decr(coal, items):
     return _plan_counter_step(coal, items, -1)
+
+
+@serve_plan("cntundo")
+def _plan_cntundo(coal, items):
+    # op twin: cntundo_command — the inverse step is just a counter step
+    # whose delta comes from the undo log, so it plans exactly like
+    # INCR/DECR once the target resolves.  Every rejection (non-counter
+    # key, unknown/undone/evicted op) demotes BEFORE any mutation, and
+    # the per-command path raises the exact error.
+    n = len(items)
+    if n < 2 or n > 3:
+        return None
+    try:
+        key = as_bytes(items[1])
+        uuid = as_uint(items[2]) if n > 2 else None
+    except CstError:
+        return None
+    kid = coal.resolve_key(key, S.ENC_COUNTER)
+    if kid is coal.CONFLICT:
+        return None
+    undo = coal.node.undo
+    target = undo.resolve(key, uuid)
+    if target is None:
+        return None  # exact op error per-command
+    t_uuid, delta = target
+    new_uuid = coal.tick()
+    st = coal.cnts.get(key)
+    if st is None:
+        ks = coal.ks
+        st = [ks.counter_sum(kid),
+              ks.counter_slot_total(kid, coal.nodeid)] if kid >= 0 \
+            else [0, 0]
+        coal.cnts[key] = st
+    st[0] -= delta
+    st[1] -= delta
+    undo.mark_undone(t_uuid)
+    undo.record(new_uuid, key, -delta, inverse=True)
+    coal.add(b"cntset", (key, new_uuid, st[1]), [items[1], Int(st[1])])
+    return Int(st[0])
 
 
 def _plan_elem_update(coal, items, name, enc, add):

@@ -111,6 +111,10 @@ def _section_stats(node, out):
     out.append(("repl_delta_bytes", st.repl_delta_bytes))
     out.append(("repl_full_syncs", st.repl_full_syncs))
     out.append(("repl_digest_rounds", st.repl_digest_rounds))
+    # replica-link connections re-established after a drop (the backoff
+    # ladder's success count — per-peer state/attempts ride the
+    # Replication section's repl_link_state / replica<i> rows)
+    out.append(("repl_reconnects", st.repl_reconnects))
     # client-serving coalescing (server/serve.py), mirroring the repl_*
     # trio above; the latency percentiles come from the sampled
     # plan→land ring (CONSTDB_SERVE_LAT_SAMPLE)
@@ -204,13 +208,24 @@ def _section_replication(node, out):
     out.append(("repl_log_last_uuid", rl.last_uuid))
     horizon = node.replicas.min_uuid() if node.replicas else None
     out.append(("gc_horizon_uuid", horizon if horizon is not None else ""))
+    states = []
     for i, (addr, m) in enumerate(peers):
-        state = "connected" if (m.link is not None and m.link.connected) \
-            else ("alive" if m.alive else "forgotten")
+        link = m.link
+        if link is not None and getattr(link, "state", None) is not None:
+            # live link: the backoff ladder's own view (connected /
+            # dialing / backoff:N / suspended — replica/link.py)
+            state = link.state
+        else:
+            state = "alive" if m.alive else "forgotten"
+        states.append(f"{addr}={state}")
+        recon = getattr(link, "reconnects", 0) if link is not None else 0
         out.append((f"replica{i}",
                     f"addr={addr},node_id={m.node_id},state={state},"
+                    f"reconnects={recon},"
                     f"i_sent={m.uuid_i_sent},i_acked={m.uuid_i_acked},"
                     f"he_sent={m.uuid_he_sent},he_acked={m.uuid_he_acked}"))
+    if states:
+        out.append(("repl_link_state", ";".join(states)))
 
 
 def _section_keyspace(node, out):

@@ -35,7 +35,11 @@ class ServerApp:
 
     def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 0,
                  advertised_addr: str = "", work_dir: str = ".",
-                 heartbeat: float = 4.0, reconnect_delay: float = 5.0,
+                 heartbeat: float = 4.0,
+                 reconnect_delay: Optional[float] = None,
+                 reconnect_max: Optional[float] = None,
+                 reconnect_factor: Optional[float] = None,
+                 reconnect_jitter: Optional[float] = None,
                  handshake_timeout: float = 10.0,
                  snapshot_chunk_keys: int = 1 << 16,
                  snapshot_compress_level: int = 1,
@@ -68,7 +72,30 @@ class ServerApp:
         self._advertised = advertised_addr
         self.work_dir = work_dir
         self.heartbeat = heartbeat
-        self.reconnect_delay = reconnect_delay
+        # replica-link reconnect: bounded exponential backoff with
+        # DETERMINISTIC jitter (replica/link.py backoff_delay) — base
+        # delay, ceiling, growth factor, jitter fraction.  None = the
+        # CONSTDB_RECONNECT_* env defaults.  The jitter derives from
+        # (node_id, peer addr, attempt) instead of random(), so a chaos
+        # scenario's reconnect cadence replays exactly from its seed.
+        from ..conf import env_float as _envf
+        self.reconnect_delay = _envf("CONSTDB_RECONNECT_BASE_MS",
+                                     5000.0) / 1000.0 \
+            if reconnect_delay is None else reconnect_delay
+        self.reconnect_max = _envf("CONSTDB_RECONNECT_MAX_MS",
+                                   60000.0) / 1000.0 \
+            if reconnect_max is None else reconnect_max
+        self.reconnect_factor = _envf("CONSTDB_RECONNECT_FACTOR", 2.0) \
+            if reconnect_factor is None else reconnect_factor
+        self.reconnect_jitter = _envf("CONSTDB_RECONNECT_JITTER", 0.2) \
+            if reconnect_jitter is None else reconnect_jitter
+        # the seam the chaos harness (constdb_tpu/chaos) installs to
+        # route EVERY inter-node transport through its fault plane: an
+        # async callable (host, port) -> (reader, writer).  None = a
+        # plain TCP connection.  Replica links are always the DIALING
+        # side of their connection (an inbound SYNC adopts a stream some
+        # peer's link dialed), so wrapping dials covers the whole mesh.
+        self.peer_connector = None
         self.handshake_timeout = handshake_timeout
         self.snapshot_chunk_keys = snapshot_chunk_keys
         self.snapshot_compress_level = snapshot_compress_level
@@ -284,6 +311,14 @@ class ServerApp:
             consumer.close()
 
     # ---------------------------------------------------------------- links
+
+    async def open_peer_connection(self, host: str, port: int):
+        """Dial a replica peer (replica/link.py _dial_once).  Routed
+        through `peer_connector` when one is installed (the chaos
+        harness's fault plane); a plain TCP connection otherwise."""
+        if self.peer_connector is not None:
+            return await self.peer_connector(host, port)
+        return await asyncio.open_connection(host, port)
 
     def ensure_link(self, meta: ReplicaMeta) -> None:
         """Spawn (or keep) the dialing link for a live peer."""

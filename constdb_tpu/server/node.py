@@ -61,6 +61,10 @@ class NodeStats:
     repl_delta_bytes: int = 0
     repl_full_syncs: int = 0
     repl_digest_rounds: int = 0
+    # replica-link connections re-established after a drop (every
+    # _install beyond a link's first, dialed or adopted — replica/
+    # link.py).  Per-peer counts ride the INFO replication section.
+    repl_reconnects: int = 0
     # client-serving coalescing (server/serve.py): pipelined client
     # commands folded into columnar micro-batches, batches landed,
     # commands that acted as ordered barriers (reads / non-plannable
@@ -79,6 +83,83 @@ class NodeStats:
     extra: dict = field(default_factory=dict)
 
 
+class CounterUndoLog:
+    """Locally-originated counter steps this node can still UNDO.
+
+    Grounded in "The Only Undoable CRDTs are Counters" (PAPERS.md, arXiv
+    2006.10494): the PN-counter is the one family whose ops admit a sound
+    inverse — applying the negated delta commutes with every concurrent
+    op and converges mesh-wide like any increment.  Each local INCR/DECR
+    (and each CNTUNDO, so undo-of-undo is redo) records (uuid → key,
+    delta) here; `CNTUNDO key [uuid]` resolves its target against this
+    log and replicates the inverse as an ordinary absolute-total CNTSET.
+
+    Node-local on purpose: a slot is a single-writer register, so only
+    the op's ORIGIN can soundly invert it — a remote node undoing it
+    would write someone else's slot.  Bounded (CONSTDB_UNDO_WINDOW ops,
+    FIFO eviction) and not snapshot-persisted: after eviction or a
+    restart the op reports "evicted", never a wrong inverse.
+    """
+
+    __slots__ = ("cap", "_ops", "_by_key", "_order")
+
+    def __init__(self, cap: Optional[int] = None) -> None:
+        if cap is None:
+            from ..conf import env_int
+            cap = env_int("CONSTDB_UNDO_WINDOW", 4096)
+        self.cap = max(1, cap)
+        self._ops: dict[int, list] = {}      # uuid -> [key, delta, undone]
+        self._by_key: dict[bytes, list] = {}  # key -> uuid stack (newest last)
+        self._order: deque[int] = deque()     # FIFO eviction order
+
+    def record(self, uuid: int, key: bytes, delta: int,
+               inverse: bool = False) -> None:
+        """`inverse=True` marks the record as an undo's own inverse op:
+        a BARE `CNTUNDO key` walks user ops only (two bare undos revert
+        two increments, they do not ping-pong); undoing an inverse —
+        redo — takes its explicit uuid."""
+        self._ops[uuid] = [key, delta, False, inverse]
+        self._by_key.setdefault(key, []).append(uuid)
+        self._order.append(uuid)
+        while len(self._order) > self.cap:
+            old = self._order.popleft()
+            ent = self._ops.pop(old, None)
+            if ent is not None:
+                stack = self._by_key.get(ent[0])
+                if stack is not None:
+                    try:
+                        stack.remove(old)
+                    except ValueError:
+                        pass
+                    if not stack:
+                        del self._by_key[ent[0]]
+
+    def resolve(self, key: bytes, uuid: Optional[int] = None):
+        """The undo target: `(uuid, delta)` of the op to invert — the
+        explicit uuid (any not-yet-undone record, inverses included:
+        that is redo), or the newest not-yet-undone USER op on `key`
+        (classic stack undo).  None when there is nothing to undo (the
+        command surfaces the precise reason)."""
+        if uuid is not None:
+            ent = self._ops.get(uuid)
+            if ent is None or ent[0] != key or ent[2]:
+                return None
+            return uuid, ent[1]
+        for u in reversed(self._by_key.get(key, ())):
+            ent = self._ops[u]
+            if not ent[2] and not ent[3]:
+                return u, ent[1]
+        return None
+
+    def known(self, uuid: int) -> bool:
+        return uuid in self._ops
+
+    def mark_undone(self, uuid: int) -> None:
+        ent = self._ops.get(uuid)
+        if ent is not None:
+            ent[2] = True
+
+
 class Node:
     def __init__(self, node_id: int = 0, alias: str = "", addr: str = "",
                  engine=None, repl_log_cap: int = ReplLog.DEFAULT_CAP,
@@ -92,6 +173,8 @@ class Node:
         self.events = EventBus()
         self.engine = engine if engine is not None else CpuMergeEngine()
         self.stats = NodeStats()
+        # undoable local counter ops (CNTUNDO — server/commands.py)
+        self.undo = CounterUndoLog()
         from ..replica.manager import ReplicaManager
         self.replicas = ReplicaManager()
         # bumped by reset_for_full_resync; replica links stamp it at

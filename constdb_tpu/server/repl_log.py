@@ -44,6 +44,11 @@ class ReplLog:
         self._bytes = 0
         self.evicted_up_to = 0  # uuid of the newest evicted entry (0 = none)
         self.last_uuid = 0      # newest uuid ever pushed (survives eviction)
+        # observer: called with (uuid, name, args) as each entry lands —
+        # the chaos oracle's op journal taps the origin stream here
+        # (constdb_tpu/chaos/oracle.py); the ring's eviction makes the
+        # log itself useless as a post-hoc record.  None = no observer.
+        self.on_append = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -69,6 +74,8 @@ class ReplLog:
         self._uuids.append(uuid)
         self._bytes += size
         self.last_uuid = uuid
+        if self.on_append is not None:
+            self.on_append(uuid, name, args)
         while self._bytes > self.cap and len(self._entries) > 1:
             ev = self._entries.popleft()
             self._uuids.popleft()
@@ -106,6 +113,9 @@ class ReplLog:
             prev = uuid
         self._bytes += added
         self.last_uuid = prev
+        if self.on_append is not None:
+            for uuid, name, args in cmds:
+                self.on_append(uuid, name, args)
         while self._bytes > self.cap and len(entries) > 1:
             ev = entries.popleft()
             uuids.popleft()

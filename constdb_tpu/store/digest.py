@@ -204,6 +204,20 @@ def state_digest_matrix(ks: KeySpace, fanout: int,
     return flat.reshape(fanout, leaves)
 
 
+def full_state_digest(ks: KeySpace, fanout: int = 0,
+                      leaves: int = 1) -> int:
+    """One 64-bit digest of the whole logical state: the matrix folded
+    to a scalar (mod-2^64 sum, so it is geometry-independent — every
+    (fanout, leaves) layout of the same state sums to the same value).
+    The chaos oracle's digest-agreement law and the resync bench both
+    compare replicas through this; same flush-first caveat as
+    `state_digest_matrix`."""
+    if fanout <= 0:
+        fanout = DIGEST_FANOUT
+    m = state_digest_matrix(ks, fanout, leaves)
+    return int(m.sum(dtype=_U64))
+
+
 def _key_accum(ks: KeySpace) -> np.ndarray:
     """Per-kid uint64 content stamp: each live key's total contribution
     to its digest bucket (envelope row + counter slots + live element

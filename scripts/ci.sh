@@ -156,6 +156,20 @@ print("tensor smoke verified:",
 EOF
 
 echo
+echo "== chaos smoke (fixed-seed certification cells) =="
+# the scripted chaos scenario — partitions + reorder + duplication +
+# mid-frame truncation + connection/process kills + clock jitter + one
+# mixed-version peer — on one representative capability cell per fast
+# path (everything-on, everything-off, resident engine, sharded
+# serving), with the full invariant oracle verified: convergence to the
+# CPU-engine reference, digest agreement, watermark monotonicity,
+# no-resurrection, GC drain, and loud demotion accounting.  Fixed seed:
+# a failure here replays exactly (the full matrix + randomized soak are
+# slow-marked in tests/test_chaos.py).
+JAX_PLATFORMS=cpu timeout -k 10 420 python -m constdb_tpu.chaos --seed 7 \
+    || exit $?
+
+echo
 echo "== tier-1 tests + slow-marker audit =="
 ./scripts/audit_markers.sh "$@" || exit $?
 

@@ -1,23 +1,27 @@
-"""In-process cluster harness for replication tests.
+"""In-process cluster helpers for replication tests.
 
 The reference tests multi-node behavior black-box against live processes
-driven by a client with a local oracle (reference bin/test.rs, SURVEY.md §4).
-This harness keeps the black-box client-over-TCP shape but runs every node
-in ONE asyncio loop and replaces convergence *sleeps* with convergence
-*polling* on canonical state — deterministic and fast.
+driven by a client with a local oracle (reference bin/test.rs, SURVEY.md
+§4).  These helpers keep the black-box client-over-TCP shape but run
+every node in ONE asyncio loop and replace convergence *sleeps* with
+convergence *polling* on canonical state — deterministic and fast.
+
+Since round 15 the heavy machinery lives in `constdb_tpu/chaos/` (the
+fault-injecting certification harness): the RESP `Client` and the FAST
+cadence knobs are re-exported from `chaos.cluster`, and crash/restart
+are ChaosCluster scenario primitives (`restart_cold`/`restart_warm`)
+instead of per-test helpers.  This module keeps the thin plain-apps
+surface the replication suites drive (`make_cluster` over a list of
+ServerApps + converge/full_mesh polling).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
 
-from constdb_tpu.resp.codec import RespParser, encode_msg
-from constdb_tpu.resp.message import Arr, Bulk, Msg
+from constdb_tpu.chaos.cluster import FAST, Client  # noqa: F401 (re-export)
 from constdb_tpu.server.io import ServerApp, start_node
 from constdb_tpu.server.node import Node
-
-FAST = dict(heartbeat=0.15, reconnect_delay=0.25, gc_interval=0.2)
 
 
 async def make_cluster(n: int, work_dir: str, engine=None,
@@ -35,42 +39,6 @@ async def make_cluster(n: int, work_dir: str, engine=None,
 async def close_cluster(apps) -> None:
     for app in apps:
         await app.close()
-
-
-class Client:
-    """Minimal RESP client (the reference's constdb-cli/test transport)."""
-
-    def __init__(self) -> None:
-        self.reader: Optional[asyncio.StreamReader] = None
-        self.writer: Optional[asyncio.StreamWriter] = None
-        self.parser = RespParser()
-
-    async def connect(self, addr: str) -> "Client":
-        host, port = addr.rsplit(":", 1)
-        self.reader, self.writer = await asyncio.open_connection(host, int(port))
-        return self
-
-    async def cmd(self, *parts) -> Msg:
-        items = [Bulk(p if isinstance(p, bytes) else str(p).encode())
-                 for p in parts]
-        self.writer.write(encode_msg(Arr(items)))
-        await self.writer.drain()
-        while True:
-            msg = self.parser.next_msg()
-            if msg is not None:
-                return msg
-            data = await asyncio.wait_for(self.reader.read(1 << 16), 10.0)
-            if not data:
-                raise ConnectionError("EOF")
-            self.parser.feed(data)
-
-    async def close(self) -> None:
-        if self.writer is not None:
-            self.writer.close()
-            try:
-                await self.writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
 
 
 async def converge(apps, timeout: float = 15.0, poll: float = 0.05) -> None:

@@ -1,225 +1,139 @@
-"""Chaos convergence: randomized concurrent writes with repeated node
-crashes and restarts (cold from snapshot or warm in-memory) must still
-converge to the oracle.
+"""Chaos certification suite (constdb_tpu/chaos/).
 
-This extends the reference's randomized-workload strategy (reference
-bin/test.rs:131-144, SURVEY.md §4) with the failure dimension §5.3 calls
-for: nodes leave mid-stream, lose their process state, boot-restore from
-their last snapshot, and rejoin through partial OR full resync depending
-on what the survivors' repl-logs still cover.
+The old randomized crash/restart loop grew into the first-class harness:
+scenarios are seed + capability cell + scripted fault/op schedule, the
+crash styles are the two ChaosCluster primitives (`restart_cold` boots
+from a real snapshot through io.py's restore path, `restart_warm`
+rebuilds the server over the surviving Node), and the invariant oracle
+replaces the hand-rolled client-side expectations: convergence to the
+CPU-engine reference export, continuous watermark/beacon monotonicity,
+digest agreement, no-resurrection, GC drain, and loud fault accounting.
+
+Tier-1 runs compact deterministic scenarios; the full capability matrix
+and the randomized soak are slow-marked.  Every failure message carries
+`[chaos seed=N cell=…]` — the replay seed IS the repro.
 """
 
 from __future__ import annotations
 
 import asyncio
-import os
-import random
 
 import pytest
 
-from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
-from constdb_tpu.resp.message import Arr, Int
-from constdb_tpu.server.io import ServerApp, start_node
-from constdb_tpu.server.node import Node
-
-from cluster_util import Client, close_cluster, converge, make_cluster, FAST
-
-
-async def _restart_cold(app: ServerApp, work_dir: str) -> ServerApp:
-    """Crash + cold boot: dump the node's state, close, then build a FRESH
-    Node restored from the snapshot on the same port (the subprocess path
-    start_node uses — io.py boot restore)."""
-    old = app.node
-    snap = os.path.join(work_dir, f"chaos.{old.node_id}.snapshot")
-    old.ensure_flushed()
-    dump_keyspace(snap, old.ks,
-                  NodeMeta(node_id=old.node_id, alias=old.alias,
-                           repl_last_uuid=old.repl_log.last_uuid),
-                  old.replicas.records())
-    port = app.port
-    await app.close()
-    node = Node(node_id=old.node_id, alias=old.alias)
-    return await start_node(node, host="127.0.0.1", port=port,
-                            work_dir=work_dir, snapshot_path=snap, **FAST)
+from constdb_tpu.chaos import (Cell, ChaosCluster, NodeSpec, Scenario,
+                               certify_scenario, matrix_cells,
+                               run_scenario, soak_scenario)
+from constdb_tpu.chaos.cluster import Client
+from constdb_tpu.resp.message import Nil
 
 
-async def _restart_warm(app: ServerApp, work_dir: str) -> ServerApp:
-    """Close the server but keep the Node object (process hiccup: state
-    survives, connections do not)."""
-    port = app.port
-    await app.close()
-    app2 = ServerApp(app.node, host="127.0.0.1", port=port,
-                     work_dir=work_dir, **FAST)
-    await app2.start()
-    return app2
+def test_certify_default_cell(tmp_path):
+    """The acceptance schedule (partitions + reorder + duplication +
+    mid-frame truncation + kills + cold/warm crashes + clock jitter +
+    wire corruption + one mixed-version peer) on the everything-on
+    cell, full oracle verified."""
+    stats = run_scenario(certify_scenario(7, Cell()))
+    plane = stats["plane"]
+    # the schedule really injected the faults it promises
+    assert plane.get("partitions", 0) >= 3
+    assert plane.get("truncations", 0) == 1
+    assert plane.get("wire_corruptions", 0) == 1
+    assert stats["reconnects"] >= 1
 
 
-def _chaos_run(tmp_path, seed, rounds=6, ops_per_round=40,
-               repl_log_cap=1_024_000, converge_timeout=45.0):
-    """One randomized chaos run: bursts of mixed writes (counters, sets,
-    hashes, deletes) across whichever nodes are up, with crash/restart
-    between bursts (cold from snapshot or warm in-memory), then full
-    convergence against a client-side oracle — the reference's randomized
-    black-box strategy (bin/test.rs:131-144) plus the failure dimension.
-    A small repl_log_cap forces the partial-vs-full resync decision both
-    ways across the run."""
-    async def main():
-        rng = random.Random(seed)
-        apps = await make_cluster(3, str(tmp_path),
-                                  repl_log_cap=repl_log_cap)
-        try:
-            c0 = await Client().connect(apps[0].advertised_addr)
-            for other in apps[1:]:
-                await c0.cmd("meet", other.advertised_addr)
-            await converge(apps)
-            await c0.close()
-
-            oracle_counts: dict[str, int] = {}
-            oracle_sets: dict[str, set] = {}
-            oracle_hash: dict[str, dict] = {}
-            deleted: set = set()
-            for round_no in range(rounds):
-                # a burst of writes spread over whichever nodes are up
-                clients = [await Client().connect(a.advertised_addr)
-                           for a in apps]
-                for i in range(ops_per_round):
-                    c = rng.choice(clients)
-                    die = rng.random()
-                    if die < 0.4:
-                        k = f"cnt{rng.randrange(8)}"
-                        await c.cmd("incr", k)
-                        oracle_counts[k] = oracle_counts.get(k, 0) + 1
-                    elif die < 0.7:
-                        k = f"set{rng.randrange(8)}"
-                        m = f"m{round_no}-{i}"
-                        await c.cmd("sadd", k, m)
-                        oracle_sets.setdefault(k, set()).add(m)
-                    elif die < 0.85:
-                        k = f"h{rng.randrange(4)}"
-                        f, v = f"f{rng.randrange(6)}", f"v{round_no}-{i}"
-                        await c.cmd("hset", k, f, v)
-                        oracle_hash.setdefault(k, {})[f] = v
-                    elif die < 0.95 and oracle_sets:
-                        # remove a member (tombstone traffic) — but only if
-                        # it is VISIBLE on the issuing node: removing a
-                        # not-yet-replicated member mints a delete uuid the
-                        # node's HLC never ordered after the add, so
-                        # add-wins legitimately beats it and a client-side
-                        # oracle cannot model that race
-                        k = rng.choice(sorted(oracle_sets))
-                        if oracle_sets[k]:
-                            m = rng.choice(sorted(oracle_sets[k]))
-                            got = await c.cmd("smembers", k)
-                            if isinstance(got, Arr) and \
-                                    any(b.val.decode() == m
-                                        for b in got.items):
-                                await c.cmd("srem", k, m)
-                                oracle_sets[k].discard(m)
-                    else:
-                        k = f"reg{rng.randrange(6)}"
-                        await c.cmd("set", k, f"d{round_no}-{i}")
-                        await c.cmd("del", k)
-                        deleted.add(k)
-                for c in clients:
-                    await c.close()
-
-                # crash / restart one node (skip some rounds)
-                victim = rng.randrange(len(apps))
-                style = rng.random()
-                if style < 0.4:
-                    apps[victim] = await _restart_cold(apps[victim],
-                                                       str(tmp_path))
-                elif style < 0.8:
-                    apps[victim] = await _restart_warm(apps[victim],
-                                                       str(tmp_path))
-                await asyncio.sleep(0.1)
-
-            await converge(apps, timeout=converge_timeout)
-            # converged state must equal the oracle on EVERY node, and GC
-            # must actually collect once the horizon passes the tombstones
-            for app in apps:
-                c = await Client().connect(app.advertised_addr)
-                for k, want in oracle_counts.items():
-                    assert await c.cmd("get", k) == Int(want), (k, app.port)
-                for k, want in oracle_sets.items():
-                    got = await c.cmd("smembers", k)
-                    assert {b.val.decode() for b in got.items} == want, k
-                for k, want in oracle_hash.items():
-                    got = await c.cmd("hgetall", k)
-                    pairs = {p.items[0].val.decode(): p.items[1].val.decode()
-                             for p in got.items}
-                    assert pairs == want, (k, app.port)
-                for k in deleted:
-                    from constdb_tpu.resp.message import Nil
-                    assert isinstance(await c.cmd("get", k), Nil), k
-                await c.close()
-            # GC-drained assertion: every peer has acked the full stream at
-            # convergence, so the horizon passes every tombstone — a few GC
-            # cycles must empty the garbage heap (collection really ran,
-            # not merely deferred — VERDICT r4 item 9)
-            deadline = asyncio.get_running_loop().time() + 10.0
-            while any(len(a.node.ks.garbage) for a in apps):
-                for a in apps:
-                    a.node.gc()
-                if asyncio.get_running_loop().time() > deadline:
-                    raise AssertionError(
-                        "garbage heap not drained: "
-                        + str([len(a.node.ks.garbage) for a in apps]))
-                await asyncio.sleep(0.2)
-        finally:
-            await close_cluster(apps)
-    asyncio.run(main())
+def test_certify_legacy_cell(tmp_path):
+    """Everything-off cell: per-frame wire, full snapshots only — the
+    pure pre-capability paths under the same chaos schedule."""
+    run_scenario(certify_scenario(7, Cell(wire=False, delta=False)))
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
-def test_chaos_restarts_converge(tmp_path, seed):
-    _chaos_run(tmp_path, seed)
+def test_certify_replays_from_seed(tmp_path):
+    """Determinism pin: the same seed replays the same decision stream —
+    identical journaled op set and identical converged state."""
+    a = run_scenario(certify_scenario(21, Cell(wire=False, delta=False)))
+    b = run_scenario(certify_scenario(21, Cell(wire=False, delta=False)))
+    assert a["journal_ops"] == b["journal_ops"]
+    assert a["canonical_keys"] == b["canonical_keys"]
+
+
+def test_crash_styles_converge(tmp_path):
+    """The two crash primitives back to back — cold (snapshot boot,
+    in-memory watermarks/undo log lost) and warm (connections only) —
+    with writes in between; the oracle still certifies."""
+    steps = [
+        ("ops", 40),
+        ("crash", 1, "cold"),
+        ("ops", 40),
+        ("crash", 0, "warm"),
+        ("ops", 40),
+        ("crash", 2, "cold"),
+        ("ops", 20),
+        ("certify",),
+    ]
+    run_scenario(Scenario(seed=5, steps=steps))
+
+
+@pytest.mark.slow
+def test_certify_full_matrix(tmp_path):
+    """Acceptance: the scripted scenario passes the full invariant
+    oracle on EVERY capability-matrix cell (wire batch on/off, delta
+    sync on/off, serve shards 1/2, resident engine 0/1)."""
+    for cell in matrix_cells():
+        run_scenario(certify_scenario(11, cell, ops=25))
+
+
+@pytest.mark.slow
+def test_chaos_soak_randomized(tmp_path):
+    """Randomized soak: seeded schedules over the default cell.  A
+    failure prints `[chaos seed=N]`; `soak_scenario(N)` replays it."""
+    for seed in (99, 1, 2):
+        run_scenario(soak_scenario(seed))
 
 
 def test_cold_restart_does_not_resurrect_collected_tombstones(tmp_path):
-    """Regression (round-5 chaos find): a cold-restarted node must resume
-    pulling each peer from its SNAPSHOT-RECORDED watermark.  With the
-    watermark lost (resume 0), peers replay their whole repl_log ring —
-    including ADDS whose tombstones the mesh already GC-collected — and
-    the deleted member resurrects with no surviving delete op anywhere.
-    Requires: add on A, remove propagated + collected everywhere, THEN a
-    cold restart of B followed by A's ring replay."""
+    """Regression (round-5 chaos find): a cold-restarted node must
+    resume pulling each peer from its SNAPSHOT-RECORDED watermark.
+    With the watermark lost (resume 0), peers replay their whole
+    repl_log ring — including ADDS whose tombstones the mesh already
+    GC-collected — and the deleted member resurrects with no surviving
+    delete op anywhere."""
     async def main():
-        from constdb_tpu.resp.message import Nil
-
-        apps = await make_cluster(2, str(tmp_path))
+        cluster = ChaosCluster(str(tmp_path), seed=1,
+                               specs=[NodeSpec(), NodeSpec()])
+        await cluster.start()
         try:
-            a, b = apps
+            a, b = cluster.apps
             ca = await Client().connect(a.advertised_addr)
             cb = await Client().connect(b.advertised_addr)
             await ca.cmd("meet", b.advertised_addr)
-            await converge(apps)
+            await cluster.converge()
             await ca.cmd("sadd", "s", "gone")
             await ca.cmd("sadd", "s", "keep")
-            await converge(apps)
+            await cluster.converge()
             # the REMOVE originates on B — the node about to lose its
-            # repl_log: after the restart no log anywhere holds the delete,
-            # while A's ring still holds the add
+            # repl_log: after the restart no log anywhere holds the
+            # delete, while A's ring still holds the add
             await cb.cmd("srem", "s", "gone")
             await cb.close()
-            await converge(apps)
+            await cluster.converge()
             # wait until BOTH nodes physically collected the tombstone
             deadline = asyncio.get_running_loop().time() + 10.0
             while True:
-                for app in apps:
+                for app in cluster.apps:
                     app.node.gc()
                 if all(len(app.node.ks.garbage) == 0 and
                        app.node.ks.el_row(app.node.ks.lookup(b"s"),
-                                          b"gone") < 0 for app in apps):
+                                          b"gone") < 0
+                       for app in cluster.apps):
                     break
                 assert asyncio.get_running_loop().time() < deadline, \
                     "tombstone never collected"
                 await asyncio.sleep(0.1)
             # cold-restart B; A's ring still holds the original SADD op
             assert a.node.repl_log.first_uuid <= a.node.repl_log.last_uuid
-            apps[1] = await _restart_cold(apps[1], str(tmp_path))
-            await converge(apps, timeout=15.0)
-            for app in apps:
+            await cluster.restart_cold(1)
+            await cluster.converge(timeout=15.0)
+            for app in cluster.apps:
                 c = await Client().connect(app.advertised_addr)
                 got = await c.cmd("smembers", "s")
                 members = ({i.val for i in got.items}
@@ -228,15 +142,157 @@ def test_cold_restart_does_not_resurrect_collected_tombstones(tmp_path):
                 await c.close()
             await ca.close()
         finally:
-            await close_cluster(apps)
+            await cluster.close()
     asyncio.run(main())
 
 
-@pytest.mark.skipif(not os.environ.get("CONSTDB_SLOW"),
-                    reason="set CONSTDB_SLOW=1 for the chaos soak")
-def test_chaos_soak(tmp_path):
-    """Long randomized soak: 25 restart cycles over 5000 mixed ops, with a
-    repl_log small enough that full AND partial resyncs both occur many
-    times (reference bin/test.rs randomized-workload scale)."""
-    _chaos_run(tmp_path, seed=99, rounds=25, ops_per_round=200,
-               repl_log_cap=4_000, converge_timeout=90.0)
+def test_coverage_gates_third_party_tombstone_collection(tmp_path):
+    """Regression (round-15 chaos find #1): node B must NOT collect a
+    tombstone that originated on node C while node A — partitioned from
+    C — has not seen the delete, even though A's acks of B's OWN stream
+    are far past it.  The REPLACK cluster-coverage field (item 5) is
+    what pins B's horizon; without it, a later state transfer from B to
+    A adopts C's watermark over a delete A never applied and the member
+    resurrects mesh-wide."""
+    from constdb_tpu.chaos import FaultPlane
+
+    async def main():
+        plane = FaultPlane(3)
+        cluster = ChaosCluster(str(tmp_path), seed=3,
+                               specs=[NodeSpec()] * 3, plane=plane)
+        await cluster.start()
+        try:
+            a, b, c = cluster.apps
+            cl = await Client().connect(a.advertised_addr)
+            await cl.cmd("meet", b.advertised_addr)
+            await cl.cmd("meet", c.advertised_addr)
+            await cl.close()
+            await cluster.converge()
+            cc = await Client().connect(c.advertised_addr)
+            await cc.cmd("sadd", "s", "m")
+            await cluster.converge()
+            # A loses C; C removes the member — only B applies it
+            plane.partition(0, 2)
+            await cc.cmd("srem", "s", "m")
+            await cc.close()
+
+            def b_has_tombstone():
+                ks = b.node.ks
+                kid = ks.lookup(b"s")
+                row = ks.el_row(kid, b"m") if kid >= 0 else -1
+                return row >= 0 and \
+                    int(ks.el.del_t[row]) > int(ks.el.add_t[row])
+
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while not b_has_tombstone():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            # keep B's view of A's OWN stream fresh (A writes, B acks
+            # flow) so the ack-only horizon WOULD pass the delete
+            ca = await Client().connect(a.advertised_addr)
+            for i in range(5):
+                await ca.cmd("set", "tick", f"v{i}")
+                await asyncio.sleep(0.2)
+                b.node.gc()
+                assert b_has_tombstone(), \
+                    "B collected a third-party tombstone A never saw"
+            await ca.close()
+            # heal: C delivers the delete to A; only then may B collect
+            plane.heal()
+            await cluster.converge(timeout=20.0)
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while b_has_tombstone():
+                b.node.gc()
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "B never collected after full coverage"
+                await asyncio.sleep(0.1)
+        finally:
+            await cluster.close()
+    asyncio.run(main())
+
+
+def test_backoff_delay_deterministic_and_bounded():
+    """The reconnect ladder: exponential growth to the ceiling, and
+    jitter that is a pure function of (node, peer, attempt) — chaos
+    replays depend on it."""
+    from constdb_tpu.replica.link import backoff_delay
+
+    raw = [backoff_delay(0.2, 2.0, 5.0, 0.0, 1, "a:1", n)
+           for n in range(12)]
+    assert raw == sorted(raw)
+    assert raw[0] == 0.2 and raw[-1] == 5.0
+    jit = [backoff_delay(0.2, 2.0, 5.0, 0.2, 1, "a:1", n)
+           for n in range(12)]
+    assert jit == [backoff_delay(0.2, 2.0, 5.0, 0.2, 1, "a:1", n)
+                   for n in range(12)]  # deterministic
+    assert all(0.8 * r <= j <= 1.2 * r + 1e-9
+               for r, j in zip(raw, jit))
+    # distinct nodes de-synchronize against the same returned peer
+    assert backoff_delay(1, 2, 60, 0.2, 1, "a:1", 3) != \
+        backoff_delay(1, 2, 60, 0.2, 2, "a:1", 3)
+
+
+def test_info_reports_link_state_and_reconnects(tmp_path):
+    """Satellite: the previously-implicit retry cadence is observable —
+    INFO carries repl_link_state + repl_reconnects, and a killed
+    connection shows up in both."""
+    from constdb_tpu.chaos import FaultPlane
+
+    async def main():
+        plane = FaultPlane(5)
+        cluster = ChaosCluster(str(tmp_path), seed=5,
+                               specs=[NodeSpec(), NodeSpec()],
+                               plane=plane)
+        await cluster.start()
+        try:
+            a, b = cluster.apps
+            c = await Client().connect(a.advertised_addr)
+            await c.cmd("meet", b.advertised_addr)
+            await cluster.full_mesh()
+            assert plane.kill_connections(0, 1) >= 1
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while a.node.stats.repl_reconnects + \
+                    b.node.stats.repl_reconnects < 1:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "no reconnect counted after a connection kill"
+                await asyncio.sleep(0.05)
+            await cluster.full_mesh(timeout=15.0)
+            info = (await c.cmd("info", "replication")).val.decode()
+            assert "repl_link_state" in info
+            assert "state=connected" in info
+            assert "reconnects=" in info
+            stats = (await c.cmd("info", "stats")).val.decode()
+            assert "repl_reconnects:" in stats
+            await c.close()
+        finally:
+            await cluster.close()
+    asyncio.run(main())
+
+
+def test_replack_carries_cluster_coverage(tmp_path):
+    """Wire pin for the coverage field: after a converged exchange both
+    peers hold a non-negative coverage for each other (legacy peers
+    stay at -1 and keep the ack-only horizon)."""
+    async def main():
+        cluster = ChaosCluster(str(tmp_path), seed=4,
+                               specs=[NodeSpec(), NodeSpec()])
+        await cluster.start()
+        try:
+            a, b = cluster.apps
+            cl = await Client().connect(a.advertised_addr)
+            await cl.cmd("meet", b.advertised_addr)
+            await cl.cmd("set", "k", "v")
+            await cl.close()
+            await cluster.converge()
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while True:
+                covs = [m.coverage
+                        for app in cluster.apps
+                        for m in app.node.replicas.peers.values()]
+                if covs and all(c >= 0 for c in covs):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, covs
+                await asyncio.sleep(0.05)
+        finally:
+            await cluster.close()
+    asyncio.run(main())

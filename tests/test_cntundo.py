@@ -1,0 +1,181 @@
+"""Counter UNDO (`CNTUNDO key [uuid]`) — the one sound CRDT undo.
+
+Grounded in "The Only Undoable CRDTs are Counters" (PAPERS.md, arXiv
+2006.10494): a PN-counter step's inverse is just the negated delta, and
+since slots are single-writer LWW registers the ORIGIN can apply it as
+a fresh write that commutes with everything concurrent.  The inverse
+replicates as an ordinary absolute-total `cntset`, so it rides every
+fast path like any increment; no other family is undoable (an element
+re-add is a NEW add, not an un-remove) and the command says so.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from constdb_tpu.resp.message import Bulk, Err, Int
+from constdb_tpu.server.node import CounterUndoLog, Node
+
+
+def ex(node, *parts):
+    return node.execute([Bulk(p if isinstance(p, bytes) else
+                              str(p).encode()) for p in parts])
+
+
+def test_undo_is_exact_inverse():
+    node = Node(node_id=1)
+    assert ex(node, "incr", "k") == Int(1)
+    assert ex(node, "incr", "k", 5) == Int(6)
+    # stack undo walks USER ops newest-first (never the inverses)
+    assert ex(node, "cntundo", "k") == Int(1)
+    u_inverse = node.hlc.current  # the undo op's own uuid
+    assert ex(node, "cntundo", "k") == Int(0)
+    r = ex(node, "cntundo", "k")
+    assert isinstance(r, Err)  # no user op left
+    # undo of an undo — REDO — takes the inverse op's explicit uuid
+    assert ex(node, "cntundo", "k", u_inverse) == Int(5)
+
+
+def test_undo_by_explicit_uuid_and_errors():
+    node = Node(node_id=1)
+    ex(node, "incr", "k")
+    u1 = node.hlc.current
+    ex(node, "incr", "k", 10)
+    # undo the FIRST op by uuid, not the newest
+    assert ex(node, "cntundo", "k", u1) == Int(10)
+    # double-undo of the same op is rejected cleanly
+    r = ex(node, "cntundo", "k", u1)
+    assert isinstance(r, Err) and b"already undone" in r.val
+    # unknown uuid
+    r = ex(node, "cntundo", "k", 12345)
+    assert isinstance(r, Err) and b"unknown, remote, or evicted" in r.val
+    # key mismatch: a real op uuid against the wrong key
+    ex(node, "incr", "other")
+    u3 = node.hlc.current
+    r = ex(node, "cntundo", "k", u3)
+    assert isinstance(r, Err)
+
+
+def test_undo_rejected_on_non_counter_families():
+    node = Node(node_id=1)
+    ex(node, "set", "reg", "v")
+    r = ex(node, "cntundo", "reg")
+    assert isinstance(r, Err) and b"only sound for counters" in r.val
+    ex(node, "sadd", "s", "m")
+    r = ex(node, "cntundo", "s", 1)
+    assert isinstance(r, Err) and b"only sound for counters" in r.val
+
+
+def test_undo_window_evicts_fifo():
+    log = CounterUndoLog(cap=2)
+    log.record(1, b"k", 1)
+    log.record(2, b"k", 2)
+    log.record(3, b"q", 3)  # evicts uuid 1
+    assert log.resolve(b"k", 1) is None
+    assert log.resolve(b"k") == (2, 2)
+    assert log.resolve(b"q") == (3, 3)
+    log.mark_undone(2)
+    assert log.resolve(b"k") is None
+
+
+def test_undo_replicates_and_is_remote_rejected(tmp_path):
+    """The inverse converges mesh-wide like any write, and a REPLICA of
+    the op cannot undo it (single-writer slots: not its to invert)."""
+    from constdb_tpu.chaos import ChaosCluster, NodeSpec
+    from constdb_tpu.chaos.cluster import Client
+
+    async def main():
+        cluster = ChaosCluster(str(tmp_path), seed=2,
+                               specs=[NodeSpec(), NodeSpec()])
+        await cluster.start()
+        try:
+            a, b = cluster.apps
+            ca = await Client().connect(a.advertised_addr)
+            await ca.cmd("meet", b.advertised_addr)
+            assert await ca.cmd("incr", "k", 7) == Int(7)
+            await cluster.converge()
+            # B holds the replicated total but NOT the op: remote undo
+            # is cleanly rejected
+            cb = await Client().connect(b.advertised_addr)
+            r = await cb.cmd("cntundo", "k")
+            assert isinstance(r, Err)
+            # the origin undoes; the inverse replicates as cntset
+            assert await ca.cmd("cntundo", "k") == Int(0)
+            await cluster.converge()
+            assert await cb.cmd("get", "k") == Int(0)
+            await ca.close()
+            await cb.close()
+        finally:
+            await cluster.close()
+    asyncio.run(main())
+
+
+def test_undo_plans_through_serve_coalescer(tmp_path):
+    """A pipelined chunk mixing INCR and CNTUNDO rides the serve
+    planner (no barrier demotion for the valid case), with replies
+    byte-identical to the per-command path's values."""
+    from constdb_tpu.chaos import ChaosCluster, NodeSpec
+    from constdb_tpu.chaos.cluster import Client
+    from constdb_tpu.resp.codec import encode_msg
+    from constdb_tpu.resp.message import Arr
+
+    async def main():
+        cluster = ChaosCluster(str(tmp_path), seed=3, specs=[NodeSpec()])
+        await cluster.start()
+        try:
+            app = cluster.apps[0]
+            c = await Client().connect(app.advertised_addr)
+            buf = bytearray()
+            for parts in ((b"incr", b"k", b"3"), (b"incr", b"k", b"4"),
+                          (b"cntundo", b"k"), (b"incr", b"k", b"10")):
+                buf += encode_msg(Arr([Bulk(p) for p in parts]))
+            c.writer.write(bytes(buf))
+            await c.writer.drain()
+            replies = []
+            while len(replies) < 4:
+                msg = c.parser.next_msg()
+                if msg is not None:
+                    replies.append(msg)
+                    continue
+                data = await asyncio.wait_for(c.reader.read(1 << 16), 10.0)
+                c.parser.feed(data)
+            # 3, 7, undo(-4) -> 3, +10 -> 13
+            assert replies == [Int(3), Int(7), Int(3), Int(13)], replies
+            assert await c.cmd("get", "k") == Int(13)
+            # the whole chunk coalesced: one flush, no barriers for the
+            # plannable run (serve_barriers counts only real demotions)
+            assert app.node.stats.serve_msgs_coalesced >= 4
+            await c.close()
+        finally:
+            await cluster.close()
+    asyncio.run(main())
+
+
+def test_undo_survives_warm_restart_not_cold(tmp_path):
+    """The undo log is process state: a warm restart keeps it, a cold
+    restart loses it and the op reports 'evicted' — never a wrong
+    inverse."""
+    from constdb_tpu.chaos import ChaosCluster, NodeSpec
+    from constdb_tpu.chaos.cluster import Client
+
+    async def main():
+        cluster = ChaosCluster(str(tmp_path), seed=4, specs=[NodeSpec()])
+        await cluster.start()
+        try:
+            c = await Client().connect(cluster.apps[0].advertised_addr)
+            assert await c.cmd("incr", "k", 5) == Int(5)
+            await c.close()
+            await cluster.restart_warm(0)
+            c = await Client().connect(cluster.apps[0].advertised_addr)
+            assert await c.cmd("cntundo", "k") == Int(0)
+            assert await c.cmd("incr", "k", 9) == Int(9)
+            await c.close()
+            await cluster.restart_cold(0)
+            c = await Client().connect(cluster.apps[0].advertised_addr)
+            assert await c.cmd("get", "k") == Int(9)
+            r = await c.cmd("cntundo", "k")
+            assert isinstance(r, Err)
+            await c.close()
+        finally:
+            await cluster.close()
+    asyncio.run(main())

@@ -97,6 +97,24 @@ def _digest_of(ks: KeySpace, fanout: int = 16, leaves: int = 8):
     return D.state_digest_matrix(ks, fanout, leaves)
 
 
+def test_full_state_digest_is_geometry_independent():
+    """The scalar fold (the chaos oracle's digest-agreement law and the
+    resync bench's cross-check) is the mod-2^64 sum of the matrix, so
+    every (fanout, leaves) layout of one state agrees — and two states
+    that differ by one write do not."""
+    ks = KeySpace()
+    _apply_ops(ks, _mixed_ops())
+    want = D.full_state_digest(ks)
+    for fanout, leaves in ((1, 1), (4, 2), (16, 8), (64, 1)):
+        assert D.full_state_digest(ks, fanout, leaves) == want
+    other = KeySpace()
+    _apply_ops(other, _mixed_ops())
+    assert D.full_state_digest(other) == want  # same ops, same state
+    kid, _ = other.get_or_create(b"extra", S.ENC_COUNTER, 77 << 22)
+    other.counter_change(kid, 9, 1, 77 << 22)
+    assert D.full_state_digest(other) != want
+
+
 # --------------------------------------------------------------------------
 # digest determinism: one logical state, many construction routes
 

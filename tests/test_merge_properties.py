@@ -214,6 +214,136 @@ def test_type_conflict_skipped(engine):
     assert a.counter_sum(a.lookup(b"k")) == 1  # local survives
 
 
+# --------------------------------------------------------------------
+# Faulted delivery orders through the REAL apply path (round 15): the
+# merge laws above hold for state merges; these replay them through the
+# CoalescingApplier — the machinery a chaotic mesh actually drives —
+# under every delivery shape the transport contract admits: arbitrary
+# cross-origin interleavings (each origin's stream in order), arbitrary
+# coalescing batch sizes, and whole-stream REDELIVERY (the reconnect
+# window, delivered twice through a fresh applier).  One fixpoint.
+# --------------------------------------------------------------------
+
+def _origin_streams(seed: int, n_origins: int = 3, n_ops: int = 80):
+    """Per-origin replication-rewrite streams (gap-free, increasing
+    uuids per origin; uuid ranges overlap ACROSS origins so LWW ties
+    and interleaved wins actually happen).  Only commuting rewrites —
+    the delivered-set semantics the chaos oracle's reference relies on."""
+    from constdb_tpu.resp.message import Bulk as B, Int as I
+
+    rng = random.Random(seed)
+    streams = []
+    for o in range(1, n_origins + 1):
+        ticks = sorted(rng.sample(range(1, n_ops * 8), n_ops))
+        prev = 0
+        ops = []
+        totals: dict[bytes, int] = {}
+        for t in ticks:
+            uuid = (t << 22) | o  # distinct across origins, sorted within
+            k = rng.random()
+            if k < 0.3:
+                key = b"cnt:%d" % rng.randrange(4)
+                totals[key] = totals.get(key, 0) + rng.choice([1, -1, 3])
+                frame = (b"cntset", [B(key), I(totals[key])])
+            elif k < 0.5:
+                frame = (b"set", [B(b"reg:%d" % rng.randrange(4)),
+                                  B(b"v%d:%d" % (o, t))])
+            elif k < 0.65:
+                frame = (b"sadd", [B(b"set:%d" % rng.randrange(3)),
+                                   B(b"m%d" % rng.randrange(8))])
+            elif k < 0.75:
+                frame = (b"srem", [B(b"set:%d" % rng.randrange(3)),
+                                   B(b"m%d" % rng.randrange(8))])
+            elif k < 0.9:
+                frame = (b"hset", [B(b"h:%d" % rng.randrange(3)),
+                                   B(b"f%d" % rng.randrange(4)),
+                                   B(b"w%d:%d" % (o, t))])
+            else:
+                frame = (b"delbytes", [B(b"reg:%d" % rng.randrange(4))])
+            ops.append((uuid, prev, frame[0], frame[1]))
+            prev = uuid
+        streams.append((o, ops))
+    return streams
+
+
+def _deliver(streams, interleave_rng, batch: int,
+             redeliver: bool = False):
+    """One delivery run: a fresh node pulls every origin stream through
+    its own CoalescingApplier in a seeded cross-origin interleaving."""
+    from constdb_tpu.replica.coalesce import CoalescingApplier
+    from constdb_tpu.replica.manager import ReplicaMeta
+    from constdb_tpu.resp.message import Bulk as B, Int as I
+    from constdb_tpu.server.node import Node
+
+    node = Node(node_id=99)
+
+    def run_once():
+        appliers = {}
+        for o, _ops in streams:
+            meta = ReplicaMeta(addr=f"origin-{o}")
+            appliers[o] = CoalescingApplier(node, meta, max_frames=batch)
+        cursors = {o: 0 for o, _ in streams}
+        by_origin = dict(streams)
+        while True:
+            live = [o for o in cursors if cursors[o] < len(by_origin[o])]
+            if not live:
+                break
+            o = live[interleave_rng.randrange(len(live))]
+            uuid, prev, name, args = by_origin[o][cursors[o]]
+            cursors[o] += 1
+            appliers[o].apply([B(b"replicate"), I(o), I(prev), I(uuid),
+                               B(name), *args])
+        for a in appliers.values():
+            a.flush()
+
+    run_once()
+    if redeliver:
+        # the reconnect window, at its widest: the WHOLE of every
+        # stream re-delivered through fresh appliers (fresh metas =
+        # watermark 0); every re-apply must be an idempotent merge
+        run_once()
+    return node.canonical()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_faulted_delivery_orders_converge(seed):
+    """Any interleaving x any coalescing granularity x full redelivery
+    = one canonical state, equal to the per-frame reference."""
+    streams = _origin_streams(seed)
+    want = _deliver(streams, random.Random(0), batch=1)
+    got = set()
+    for d_seed in range(3):
+        for batch in (1, 7, 512):
+            got.add(tuple(sorted(
+                _deliver(streams, random.Random(d_seed), batch).items())))
+    got.add(tuple(sorted(
+        _deliver(streams, random.Random(9), 64, redeliver=True).items())))
+    assert got == {tuple(sorted(want.items()))}
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_faulted_delivery_matches_state_merge(seed):
+    """The op-path fixpoint IS the state-merge fixpoint: delivering the
+    streams through the coalescer equals applying each origin's ops to
+    its own store and state-merging the stores (the certified-MRDT
+    correspondence the chaos oracle's reference replay rests on)."""
+    from constdb_tpu.server.node import Node
+
+    streams = _origin_streams(seed)
+    via_ops = _deliver(streams, random.Random(3), batch=16)
+    per_origin = []
+    for o, ops in streams:
+        n = Node(node_id=o)
+        for uuid, _prev, name, args in ops:
+            n.apply_replicated(name, args, o, uuid)
+        per_origin.append(n.ks)
+    engine = CpuMergeEngine()
+    acc = KeySpace()
+    for s in per_origin:
+        engine.merge(acc, batch_from_keyspace(s))
+    assert via_ops == acc.canonical()
+
+
 @pytest.mark.skipif(not os.environ.get("CONSTDB_SLOW"),
                     reason="set CONSTDB_SLOW=1 for the extended fuzz")
 def test_extended_differential_fuzz():
