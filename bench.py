@@ -1379,6 +1379,10 @@ def _serve_bench_server(pipe, serve_batch: int, engine_kind: str,
             "serve_flushes": st.serve_flushes,
             "serve_barriers": st.serve_barriers,
             "cmds_processed": st.cmds_processed,
+            "oom_shed_writes": st.oom_shed_writes,
+            "oom_hard_reclaims": st.oom_hard_reclaims,
+            "used_memory": node.governor.used_memory(),
+            "overload_state": node.governor.state_name,
             "serve_shards": serve_shards,
             "serve_xshard_barriers": x.get("serve_xshard_barriers", 0),
             "per_shard": {
@@ -1613,6 +1617,176 @@ def serve_main(args) -> None:
     }
     print(json.dumps(out))
     if not verified:
+        sys.exit(1)
+
+
+async def _overload_drive(port: int, per_conn: list, tallies: list,
+                          rtts: list) -> None:
+    """Pipelined driver that CLASSIFIES replies: (ok, oom, other_err)
+    per connection, with per-window reply latency sampled exactly like
+    _serve_drive — the latency of the non-shed traffic is the livelock
+    gauge (a wedged shedding path shows up here, not in the shed
+    count)."""
+    import asyncio
+
+    from constdb_tpu.resp.codec import make_parser
+    from constdb_tpu.resp.message import Err
+    from constdb_tpu.server.overload import OOM_ERR
+
+    async def one(chunks, tally, sink):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        parser = make_parser()
+        clock = time.perf_counter
+        # windows are driven synchronously per chunk (send, then read
+        # that window's replies) so the tally maps 1:1 onto windows and
+        # the server is never more than one window deep per connection —
+        # the firehose pressure comes from value size, not queue depth
+        try:
+            for data, n in chunks:
+                t0 = clock()
+                writer.write(data)
+                await writer.drain()
+                seen = 0
+                while seen < n:
+                    m = parser.next_msg()
+                    if m is not None:
+                        seen += 1
+                        if isinstance(m, Err):
+                            if bytes(m.val) == OOM_ERR:
+                                tally[1] += 1
+                            else:
+                                tally[2] += 1
+                        else:
+                            tally[0] += 1
+                        continue
+                    b = await asyncio.wait_for(reader.read(1 << 16), 30.0)
+                    if not b:
+                        raise ConnectionError("server EOF under overload")
+                    parser.feed(b)
+                sink.append(clock() - t0)
+        finally:
+            writer.close()
+
+    tallies.extend([0, 0, 0] for _ in per_conn)
+    sinks = [[] for _ in per_conn]
+    await asyncio.gather(*(one(c, t, s) for c, t, s
+                           in zip(per_conn, tallies, sinks)))
+    for s in sinks:
+        rtts.extend(s)
+
+
+def serve_overload_main(args) -> None:
+    """`bench.py --mode serve --overload`: the overload leg — a real
+    socket server with CONSTDB_MAXMEMORY set well below the workload's
+    footprint.  The node must SURVIVE the firehose: shed client data
+    writes with the exact -OOM error, keep serving the non-shed
+    traffic with bounded reply latency (no livelock), and keep its
+    accounting gauges consistent.  Emits ONE JSON line with the shed
+    rate, req/s over the whole mix, and reply-window p50/p99."""
+    import asyncio
+
+    n_ops = int(os.environ.get("CONSTDB_BENCH_OVL_OPS", 40_000))
+    n_conns = int(os.environ.get("CONSTDB_BENCH_SERVE_CONNS", 2))
+    pipeline = int(os.environ.get("CONSTDB_BENCH_SERVE_PIPELINE", 64))
+    val_len = int(os.environ.get("CONSTDB_BENCH_OVL_VAL", 256))
+    maxmem = int(os.environ.get("CONSTDB_BENCH_OVL_MAXMEM", 2 << 20))
+    engine_kind = os.environ.get("CONSTDB_BENCH_SERVE_ENGINE", "cpu")
+
+    ensure_native()
+    from constdb_tpu.resp.codec import encode_msg
+    from constdb_tpu.resp.message import Arr, Bulk
+
+    per_ops = n_ops // n_conns
+    footprint = n_ops * (val_len + 64)
+    print(f"[bench] overload workload: {n_ops} SETs x {val_len}B "
+          f"(~{footprint >> 20}MB footprint) vs maxmemory "
+          f"{maxmem >> 20}MB", file=sys.stderr)
+    per_conn = []
+    for ci in range(n_conns):
+        chunks = []
+        for lo in range(0, per_ops, pipeline):
+            n = min(pipeline, per_ops - lo)
+            # unique keys: the footprint must really GROW past the cap
+            # (a cycling key set converges to its working-set size)
+            chunks.append((b"".join(
+                encode_msg(Arr([Bulk(b"set"),
+                                Bulk(b"ovl:%d:%d" % (ci, lo + j)),
+                                Bulk(b"v" * val_len)]))
+                for j in range(n)), n))
+        per_conn.append(chunks)
+
+    # the forked server child inherits the env: the governor reads the
+    # cap at Node construction
+    os.environ["CONSTDB_MAXMEMORY"] = str(maxmem)
+    try:
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_serve_bench_server,
+                        args=(child, 512, engine_kind, 1), daemon=True)
+        p.start()
+        child.close()
+        try:
+            port = parent.recv()
+            if isinstance(port, BaseException):
+                raise port
+            tallies: list = []
+            rtts: list = []
+            t0 = time.perf_counter()
+            asyncio.run(_overload_drive(port, per_conn, tallies, rtts))
+            wall = time.perf_counter() - t0
+            parent.send("stop")
+            result = parent.recv()
+            p.join()
+            parent.close()
+            if isinstance(result, BaseException):
+                raise result
+        except BaseException:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+            raise
+    finally:
+        os.environ.pop("CONSTDB_MAXMEMORY", None)
+
+    _canon, stats = result
+    ok = sum(t[0] for t in tallies)
+    oom = sum(t[1] for t in tallies)
+    other = sum(t[2] for t in tallies)
+    total = ok + oom + other
+    lat_ms = np.asarray(rtts) * 1000.0
+    p50, p99 = (float(np.percentile(lat_ms, q)) for q in (50, 99))
+    survived = total == n_ops and other == 0
+    gauges_ok = stats["oom_shed_writes"] == oom and oom > 0 and ok > 0 \
+        and stats["used_memory"] >= maxmem * 0.5
+    print(f"[bench] overload: {ok} landed / {oom} shed / {other} other "
+          f"errors of {total} ({oom / max(total, 1):.1%} shed rate), "
+          f"{total / wall:,.0f} req/s, window p50 {p50:.2f}ms "
+          f"p99 {p99:.2f}ms; server used_memory={stats['used_memory']} "
+          f"state={stats['overload_state']} "
+          f"reclaims={stats['oom_hard_reclaims']}", file=sys.stderr)
+    out = {
+        "metric": "serve_overload_shed_rate",
+        "value": round(oom / max(total, 1), 4),
+        "unit": "fraction",
+        "mode": "serve-overload",
+        "ops": total,
+        "landed": ok,
+        "shed": oom,
+        "other_errors": other,
+        "rps": round(total / wall, 1),
+        "reply_p50_ms": round(p50, 3),
+        "reply_p99_ms": round(p99, 3),
+        "maxmemory": maxmem,
+        "used_memory": stats["used_memory"],
+        "overload_state": stats["overload_state"],
+        "oom_hard_reclaims": stats["oom_hard_reclaims"],
+        "survived": bool(survived),
+        "verified": bool(survived and gauges_ok),
+        "host": host_fingerprint(),
+    }
+    print(json.dumps(out))
+    if not out["verified"]:
         sys.exit(1)
 
 
@@ -2229,6 +2403,11 @@ def main() -> None:
                     help="serve mode: comma list of shard counts (e.g. "
                     "1,2) — runs the shard-per-core scaling curve "
                     "instead of the coalesced-vs-per-command comparison")
+    ap.add_argument("--overload", action="store_true",
+                    help="serve mode: the OVERLOAD leg — maxmemory set "
+                    "below the workload's footprint; reports shed rate, "
+                    "survival, and non-shed reply latency "
+                    "(server/overload.py)")
     args, _ = ap.parse_known_args()
     if args.mode == "stream":
         if args.wire:
@@ -2237,7 +2416,9 @@ def main() -> None:
             stream_main(args)
         return
     if args.mode == "serve":
-        if args.serve_shards:
+        if args.overload:
+            serve_overload_main(args)
+        elif args.serve_shards:
             serve_shards_main(args)
         else:
             serve_main(args)

@@ -60,6 +60,14 @@ def daemonize(cfg: Config) -> str:
     return pid_path
 
 
+def _snapshot_fsync() -> bool:
+    """Durable dumps by default (file data + parent directory entry —
+    persist/snapshot.py): CONSTDB_SNAPSHOT_FSYNC=0 trades the crash
+    guarantee for dump latency."""
+    from ..conf import env_flag
+    return env_flag("CONSTDB_SNAPSHOT_FSYNC", True)
+
+
 async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
     """Periodic background dump (fork-free; see persist/snapshot.py)."""
     from ..engine.base import batch_from_keyspace
@@ -90,7 +98,7 @@ async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
                     records, [capture],
                     chunk_keys=cfg.snapshot_chunk_keys,
                     compress_level=cfg.snapshot_compress_level,
-                    fsync=True)
+                    fsync=_snapshot_fsync())
             log.info("background snapshot written to %s",
                      cfg.snapshot_path)
         except (OSError, RuntimeError) as e:
@@ -141,7 +149,8 @@ async def amain(cfg: Config) -> None:
                                    repl_last_uuid=node.repl_log.last_uuid),
                           node.replicas.records(),
                           chunk_keys=cfg.snapshot_chunk_keys,
-                          compress_level=cfg.snapshot_compress_level)
+                          compress_level=cfg.snapshot_compress_level,
+                          fsync=_snapshot_fsync())
         log.info("final snapshot written to %s", cfg.snapshot_path)
     await app.close()
 
@@ -167,7 +176,8 @@ async def _dump_plane_snapshot(app: ServerApp, cfg: Config) -> None:
         write_snapshot_file, cfg.snapshot_path, meta,
         records, captures,
         chunk_keys=cfg.snapshot_chunk_keys,
-        compress_level=cfg.snapshot_compress_level, fsync=True)
+        compress_level=cfg.snapshot_compress_level,
+        fsync=_snapshot_fsync())
 
 
 def main(argv=None) -> None:

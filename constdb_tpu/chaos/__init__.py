@@ -40,18 +40,28 @@ Shape of the harness:
     mid-stream kill + clock jitter + one mixed-version peer) and
     `matrix_cells` enumerates the capability sweep it must pass on.
 
-CLI: `python -m constdb_tpu.chaos [--seed N] [--cells a,b,...] [--all]`
-(scripts/ci.sh runs the fixed-seed representative cells as its chaos
-smoke stage).
+  * `resource` — the RESOURCE-fault cells (round 16): a memory-capped
+    node under a firehose (shed-at-the-edge with exact -OOM replies,
+    replication intake admitted, convergence preserved), a
+    stalled-reader client cut at the outbuf cap, and a stalled-reader
+    peer recovering through the repl-window pause -> ring eviction ->
+    certified resync path.  The fault plane grew a transport-sound
+    `stall` primitive for these (a peer that stops reading is a fault
+    TCP produces daily).
+
+CLI: `python -m constdb_tpu.chaos [--seed N] [--cells a,b,...] [--all]
+[--resource]` (scripts/ci.sh runs the fixed-seed representative cells
+as its chaos smoke stage and the resource cells in its overload stage).
 """
 
 from .plane import FaultPlane
 from .cluster import ChaosClock, ChaosCluster, NodeSpec
 from .oracle import InvariantMonitor, OpJournal
+from .resource import run_resource_scenario
 from .scenario import (Cell, Scenario, certify_scenario, matrix_cells,
                        run_scenario, smoke_cells, soak_scenario)
 
 __all__ = ["FaultPlane", "ChaosClock", "ChaosCluster", "NodeSpec",
            "InvariantMonitor", "OpJournal", "Cell", "Scenario",
            "certify_scenario", "matrix_cells", "run_scenario",
-           "smoke_cells", "soak_scenario"]
+           "run_resource_scenario", "smoke_cells", "soak_scenario"]

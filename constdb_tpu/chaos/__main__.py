@@ -28,6 +28,9 @@ def main(argv=None) -> int:
                     help="run the full capability matrix")
     ap.add_argument("--soak", action="store_true",
                     help="randomized soak on the default cell")
+    ap.add_argument("--resource", action="store_true",
+                    help="resource-fault cells: memory-capped firehose, "
+                         "stalled client, stalled peer (chaos/resource.py)")
     ap.add_argument("--ops", type=int, default=30,
                     help="ops per scripted burst")
     ap.add_argument("--list", action="store_true",
@@ -40,6 +43,15 @@ def main(argv=None) -> int:
     if ns.list:
         for c in matrix_cells():
             print(c.name)
+        return 0
+
+    if ns.resource:
+        from .resource import run_resource_scenario
+        print(f"chaos resource cells: seed={ns.seed}")
+        t0 = time.monotonic()
+        stats = run_resource_scenario(ns.seed)
+        print(f"chaos resource cells PASSED in "
+              f"{time.monotonic() - t0:.1f}s: {stats}")
         return 0
 
     if ns.soak:

@@ -133,7 +133,7 @@ class EdgeRules:
     """Mutable fault configuration of one directed edge."""
 
     __slots__ = ("blocked", "delay", "reorder", "dup",
-                 "truncate_next", "corrupt_next")
+                 "truncate_next", "corrupt_next", "stall")
 
     def __init__(self) -> None:
         self.blocked = False
@@ -142,11 +142,20 @@ class EdgeRules:
         self.dup = 0.0
         self.truncate_next = False
         self.corrupt_next = False
+        # stalled reader: delivery on this direction PARKS (the pumps
+        # stop moving bytes — crucially the inbound pump stops READING
+        # the real socket, so TCP backpressure reaches the sender) until
+        # unstalled.  Transport-sound: a peer that stops draining its
+        # receive buffer is exactly this, and TCP neither drops nor
+        # reorders while it lasts — the resource fault the replication
+        # window (CONSTDB_REPL_WINDOW) exists to govern.
+        self.stall = False
 
     def clear(self) -> None:
         self.delay = None
         self.reorder = 0.0
         self.dup = 0.0
+        self.stall = False
 
 
 class _Edge:
@@ -223,6 +232,18 @@ class FaultPlane:
         if n:
             self.count("conn_kills", n)
         return n
+
+    def stall(self, src: int, dst: int) -> None:
+        """Stalled reader on src->dst: delivery parks (and the inbound
+        pump stops reading the carrying socket, so the sender feels real
+        TCP backpressure) until `unstall`.  The connection stays ALIVE —
+        this is the stalled-but-connected peer the replication window
+        governs, not a partition."""
+        self.count("stalls")
+        self.edge(src, dst).rules.stall = True
+
+    def unstall(self, src: int, dst: int) -> None:
+        self.edge(src, dst).rules.stall = False
 
     def truncate_next(self, src: int, dst: int) -> None:
         """One-shot mid-frame cut on src->dst: the next unit delivers a
@@ -361,6 +382,15 @@ class _ChaosConn:
         rng = edge.rng
         ops: list = []
         deliver: list[_Unit] = []
+        # the corrupt one-shot and reorder are mutually exclusive on an
+        # edge while the one-shot is ARMED or firing: a reorder-induced
+        # gap teardown — in this batch or one still in the delivery
+        # pipeline — kills the connection before the corrupted REPLBATCH
+        # is decoded, silently swallowing the injection and spuriously
+        # failing the oracle's demotions==corruptions accounting law.
+        # Reorder is exercised plentifully whenever no corruption is
+        # pending (the certify schedule runs its reorder window first).
+        reorder_ok = not r.corrupt_next
         for u in units:
             if r.blocked:
                 # transport-sound partition: traffic on a blocked
@@ -390,7 +420,7 @@ class _ChaosConn:
                 plane.count("frames_duplicated")
                 deliver.append(u)
             deliver.append(u)
-        if r.reorder and len(deliver) > 1:
+        if r.reorder and reorder_ok and len(deliver) > 1:
             i = 0
             while i + 1 < len(deliver):
                 if deliver[i].reorderable and deliver[i + 1].reorderable \
@@ -421,6 +451,14 @@ class _ChaosConn:
         if not self.closed:
             self._outq.put_nowait(("eof",))
 
+    async def _stall_gate(self, direction: tuple[int, int]) -> None:
+        """Park while the direction's stalled-reader fault is armed
+        (EdgeRules.stall) — polling, no rng draws, so the plane's
+        seeded decision streams are untouched."""
+        rules = self.plane.edge(*direction).rules
+        while rules.stall and not self.closed:
+            await asyncio.sleep(0.02)
+
     async def _out_pump(self) -> None:
         try:
             while True:
@@ -434,6 +472,7 @@ class _ChaosConn:
                 _, data, delay = op
                 if delay:
                     await asyncio.sleep(delay)
+                await self._stall_gate((self.src, self.dst))
                 self.real_writer.write(data)
                 await self.real_writer.drain()
         except (ConnectionError, OSError, asyncio.CancelledError):
@@ -442,6 +481,11 @@ class _ChaosConn:
     async def _in_pump(self) -> None:
         try:
             while True:
+                # the stall gate sits BEFORE the socket read: a stalled
+                # reader stops draining its receive buffer, so the
+                # sender's kernel/userspace buffers fill and its
+                # replication window (not a timeout) is what reacts
+                await self._stall_gate((self.dst, self.src))
                 data = await self.real_reader.read(1 << 16)
                 if not data:
                     break

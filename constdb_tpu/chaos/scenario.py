@@ -123,17 +123,25 @@ def certify_scenario(seed: int, cell: Optional[Cell] = None,
         ("faults", 0, 1, dict(delay=(0.0005, 0.004), reorder=0.25,
                               dup=0.25)),
         ("ops", ops * 2),
+        ("clear_faults",),
     ]
     if cell.wire and cell.shards == 1:
-        # a corrupt REPLBATCH payload must demote LOUDLY, mid-chaos.
-        # The follow-up burst runs on node 0 ONLY, so its serve path
-        # logs a consecutive encodable run and the 0->1 push loop
-        # group-encodes a REPLBATCH for the one-shot to hit (the
-        # certify step asserts it actually fired).
-        steps += [("corrupt_wire", 0, 1), ("wire_burst", 0, 24),
-                  ("ops", ops // 2)]
+        # a corrupt REPLBATCH payload must demote LOUDLY.  Injected on a
+        # CALM edge (after clear_faults) and VERIFIED with bounded
+        # retries ("corrupt_burst"): a consumed one-shot can still be
+        # legitimately discarded WITH a dying connection (transport
+        # fate-sharing — e.g. the double-dial adopt overlap closes the
+        # stream the corrupted frame was written to), in which case the
+        # clean redelivery is correct behavior and no demotion exists to
+        # count.  The law being certified is decode-fails-loudly
+        # whenever a corrupt payload REACHES a live parser — so the
+        # step re-arms and re-bursts until one does (the burst runs on
+        # node 0 ONLY, so its serve path logs a consecutive encodable
+        # run and the 0->1 push loop group-encodes a REPLBATCH for the
+        # one-shot to hit; the certify step asserts a demotion really
+        # landed).
+        steps += [("corrupt_burst", 0, 1, 24), ("ops", ops // 2)]
     steps += [
-        ("clear_faults",),
         # no-resurrection probe setup: the member exists mesh-wide
         # BEFORE the partition...
         ("probe_setup",),
@@ -342,6 +350,36 @@ class _Workload:
 # ------------------------------------------------------------------ runner
 
 
+async def _corrupt_burst(sc: Scenario, cluster: ChaosCluster, plane,
+                         wl: "_Workload", src: int, dst: int,
+                         n: int, retries: int = 6) -> None:
+    """Arm the REPLBATCH corruption one-shot on src->dst and drive a
+    pipelined burst until a demotion is OBSERVED (bounded retries).  A
+    consumed injection whose carrying connection died before delivery
+    (fate-sharing — e.g. the double-dial adopt overlap) is re-armed and
+    re-tried; an injection that reaches a live parser must demote
+    within the wait window or the scenario fails loudly."""
+    loop = asyncio.get_running_loop()
+    demos0 = cluster.stat_total("repl_wire_demotions")
+    for _attempt in range(retries):
+        plane.corrupt_next_wire(src, dst)
+        await wl.pipelined_writes(cluster, src, n)
+        deadline = loop.time() + 3.0
+        while loop.time() < deadline:
+            if cluster.stat_total("repl_wire_demotions") > demos0:
+                return
+            await asyncio.sleep(0.05)
+        # not observed: either the one-shot is still ARMED (no
+        # REPLBATCH passed — e.g. the link was mid-resync) or it was
+        # consumed and discarded with a dying connection.  Disarm
+        # before re-arming so the retry holds exactly one pending shot.
+        plane.edge(src, dst).rules.corrupt_next = False
+    raise AssertionError(
+        f"[chaos {sc.name}] no wire demotion after {retries} corrupt "
+        f"bursts — a corrupt payload that reached a live parser was "
+        f"swallowed silently")
+
+
 async def _run_scenario_async(sc: Scenario) -> dict:
     import tempfile
 
@@ -367,6 +405,9 @@ async def _run_scenario_async(sc: Scenario) -> dict:
                     await wl.burst(cluster, step[2], only={step[1]})
                 elif kind == "wire_burst":
                     await wl.pipelined_writes(cluster, step[1], step[2])
+                elif kind == "corrupt_burst":
+                    await _corrupt_burst(sc, cluster, plane, wl,
+                                         step[1], step[2], step[3])
                 elif kind == "faults":
                     plane.set_faults(step[1], step[2], **step[3])
                 elif kind == "clear_faults":
@@ -407,10 +448,13 @@ async def _run_scenario_async(sc: Scenario) -> dict:
                 elif kind == "certify":
                     plane.clear_faults()
                     plane.heal()
-                    if any(s[0] == "corrupt_wire" for s in sc.steps):
-                        # the one-shot must have HIT a real REPLBATCH
-                        # (the targeted burst above guarantees traffic)
-                        assert plane.stats.get("wire_corruptions") == 1, \
+                    if any(s[0] in ("corrupt_wire", "corrupt_burst")
+                           for s in sc.steps):
+                        # at least one injection must have HIT a real
+                        # REPLBATCH (the targeted bursts guarantee
+                        # traffic; retries may consume several)
+                        assert plane.stats.get("wire_corruptions", 0) \
+                            >= 1, \
                             f"[chaos {sc.name}] wire corruption armed " \
                             f"but never hit a REPLBATCH frame"
                     canon = await certify_state(

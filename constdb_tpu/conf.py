@@ -172,6 +172,35 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_UNDO_WINDOW", "4096",
            "locally-originated counter ops kept undoable (CNTUNDO "
            "looks its target up here; older ops report 'evicted')"),
+    EnvVar("CONSTDB_MAXMEMORY", "0",
+           "governed memory ceiling in bytes (store + repl log + device "
+           "pools + applier buffers); 0 = unlimited.  Past the soft "
+           "watermark client DATA writes shed with an -OOM error; "
+           "reads, deletes, admin, and ALL replication intake stay "
+           "admitted (the convergence-soundness asymmetry, "
+           "docs/INVARIANTS.md)"),
+    EnvVar("CONSTDB_MAXMEMORY_SOFT_PCT", "85",
+           "soft watermark as a percent of CONSTDB_MAXMEMORY: shedding "
+           "starts here; at 100% of the cap the node additionally "
+           "flushes device state, drops warm caches, and forces GC"),
+    EnvVar("CONSTDB_CLIENT_OUTBUF_MAX", "134217728",
+           "per-connection reply-buffer cap in bytes: a client that "
+           "stops reading past it is disconnected loudly "
+           "(client_outbuf_disconnects) instead of pinning unbounded "
+           "reply memory; 0 = uncapped"),
+    EnvVar("CONSTDB_REPL_WINDOW", "16777216",
+           "max unacked replication-stream bytes in flight per peer: "
+           "the push loop pauses draining the ring for a stalled peer "
+           "at this window and resumes on REPLACK — a long stall "
+           "degrades to ring eviction + delta resync; 0 = unbounded"),
+    EnvVar("CONSTDB_PROTO_MAX_BULK", "536870912",
+           "max declared RESP bulk-string length accepted at parse "
+           "time (Redis-style 512MB default): a $-header past it is a "
+           "protocol error before any buffering, in both parsers"),
+    EnvVar("CONSTDB_SNAPSHOT_FSYNC", "1",
+           "fsync background/shutdown snapshot dumps — file AND parent "
+           "directory after the atomic rename — so a crash right after "
+           "the dump cannot lose it; 0 trades that for dump latency"),
 )}
 
 

@@ -947,6 +947,24 @@ class TpuMergeEngine:
         self.needs_flush = False
         self.family_secs["flush"] += _time.perf_counter() - t0
 
+    def release_device_pools(self, store: KeySpace) -> None:
+        """Hard-watermark memory reclaim (server/overload.py): flush
+        resident state down to the host, then RELEASE the device
+        mirrors, win-value pools, and tensor payload pools — they
+        refill lazily on the next merge round (mirror_rebuilds counts
+        it).  Unlike discard_resident this is loss-free: flush() runs
+        first, so host state is exact when the device copies drop."""
+        self.flush(store)
+        self._res.clear()
+        self._val_pool.clear()
+        self._pool_size = 0
+        self._pool_bytes = 0
+        self._el_del_touched.clear()
+        if self._tns_pools:
+            self._tns_pools.clear()
+            self._tns_bytes = 0
+            self._tns_epoch += 1
+
     def discard_resident(self) -> None:
         """Forget ALL resident device state WITHOUT flushing — only valid
         when the host store itself is being discarded (Node.

@@ -91,13 +91,16 @@ def _worker_stats(node) -> dict:
         "apply_barriers": st.repl_apply_barriers,
         "gc_freed": st.gc_freed,
         "keys": node.ks.n_keys(),
+        "used_bytes": node.governor.used_memory(),
+        "oom_shed": st.oom_shed_writes,
         "lat": lat,
     }
 
 
 def _serve_worker_main(conn, shard: int, n_shards: int, engine_spec: str,
                        env: dict, node_id: int, alias: str,
-                       serve_batch: int) -> None:
+                       serve_batch: int, maxmemory=None,
+                       maxmemory_soft_pct=None) -> None:
     """Serve worker loop: one shard-confined Node + ServeCoalescer."""
     import os
 
@@ -115,6 +118,18 @@ def _serve_worker_main(conn, shard: int, n_shards: int, engine_spec: str,
 
     node = Node(node_id=node_id, alias=alias,
                 engine=_make_engine(engine_spec))
+    if maxmemory is not None or maxmemory_soft_pct is not None:
+        # each worker governs its slice of the node cap (the plane
+        # passed maxmemory // n_shards): the keys are hash-partitioned,
+        # so per-shard caps bound the node total while the shed decision
+        # stays local to the worker owning the written key
+        node.governor.configure(maxmemory, maxmemory_soft_pct)
+    # a worker's own gc_horizon would be its LOCAL clock (no peers in
+    # its ReplicaManager) — unsound for tombstone collection; the
+    # parent cron drives worker GC with the real coverage-gated
+    # cluster horizon ("gc" command below), so the hard-watermark
+    # reclaim must not sweep on its own (server/overload.py)
+    node.governor.reclaim_gc = False
     node.repl_log = _TapLog()
     deleted = [False]
 
@@ -263,7 +278,9 @@ class ServeShardPool:
     def __init__(self, n_shards: int, engine_spec: str = "cpu",
                  node_id: int = 0, alias: str = "", serve_batch: int = 512,
                  env: Optional[dict] = None,
-                 start_method: str = "forkserver"):
+                 start_method: str = "forkserver",
+                 maxmemory: Optional[int] = None,
+                 maxmemory_soft_pct: Optional[float] = None):
         import multiprocessing as mp
 
         if n_shards < 1:
@@ -285,7 +302,8 @@ class ServeShardPool:
             parent, child = ctx.Pipe()
             p = ctx.Process(target=_serve_worker_main,
                             args=(child, s, n_shards, engine_spec, wenv,
-                                  node_id, alias, serve_batch),
+                                  node_id, alias, serve_batch,
+                                  maxmemory, maxmemory_soft_pct),
                             daemon=True)
             p.start()
             child.close()

@@ -611,13 +611,32 @@ class SnapshotLoader:
 # high-level dump / restore
 
 
+def _fsync_parent_dir(path: str) -> None:
+    """fsync the directory holding `path`: os.replace makes the rename
+    ATOMIC but not DURABLE — until the directory entry itself is synced,
+    a crash can roll the rename back and the just-written snapshot is
+    gone (its tmp name was already unlinked).  POSIX requires an fsync
+    on the directory fd to pin the entry."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic fs without dir-open
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def dump_keyspace(path: str, ks, meta: NodeMeta,
                   replicas: Iterable[ReplicaRecord] = (),
                   chunk_keys: int = 1 << 16,
-                  compress_level: int = 1) -> int:
+                  compress_level: int = 1,
+                  fsync: bool = False) -> int:
     """Atomic whole-keyspace dump (reference src/server.rs:183-220, minus
     the fork: the columnar capture is the consistent cut).  Returns the
-    file size."""
+    file size.  `fsync`: durable like write_snapshot_file — file data
+    before the rename, parent directory entry after it."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
@@ -629,7 +648,12 @@ def dump_keyspace(path: str, ks, meta: NodeMeta,
             for chunk in iter_keyspace_chunks(ks, chunk_keys):
                 w.write_chunk(chunk)
             w.finish()
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if fsync:
+            _fsync_parent_dir(path)
     finally:
         if os.path.exists(tmp):
             try:
@@ -672,6 +696,11 @@ def write_snapshot_file(path: str, meta: NodeMeta,
                 f.flush()
                 os.fsync(f.fileno())
         os.replace(tmp, path)
+        if fsync:
+            # the rename is atomic but not durable until the DIRECTORY
+            # entry syncs — a crash right after os.replace could roll
+            # it back, losing the dump whose bytes were just fsynced
+            _fsync_parent_dir(path)
     finally:
         if os.path.exists(tmp):
             try:

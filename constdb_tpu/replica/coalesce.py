@@ -276,7 +276,8 @@ class CoalescingApplier:
 
     __slots__ = ("node", "meta", "max_frames", "max_latency", "_now",
                  "cursor", "_epoch", "_buf", "_pending_keys", "_frames",
-                 "_first_ts", "_pending_beacon", "_enc_has")
+                 "_first_ts", "_pending_beacon", "_enc_has",
+                 "pending_bytes")
 
     def __init__(self, node, meta, max_frames: Optional[int] = None,
                  max_latency: Optional[float] = None,
@@ -300,6 +301,11 @@ class CoalescingApplier:
         self._frames = 0
         self._first_ts = 0.0
         self._pending_beacon = 0
+        # received-but-unlanded frame bytes, for the overload governor's
+        # accounting (the pull loop registers a source reading this —
+        # replica/link.py); approximate (payload bytes + a fixed
+        # per-frame overhead), zeroed by every flush
+        self.pending_bytes = 0
         # bound C-level membership test for the per-frame dispatch;
         # batch=1 pins the per-frame path by never consulting it
         self._enc_has = COLUMNAR_ENCODERS.__contains__ \
@@ -355,6 +361,12 @@ class CoalescingApplier:
             self._first_ts = self._now()
         recs.append((key, as_int(items[1]), uuid, items))
         self._pending_keys.add(key)
+        sz = 48
+        for it in items:
+            v = getattr(it, "val", None)
+            if type(v) is bytes:
+                sz += len(v)
+        self.pending_bytes += sz
         f += 1
         self._frames = f
         self.cursor = uuid
@@ -470,6 +482,7 @@ class CoalescingApplier:
         (legal by commutativity), raising the exact op-path error."""
         buf, self._buf = self._buf, {}
         frames, self._frames = self._frames, 0
+        self.pending_bytes = 0
         if not frames:
             return
         self._pending_keys.clear()

@@ -240,6 +240,11 @@ class ReplicaLink:
         self._attempts = 0
         self._ever_connected = False
         self.reconnects = 0
+        # replication flow-control observability (INFO replica<i> rows):
+        # unacked stream bytes in the peer's window, and whether the
+        # push loop is currently pausing the ring drain on it
+        self.win_unacked = 0
+        self.win_paused = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -549,18 +554,56 @@ class ReplicaLink:
         meta = self.meta
         # EVENT_PULL_LANDED wakes this loop when OUR pull side lands a
         # batch of the peer's stream, so the REPLACK below goes out once
-        # per covering batch instead of a heartbeat later
+        # per covering batch instead of a heartbeat later;
+        # EVENT_REPLICA_ACKED wakes it when a REPLACK lands, so a
+        # window-paused drain (below) resumes the moment the peer
+        # catches up instead of a heartbeat later
         consumer = node.events.new_consumer(
-            EVENT_REPLICATED | EVENT_PULL_LANDED)
+            EVENT_REPLICATED | EVENT_PULL_LANDED | EVENT_REPLICA_ACKED)
         wire_batch = wire_batch_limit(self.app)
         wire_latency = wire_latency_of(self.app)
+        # replication flow control (CONSTDB_REPL_WINDOW): stream bytes
+        # written to this connection but not yet covered by the peer's
+        # REPLACK watermark.  `inflight` holds (cursor_after_flush,
+        # nbytes) per aggregated wire flush; entries retire as
+        # uuid_i_acked passes their cursor.  When the total passes the
+        # window the loop stops DRAINING THE RING for this peer —
+        # memory stops growing here and in the transport — and resumes
+        # on ack; a long stall degrades to ring eviction, recovered by
+        # the certified delta/full resync path on this same connection.
+        window = getattr(self.app, "repl_window", None)
+        if window is None:
+            from ..conf import env_int
+            window = env_int("CONSTDB_REPL_WINDOW", 16 << 20)
+        from collections import deque
+        inflight: deque = deque()
+        inflight_bytes = 0
+        paused = False
         loop = asyncio.get_running_loop()
         try:
             synced = False  # peer_resume not yet honored
             cursor = 0
             last_ack = 0.0
             while True:
-                if not synced or not node.repl_log.can_resume_from(cursor):
+                acked = meta.uuid_i_acked
+                while inflight and inflight[0][0] <= acked:
+                    inflight_bytes -= inflight.popleft()[1]
+                self.win_unacked = inflight_bytes
+                win_full = bool(window) and synced and \
+                    inflight_bytes > window
+                if win_full and not paused:
+                    paused = self.win_paused = True
+                    node.stats.repl_window_pauses += 1
+                    log.warning(
+                        "push %s: %d unacked stream bytes over "
+                        "CONSTDB_REPL_WINDOW=%d; pausing ring drain "
+                        "until the peer acks", meta.addr, inflight_bytes,
+                        window)
+                elif not win_full:
+                    paused = self.win_paused = False
+                if not paused and \
+                        (not synced or
+                         not node.repl_log.can_resume_from(cursor)):
                     resume = peer_resume if not synced else cursor
                     if node.repl_log.can_resume_from(resume):
                         # partial replay is always the lossless choice when
@@ -641,7 +684,17 @@ class ReplicaLink:
                     bool(self._peer_caps & CAP_BATCH_STREAM)
                 out = bytearray()
                 t_flush = loop.time()
-                while True:
+
+                def flush_out(buf: bytearray) -> bytearray:
+                    # every aggregated stream flush is one window entry:
+                    # acked when the peer's REPLACK watermark passes the
+                    # cursor the flush ended at
+                    nonlocal inflight_bytes
+                    inflight.append((cursor, len(buf)))
+                    inflight_bytes += len(buf)
+                    return self._flush_wire(writer, buf)
+
+                while not paused:
                     # byte-capped runs: the flush bound below must get a
                     # chance to engage BEFORE a backlog of huge values
                     # is encoded into one frame/buffer (a lone oversized
@@ -675,14 +728,19 @@ class ReplicaLink:
                         cursor = run[-1].uuid
                     if len(out) >= _WIRE_FLUSH_BYTES or \
                             loop.time() - t_flush >= wire_latency:
-                        out = self._flush_wire(writer, out)
+                        out = flush_out(out)
                         await writer.drain()  # backpressure + yield
                         t_flush = loop.time()
+                    if window and inflight_bytes > window:
+                        # the window filled MID-drain: stop pulling the
+                        # ring now; the top of the loop re-evaluates
+                        # (and counts) the pause
+                        break
                 if out:
-                    out = self._flush_wire(writer, out)
+                    out = flush_out(out)
                 if self._writer is writer:
                     meta.uuid_i_sent = cursor  # observability (INFO)
-                if not node.repl_log.can_resume_from(cursor):
+                if not paused and not node.repl_log.can_resume_from(cursor):
                     # fell off the ring mid-round: resync NOW (top of the
                     # loop) instead of sleeping out a heartbeat first
                     await writer.drain()
@@ -712,6 +770,11 @@ class ReplicaLink:
         except (ConnectionError, OSError) as e:
             log.debug("push %s dropped: %s", self.meta.addr, e)
         finally:
+            # the window gauges describe THIS connection's in-flight
+            # bytes; left set, INFO would report a stale paused window
+            # for a link that is reconnecting and not pushing at all
+            self.win_unacked = 0
+            self.win_paused = False
             consumer.close()
 
     async def _send_snapshot(self, writer, reset: bool = False) -> int:
@@ -1024,6 +1087,17 @@ class ReplicaLink:
                 max_frames=getattr(self.app, "apply_batch", None),
                 max_latency=getattr(self.app, "apply_latency", None),
                 now=asyncio.get_running_loop().time)
+        # the applier's intake buffer counts toward the governed memory
+        # total for the connection's lifetime (server/overload.py)
+        gov = self.node.governor
+        src = lambda: applier.pending_bytes  # noqa: E731
+        gov.register_source(src)
+        try:
+            await self._pull_frames(reader, writer, parser, applier)
+        finally:
+            gov.unregister_source(src)
+
+    async def _pull_frames(self, reader, writer, parser, applier) -> None:
         while True:
             msg = parser.next_msg()
             if msg is None:

@@ -23,6 +23,25 @@ _COMPACT_THRESHOLD = 1 << 16
 # pre-encodes the common counter replies)
 _INT_REPLY = [b":%d\r\n" % i for i in range(1024)]
 
+_DEFAULT_MAX_BULK = 512 << 20  # Redis proto-max-bulk-len default
+_MAX_BULK_CACHE: list = []
+
+
+def max_bulk_len() -> int:
+    """The parse-time bulk-length ceiling (CONSTDB_PROTO_MAX_BULK,
+    Redis-style 512MB default).  A `$`-header past it is a PROTOCOL
+    error the moment the header line parses — the parser never buffers
+    toward an absurd declared length, so a malicious `$99999999999`
+    costs one error reply, not an allocation (overload governance,
+    docs/INVARIANTS.md "Degradation laws").  Cached at first use;
+    clamped to the wire format's hard 512MB ceiling."""
+    if not _MAX_BULK_CACHE:
+        from ..conf import env_int
+        _MAX_BULK_CACHE.append(
+            min(max(1, env_int("CONSTDB_PROTO_MAX_BULK",
+                               _DEFAULT_MAX_BULK)), _DEFAULT_MAX_BULK))
+    return _MAX_BULK_CACHE[0]
+
 
 def encode_into(out: bytearray, m: Msg) -> None:
     """Append m's wire encoding to `out` — native fast path when the
@@ -80,12 +99,13 @@ _NEED_MORE = _NeedMore()
 
 
 class RespParser:
-    __slots__ = ("_buf", "_pos", "max_depth", "_q", "_qpos")
+    __slots__ = ("_buf", "_pos", "max_depth", "max_bulk", "_q", "_qpos")
 
-    def __init__(self, max_depth: int = 32):
+    def __init__(self, max_depth: int = 32, max_bulk: Optional[int] = None):
         self._buf = bytearray()
         self._pos = 0
         self.max_depth = max_depth
+        self.max_bulk = max_bulk_len() if max_bulk is None else max_bulk
         # already-parsed messages awaiting delivery: the native subclass
         # fast-parses whole pipelines in one C call, and `pushback`
         # re-queues messages a caller drained but does not own (server/io.py
@@ -217,7 +237,7 @@ class RespParser:
                         except ValueError:
                             raise InvalidRequestMsg(
                                 "invalid bulk length") from None
-                        if ln > 512 << 20:
+                        if ln > self.max_bulk:
                             # same cap as the general path below: a huge
                             # declared length must fail fast, not buffer
                             raise InvalidRequestMsg("bulk string too large")
@@ -295,7 +315,7 @@ class RespParser:
                 if n != -1:  # only $-1 is Nil; other negatives are malformed
                     raise InvalidRequestMsg("negative bulk length")
                 return NIL
-            if n > 512 << 20:
+            if n > self.max_bulk:
                 raise InvalidRequestMsg("bulk string too large")
             end = self._pos + n + 2
             if end > len(self._buf):
@@ -337,8 +357,23 @@ class NativeRespParser(RespParser):
         if ext is None:
             return super()._parse_one()
         try:
-            msgs, new_pos, fallback = ext.resp_parse(
-                self._buf, self._pos, Arr, Bulk, Int, Simple, Err, NIL)
+            # max_bulk rides into the C scanner so an absurd $-header is
+            # rejected at HEADER-parse time (the scanner defers it to the
+            # pure parser, which raises) — never buffered toward.  A
+            # prebuilt cst_ext.so predating the parameter rejects the
+            # call shape; enforcement then falls to the pure parser,
+            # which is only load-bearing below the 512MB hard ceiling
+            # the old scanner already enforces.
+            try:
+                msgs, new_pos, fallback = ext.resp_parse(
+                    self._buf, self._pos, Arr, Bulk, Int, Simple, Err,
+                    NIL, 1024, self.max_bulk)
+            except TypeError:
+                if self.max_bulk < _DEFAULT_MAX_BULK:
+                    return super()._parse_one()
+                msgs, new_pos, fallback = ext.resp_parse(
+                    self._buf, self._pos, Arr, Bulk, Int, Simple, Err,
+                    NIL)
         except ValueError as e:
             raise InvalidRequestMsg(str(e)) from None
         self._pos = new_pos

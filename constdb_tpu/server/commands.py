@@ -54,6 +54,13 @@ CMD_NO_REPLICATE = 8
 CMD_NO_REPLY = 16
 CMD_REPL_ONLY = 32
 CMD_CLIENT_ONLY = 64
+# data-GROWING client writes: shed with a clean -OOM error past the
+# maxmemory soft watermark (server/overload.py).  Deletes/removals/
+# expiry are deliberately NOT flagged (they free memory), admin and
+# membership never are, and the replication path never consults the
+# flag at all — replicated ops must always land or the mesh diverges
+# (docs/INVARIANTS.md "Degradation laws").
+CMD_DENYOOM = 128
 
 
 class Command:
@@ -171,6 +178,15 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
     if cmd.flags & CMD_REPL_ONLY:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
+    if cmd.flags & CMD_DENYOOM and node.governor.shed_writes():
+        # maxmemory shed, at the CLIENT edge only: nothing was applied,
+        # logged, or replicated — this write never existed, so the
+        # mesh's delivered set (and its convergence) is untouched.  The
+        # replication path (apply_replicated) never gates: replicated
+        # ops must always land (server/overload.py module doc).
+        node.stats.oom_shed_writes += 1
+        from .overload import OOM_ERR
+        return Err(OOM_ERR)
     if name in TENSOR_DEVICE_READS:
         # tensor reads are served DEVICE-FIRST (Node.tensor_read): they
         # touch only the env plane (query/alive — flushed narrowly
@@ -242,7 +258,7 @@ def _invalid_type():
     return InvalidType()
 
 
-@register("set", CMD_WRITE, families=("env", "reg"))
+@register("set", CMD_WRITE | CMD_DENYOOM, families=("env", "reg"))
 def set_command(node, ctx, args):
     key = args.next_bytes()
     val = args.next_bytes()
@@ -393,12 +409,12 @@ def _counter_step(node, ctx, args, delta: int) -> Msg:
     return Int(v)
 
 
-@register("incr", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "cnt"))
+@register("incr", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "cnt"))
 def incr_command(node, ctx, args):
     return _counter_step(node, ctx, args, 1)
 
 
-@register("decr", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "cnt"))
+@register("decr", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "cnt"))
 def decr_command(node, ctx, args):
     return _counter_step(node, ctx, args, -1)
 
@@ -414,7 +430,7 @@ def cntset_command(node, ctx, args):
     return NO_REPLY
 
 
-@register("cntundo", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY, families=("env", "cnt"))
+@register("cntundo", CMD_WRITE | CMD_NO_REPLICATE | CMD_CLIENT_ONLY | CMD_DENYOOM, families=("env", "cnt"))
 def cntundo_command(node, ctx, args):
     """`CNTUNDO key [uuid]` — sound inverse-op undo for the PN-counter
     family only (PAPERS.md, "The Only Undoable CRDTs are Counters"):
@@ -474,7 +490,7 @@ def delcnt_command(node, ctx, args):
 # set commands (reference src/type_set.rs)
 # ====================================================================
 
-@register("sadd", CMD_WRITE, families=("env", "el"))
+@register("sadd", CMD_WRITE | CMD_DENYOOM, families=("env", "el"))
 def sadd_command(node, ctx, args):
     key = args.next_bytes()
     members = args.rest_bytes()
@@ -563,7 +579,7 @@ def delset_command(node, ctx, args):
 # hash commands (reference src/type_hash.rs)
 # ====================================================================
 
-@register("hset", CMD_WRITE, families=("env", "el"))
+@register("hset", CMD_WRITE | CMD_DENYOOM, families=("env", "el"))
 def hset_command(node, ctx, args):
     key = args.next_bytes()
     kvs = []
@@ -659,7 +675,7 @@ def _mv_apply(ks, kid, clock_bytes, wc, val, uuid, nodeid) -> None:
     ks.updated_at(kid, uuid)
 
 
-@register("mvset", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
+@register("mvset", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "el"))
 def mvset_command(node, ctx, args):
     """MVSET key value [context-token].  The token (from MVGET) is the
     causal context the writer observed; writing with it supersedes exactly
@@ -775,7 +791,7 @@ def _list_insert(node, ctx, key, index: int, values: list) -> int:
     return len(_list_live(ks, kid))
 
 
-@register("linsert", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
+@register("linsert", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "el"))
 def linsert_command(node, ctx, args):
     key = args.next_bytes()
     index = args.next_int()
@@ -785,7 +801,7 @@ def linsert_command(node, ctx, args):
     return Int(_list_insert(node, ctx, key, index, values))
 
 
-@register("lpush", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
+@register("lpush", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "el"))
 def lpush_command(node, ctx, args):
     key = args.next_bytes()
     values = args.rest_bytes()
@@ -797,7 +813,7 @@ def lpush_command(node, ctx, args):
     return Int(_list_insert(node, ctx, key, 0, list(reversed(values))))
 
 
-@register("rpush", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
+@register("rpush", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "el"))
 def rpush_command(node, ctx, args):
     key = args.next_bytes()
     values = args.rest_bytes()
@@ -911,7 +927,7 @@ def _tensor_knobs() -> tuple[str, int]:
             env_int("CONSTDB_TENSOR_MAX_ELEMS", 1 << 22))
 
 
-@register("tensor.set", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "tns"))
+@register("tensor.set", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "tns"))
 def tensor_set_command(node, ctx, args):
     """TENSOR.SET key strategy dtype shape payload [count] — create the
     key (fixing strategy/dtype/shape) and assign this node's
@@ -951,7 +967,7 @@ def tensor_set_command(node, ctx, args):
     return OK
 
 
-@register("tensor.merge", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "tns"))
+@register("tensor.merge", CMD_WRITE | CMD_NO_REPLICATE | CMD_DENYOOM, families=("env", "tns"))
 def tensor_merge_command(node, ctx, args):
     """TENSOR.MERGE key payload [count] — contribute a payload to an
     EXISTING tensor key (the config came from its creation)."""

@@ -62,6 +62,23 @@ def _peak_rss_bytes():
 
 def _section_memory(node, out):
     rss = _current_rss_bytes()
+    # governed accounting (server/overload.py): the byte total the
+    # maxmemory watermarks are enforced against — store live rows +
+    # blob/tensor payloads + repl log + device pools + applier buffers.
+    # A shard-per-core node's workers each govern their slice; the
+    # parent sums their last-acked gauges (serve_shard<i>_used_bytes).
+    plane = getattr(node, "serve_plane", None)
+    if plane is not None:
+        x = node.stats.extra
+        used = node.governor.used_memory() + sum(
+            x.get(f"serve_shard{i}_used_bytes", 0)
+            for i in range(plane.n_shards))
+    else:
+        used = node.governor.used_memory()
+    out.append(("used_memory", used))
+    out.append(("maxmemory", node.governor.maxmemory))
+    out.append(("maxmemory_soft", node.governor.soft_bytes))
+    out.append(("overload_state", node.governor.state_name))
     out.append(("used_memory_rss", rss))
     # ru_maxrss lags the live gauge by kernel sampling granularity; clamp
     # so the reported peak is never below the reported current
@@ -121,6 +138,14 @@ def _section_stats(node, out):
     out.append(("serve_msgs_coalesced", st.serve_msgs_coalesced))
     out.append(("serve_flushes", st.serve_flushes))
     out.append(("serve_barriers", st.serve_barriers))
+    # overload governance (server/overload.py): client writes shed at
+    # the maxmemory soft watermark, hard-watermark reclaim sweeps,
+    # slow-reader disconnects at the outbuf cap, and push loops paused
+    # on a full per-peer replication window
+    out.append(("oom_shed_writes", st.oom_shed_writes))
+    out.append(("oom_hard_reclaims", st.oom_hard_reclaims))
+    out.append(("client_outbuf_disconnects", st.client_outbuf_disconnects))
+    out.append(("repl_window_pauses", st.repl_window_pauses))
     if st.serve_lat:
         lat_ms = np.fromiter(st.serve_lat, dtype=np.float64) * 1000.0
         out.append(("serve_lat_p50_ms",
@@ -219,9 +244,13 @@ def _section_replication(node, out):
             state = "alive" if m.alive else "forgotten"
         states.append(f"{addr}={state}")
         recon = getattr(link, "reconnects", 0) if link is not None else 0
+        win = getattr(link, "win_unacked", 0) if link is not None else 0
+        win_p = int(getattr(link, "win_paused", False)) \
+            if link is not None else 0
         out.append((f"replica{i}",
                     f"addr={addr},node_id={m.node_id},state={state},"
                     f"reconnects={recon},"
+                    f"win_unacked={win},win_paused={win_p},"
                     f"i_sent={m.uuid_i_sent},i_acked={m.uuid_i_acked},"
                     f"he_sent={m.uuid_he_sent},he_acked={m.uuid_he_acked}"))
     if states:

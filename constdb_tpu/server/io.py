@@ -61,7 +61,11 @@ class ServerApp:
                  delta_sync: Optional[bool] = None,
                  delta_max_divergence: Optional[float] = None,
                  delta_bucket_keys: Optional[int] = None,
-                 delta_stamp_min: Optional[int] = None):
+                 delta_stamp_min: Optional[int] = None,
+                 maxmemory: Optional[int] = None,
+                 maxmemory_soft_pct: Optional[float] = None,
+                 client_outbuf_max: Optional[int] = None,
+                 repl_window: Optional[int] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -174,6 +178,21 @@ class ServerApp:
         # cost more than the whole-bucket payload it would trim
         self.delta_stamp_min = env_int("CONSTDB_DELTA_STAMP_MIN", 4096) \
             if delta_stamp_min is None else delta_stamp_min
+        # overload governance (server/overload.py + docs/INVARIANTS.md
+        # "Degradation laws"): the node-level memory cap + watermarks
+        # (None = the CONSTDB_MAXMEMORY / CONSTDB_MAXMEMORY_SOFT_PCT env
+        # defaults — the governor read those at Node construction, so
+        # only explicit overrides reconfigure it), the per-connection
+        # reply-buffer cap past which a non-reading client is
+        # disconnected, and the per-peer unacked replication window the
+        # push loops pause on.
+        if maxmemory is not None or maxmemory_soft_pct is not None:
+            node.governor.configure(maxmemory, maxmemory_soft_pct)
+        self.client_outbuf_max = \
+            env_int("CONSTDB_CLIENT_OUTBUF_MAX", 128 << 20) \
+            if client_outbuf_max is None else client_outbuf_max
+        self.repl_window = env_int("CONSTDB_REPL_WINDOW", 16 << 20) \
+            if repl_window is None else repl_window
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -294,11 +313,26 @@ class ServerApp:
         consumer = self.node.events.new_consumer(
             EVENT_DELETED | EVENT_REPLICA_ACKED)
         last_gc = 0.0
+        loop = asyncio.get_running_loop()
+        x = self.node.stats.extra
         try:
             while True:
+                t0 = loop.time()
                 woke = await consumer.wait(timeout=0.1)
                 self.node.hlc.tick(False)
-                now = asyncio.get_running_loop().time()
+                now = loop.time()
+                if not woke:
+                    # event-loop lag: how far past the tick timeout this
+                    # wake actually ran — the operator's view of intake
+                    # saturation (a wedged loop shows up HERE first)
+                    lag_ms = max(0.0, (now - t0 - 0.1) * 1000.0)
+                    x["loop_lag_ms"] = round(lag_ms, 2)
+                    if lag_ms > x.get("loop_lag_ms_max", 0.0):
+                        x["loop_lag_ms_max"] = round(lag_ms, 2)
+                # watermark re-check each tick: replication intake and
+                # pool growth move used_memory without any client write
+                # ever consulting the gate (server/overload.py)
+                self.node.governor.tick()
                 due = now - last_gc >= self.gc_interval
                 early = woke and now - last_gc >= self.gc_interval / 4
                 if due or early:
@@ -344,6 +378,17 @@ class ServerApp:
         self._conn_tasks.add(task)
         self.node.stats.connections_accepted += 1
         self.node.stats.current_clients += 1
+        try:
+            # bound the transport's userspace reply buffer: drain()
+            # engages at the high-water mark, so one connection's
+            # in-flight pipeline depth is one chunk of replies — a
+            # stalled reader parks its coroutine at the mark instead of
+            # growing the buffer (the outbuf cap below catches the case
+            # where a single chunk's replies blow straight past it)
+            writer.transport.set_write_buffer_limits(
+                high=min(self.client_outbuf_max or (1 << 18), 1 << 18))
+        except (AttributeError, RuntimeError):  # pragma: no cover
+            pass
         parser = make_parser()
         out = bytearray()
         upgraded = False
@@ -401,6 +446,8 @@ class ServerApp:
                     return  # connection now owned by the replica link
                 if out:
                     out = self._flush_out(writer, out)
+                    if self._outbuf_overflow(writer):
+                        return  # disconnected loudly; finally cleans up
                     await writer.drain()
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             pass
@@ -456,6 +503,33 @@ class ServerApp:
             await plane.run_chunk(msgs, out)
         else:
             coal.run_chunk(msgs, out)
+
+    def _outbuf_overflow(self, writer) -> bool:
+        """Slow-client protection (CONSTDB_CLIENT_OUTBUF_MAX): a client
+        whose un-drained reply bytes pass the cap is disconnected LOUDLY
+        — counted, logged, transport aborted (it is not reading; a
+        graceful close would park on the very buffer being dropped) —
+        instead of pinning unbounded reply memory on the loop.  The
+        disconnect is connection-fatal but never state-corrupting: every
+        landed write already landed; only undelivered reply bytes drop
+        (docs/INVARIANTS.md "Degradation laws")."""
+        cap = self.client_outbuf_max
+        if not cap:
+            return False
+        tr = writer.transport
+        if tr is None or tr.get_write_buffer_size() <= cap:
+            return False
+        self.node.stats.client_outbuf_disconnects += 1
+        try:
+            peer = writer.get_extra_info("peername")
+        except (AttributeError, OSError):  # pragma: no cover
+            peer = None
+        log.warning(
+            "client %s disconnected: reply buffer %d bytes over "
+            "CONSTDB_CLIENT_OUTBUF_MAX=%d (reader stalled)", peer,
+            tr.get_write_buffer_size(), cap)
+        tr.abort()
+        return True
 
     def _flush_out(self, writer, out: bytearray) -> bytearray:
         """Queue accumulated replies on the transport and return a fresh
@@ -541,6 +615,34 @@ def encode_msg_arr(items) -> bytes:
     return bytes(out)
 
 
+def _quarantine_snapshot(node: Node, path: str, err: BaseException) -> str:
+    """Boot-resilience for a truncated/bit-flipped snapshot: rename it
+    aside (`.corrupt` — evidence for the operator, and the crash-loop
+    breaker: the next boot no longer sees it), log LOUDLY, and flag it
+    in INFO (`boot_snapshot_quarantined`).  The node then boots EMPTY
+    and rejoins the mesh as a fresh replica — degraded but alive, which
+    beats a node that can never start."""
+    qpath = path + ".corrupt"
+    try:
+        os.replace(path, qpath)
+    except OSError as mv_err:  # pragma: no cover - fs-dependent
+        log.error("could not quarantine corrupt snapshot %s: %s",
+                  path, mv_err)
+        qpath = path
+    log.error("boot snapshot %s is unreadable (%s: %s); quarantined to "
+              "%s — booting EMPTY", path, type(err).__name__, err, qpath)
+    node.stats.extra["boot_snapshot_quarantined"] = qpath
+    return qpath
+
+
+# what a damaged snapshot file can surface as through the loader: framing
+# and checksum failures (InvalidSnapshot*), section-decode failures the
+# loader does not wrap (ValueError/KeyError/OverflowError from a
+# bit-flipped length or enum), and plain IO errors
+_SNAPSHOT_LOAD_ERRORS = (CstError, OSError, ValueError, KeyError,
+                         IndexError, OverflowError, EOFError)
+
+
 async def start_node(node: Node, **kwargs) -> ServerApp:
     """Convenience: build + start a ServerApp (optionally restoring the
     boot snapshot — a capability the reference lacks, SURVEY.md §5.4)."""
@@ -558,8 +660,8 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
         from ..persist.snapshot import SectionDemux, SnapshotLoader
         loop = asyncio.get_event_loop()
         restore = app.snapshot_path and os.path.exists(app.snapshot_path)
-        if restore:
-            if not node.node_id:
+        if restore and not node.node_id:
+            try:
                 f = await loop.run_in_executor(None, open,
                                                app.snapshot_path, "rb")
                 try:
@@ -570,6 +672,10 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
                             break
                 finally:
                     f.close()
+            except _SNAPSHOT_LOAD_ERRORS as e:
+                _quarantine_snapshot(node, app.snapshot_path, e)
+                restore = False
+        if restore:
 
             async def restore_into_plane() -> None:
                 f = await loop.run_in_executor(None, open,
@@ -577,6 +683,13 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
                 demux = SectionDemux(f)
                 try:
                     await app.serve_plane.ingest_batches(demux.batches())
+                except _SNAPSHOT_LOAD_ERRORS as e:
+                    # a mid-file corruption can strand a PARTIAL restore
+                    # in the workers: wipe them so "boots empty" is
+                    # really empty, then quarantine + serve
+                    await app.serve_plane.pool.call_all("reset")
+                    _quarantine_snapshot(node, app.snapshot_path, e)
+                    return
                 finally:
                     f.close()
                 if demux.meta is not None:
@@ -594,26 +707,39 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
         return app
     if app.snapshot_path and os.path.exists(app.snapshot_path):
         from ..persist.snapshot import load_snapshot
-        meta, records = load_snapshot(app.snapshot_path, node.ks,
-                                      engine=node.engine)
-        if meta.node_id and not node.node_id:
-            node.node_id = meta.node_id
-        node.hlc.observe(meta.repl_last_uuid)
-        # The fresh repl_log does not cover any of the restored history, so
-        # a peer resuming below the restored watermark MUST get a full
-        # snapshot — with last_uuid/evicted_up_to left at 0,
-        # can_resume_from(0) would be true and the push loop would serve
-        # PARTSYNC that silently omits every restored key (permanent
-        # divergence).  Same rule the reference applies when the resume
-        # point falls outside the ring (push.rs:95-110).
-        node.repl_log.last_uuid = meta.repl_last_uuid
-        node.repl_log.evicted_up_to = meta.repl_last_uuid
-        # snapshot-backed: the restored keyspace carries the state behind
-        # the recorded watermarks, so adopting them is lossless (and
-        # required — see merge_records)
-        node.replicas.merge_records(records, my_addr=app.advertised_addr,
-                                    adopt_watermarks=True)
-        log.info("restored snapshot %s (%d keys)", app.snapshot_path,
-                 node.ks.n_keys())
+        try:
+            meta, records = load_snapshot(app.snapshot_path, node.ks,
+                                          engine=node.engine)
+        except _SNAPSHOT_LOAD_ERRORS as e:
+            # a truncated/bit-flipped file can fail MID-merge: discard
+            # whatever partial state landed (fresh keyspace + resident
+            # mirrors) so the quarantined boot is really empty, not a
+            # silent partial restore a peer would then merge against
+            if hasattr(node.engine, "discard_resident"):
+                node.engine.discard_resident()
+            node.ks = node._make_keyspace()
+            _quarantine_snapshot(node, app.snapshot_path, e)
+        else:
+            if meta.node_id and not node.node_id:
+                node.node_id = meta.node_id
+            node.hlc.observe(meta.repl_last_uuid)
+            # The fresh repl_log does not cover any of the restored
+            # history, so a peer resuming below the restored watermark
+            # MUST get a full snapshot — with last_uuid/evicted_up_to
+            # left at 0, can_resume_from(0) would be true and the push
+            # loop would serve PARTSYNC that silently omits every
+            # restored key (permanent divergence).  Same rule the
+            # reference applies when the resume point falls outside the
+            # ring (push.rs:95-110).
+            node.repl_log.last_uuid = meta.repl_last_uuid
+            node.repl_log.evicted_up_to = meta.repl_last_uuid
+            # snapshot-backed: the restored keyspace carries the state
+            # behind the recorded watermarks, so adopting them is
+            # lossless (and required — see merge_records)
+            node.replicas.merge_records(records,
+                                        my_addr=app.advertised_addr,
+                                        adopt_watermarks=True)
+            log.info("restored snapshot %s (%d keys)", app.snapshot_path,
+                     node.ks.n_keys())
     await app.start()
     return app

@@ -74,6 +74,15 @@ class NodeStats:
     serve_flushes: int = 0
     serve_barriers: int = 0
     serve_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
+    # overload governance (server/overload.py + server/io.py +
+    # replica/link.py): client data writes shed at the maxmemory soft
+    # watermark, hard-watermark reclaim sweeps, slow-reading clients
+    # disconnected at the reply-buffer cap, and push loops paused on a
+    # full per-peer replication window
+    oom_shed_writes: int = 0
+    oom_hard_reclaims: int = 0
+    client_outbuf_disconnects: int = 0
+    repl_window_pauses: int = 0
     merges: int = 0
     merge_rows: int = 0
     merge_secs: float = 0.0
@@ -175,6 +184,11 @@ class Node:
         self.stats = NodeStats()
         # undoable local counter ops (CNTUNDO — server/commands.py)
         self.undo = CounterUndoLog()
+        # overload governance: memory accounting + maxmemory watermarks
+        # (server/overload.py; env-configured here, ServerApp / shard
+        # workers override via governor.configure)
+        from .overload import OverloadGovernor
+        self.governor = OverloadGovernor(self)
         from ..replica.manager import ReplicaManager
         self.replicas = ReplicaManager()
         # bumped by reset_for_full_resync; replica links stamp it at

@@ -167,6 +167,15 @@ class ServeCoalescer:
                 spans.append(len(out))
             return
         plan = [self._planner_of(m) for m in msgs]
+        gov = self.node.governor
+        if gov.maxmemory and gov.shed_writes(weight=len(msgs)):
+            # maxmemory shed: data-growing writes must NOT be planned —
+            # they fall through to _exec, where execute() returns the
+            # exact -OOM error without applying, logging, or
+            # replicating anything.  Exempt planners (srem/hdel free
+            # memory) keep riding the run.
+            plan = [None if fn is not None and self._oom_gated(m) else fn
+                    for fn, m in zip(plan, msgs)]
         n = len(msgs)
         n_plannable = sum(f is not None for f in plan)
         if n_plannable >= _PREPROBE_MIN:
@@ -203,6 +212,15 @@ class ServeCoalescer:
         self._cur_uuid = None
         if self._pending:
             self.flush()
+
+    @staticmethod
+    def _oom_gated(msg) -> bool:
+        """Is this (already known-plannable) command a data-growing
+        write the maxmemory soft watermark sheds (CMD_DENYOOM)?"""
+        from .commands import CMD_DENYOOM
+        name = msg.items[0].val
+        cmd = COMMANDS.get(name) or COMMANDS.get(name.lower())
+        return cmd is not None and bool(cmd.flags & CMD_DENYOOM)
 
     @staticmethod
     def _planner_of(msg):

@@ -54,7 +54,8 @@ from .repl_log import MergedReplLog
 log = logging.getLogger(__name__)
 
 _STAT_GAUGES = (("msgs", "msgs"), ("flushes", "flushes"),
-                ("barriers", "barriers"), ("keys", "keys"))
+                ("barriers", "barriers"), ("keys", "keys"),
+                ("used_bytes", "used_bytes"))
 
 
 class _Sub:
@@ -98,10 +99,17 @@ class ServeShardPlane:
     async def start(self) -> None:
         from ..parallel.serve_pool import ServeShardPool
         node = self.node
+        gov = node.governor
         self.pool = ServeShardPool(self.n_shards,
                                    engine_spec=self.engine_spec,
                                    node_id=node.node_id, alias=node.alias,
-                                   serve_batch=self.app.serve_batch)
+                                   serve_batch=self.app.serve_batch,
+                                   # each worker governs its slice of
+                                   # the node cap (serve_pool worker
+                                   # main; 0 stays unlimited)
+                                   maxmemory=gov.maxmemory
+                                   // self.n_shards,
+                                   maxmemory_soft_pct=gov.soft_pct)
         node.serve_plane = self
         node.repl_log = self.merged
         x = node.stats.extra
@@ -164,13 +172,26 @@ class ServeShardPlane:
                 fut.add_done_callback(
                     lambda f, s=shard, t=sub.token:
                         self._on_serve_ack(s, t, f))
-                futs.append((fut, sub.idxs))
+                futs.append((fut, sub.idxs, shard, sub.token))
             subs.clear()
 
         async def quiesce() -> None:
             dispatch()
-            for fut, idxs in futs:
+            for fut, idxs, shard, token in futs:
                 res = await fut
+                # run the ack bookkeeping NOW, not "soon": a future that
+                # resolved while this loop was awaiting an EARLIER one
+                # returns from its await without yielding, with its
+                # done-callback still queued behind this task's wakeup —
+                # a barrier executing right after quiesce would then
+                # read the merged repl_log MISSING entries whose writes
+                # already replied OK (found by the overload round's
+                # stress runs: REPLLOG UUIDS intermittently saw one
+                # shard's sub-chunk absent).  _on_serve_ack is
+                # idempotent, so the still-queued callback is a no-op —
+                # and remains the mirror-of-record when a client
+                # disconnect cancels this coroutine mid-quiesce.
+                self._on_serve_ack(shard, token, fut)
                 sout, spans = res[0], res[1]
                 prev = 0
                 for j, idx in enumerate(idxs):
@@ -239,10 +260,16 @@ class ServeShardPlane:
             out += r
 
     def _on_serve_ack(self, shard: int, token: Optional[int], fut) -> None:
-        """Reply-order callback (FIFO per shard): mirror the worker's
-        log entries into this shard's segment, then release the floor
-        window, then wake the pushers — that order is what keeps the
-        merged stream strictly increasing."""
+        """Reply-order ack bookkeeping (FIFO per shard): mirror the
+        worker's log entries into this shard's segment, then release
+        the floor window, then wake the pushers — that order is what
+        keeps the merged stream strictly increasing.  Runs exactly once
+        per future (idempotence guard): inline from quiesce() for
+        already-resolved futures (see the race note there) and via the
+        done-callback otherwise."""
+        if getattr(fut, "_cst_acked", False):
+            return
+        fut._cst_acked = True
         if fut.cancelled() or fut.exception() is not None:
             # the worker failed mid-chunk: its entries may be missing,
             # so the window stays HELD — the peer stream stalls on this
@@ -275,6 +302,7 @@ class ServeShardPlane:
         st.serve_barriers += stats["barriers"] - last.get("barriers", 0)
         st.repl_apply_barriers += \
             stats["apply_barriers"] - last.get("apply_barriers", 0)
+        st.oom_shed_writes += stats["oom_shed"] - last.get("oom_shed", 0)
         if stats.get("lat"):
             st.serve_lat.extend(stats["lat"])
         self._last_stats[shard] = stats
@@ -442,6 +470,12 @@ class ShardApplier:
     @property
     def pending(self) -> int:
         return self._frames
+
+    @property
+    def pending_bytes(self) -> int:
+        """Buffered-but-unlanded frame bytes (overload accounting —
+        the pull loop registers a governor source reading this)."""
+        return sum(map(len, self._bufs))
 
     async def aapply(self, items: list) -> None:
         uuid = as_int(items[3])

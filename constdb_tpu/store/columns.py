@@ -82,6 +82,14 @@ class Columns:
         exposes jemalloc's allocated gauge; this is the store-exact part)."""
         return sum(getattr(self, "_" + name).nbytes for name in self._spec)
 
+    def live_bytes(self) -> int:
+        """LIVE row bytes (n rows x per-row width), independent of the
+        pow2 capacity — the overload governor's accounting unit
+        (server/overload.py): a hash-partitioned store's shards sum to
+        exactly the single-store figure, which capacity-based accounting
+        cannot (each shard rounds its capacity up separately)."""
+        return self.n * sum(dt.itemsize for dt in self._spec.values())
+
 
 class TensorCols(Columns):
     """Tensor contributor slots — the envelope half of the tensor plane

@@ -52,6 +52,73 @@ _I64 = np.int64
 FAMILIES = ("env", "reg", "cnt", "el", "tns")
 
 
+def _blen(x) -> int:
+    return len(x) if x is not None else 0
+
+
+class BlobList(list):
+    """Side list of optional byte-strings with incremental byte
+    accounting into its keyspace's `blob_bytes` gauge.
+
+    Every blob plane (key bytes, register values, element members and
+    values) is one of these, so the overload governor's `used_bytes`
+    stays exact through EVERY mutation path — the op-path setters, the
+    engines' winner-assignment loops, and the flush path's slice writes
+    — without instrumenting each call site (there are a dozen across
+    engine/hostbatch.py and engine/tpu.py alone, all hot).  Two escape
+    hatches exist, both fenced: rebinding the attribute to a plain list
+    (only `_compact_elements` does it, adjusting the gauge itself), and
+    the list mutators no blob plane uses — those raise loudly below
+    instead of silently drifting the gauge, so a future call site must
+    add its accounting here first.
+
+    Pickles as a plain list (shard workers ship copies of these in
+    `keyspace_state_bytes`; the receiving side owns no gauge)."""
+
+    __slots__ = ("ks",)
+
+    def __init__(self, ks, items=()):
+        super().__init__(items)
+        ks.blob_bytes += sum(map(_blen, self))
+        self.ks = ks
+
+    def append(self, x) -> None:
+        self.ks.blob_bytes += _blen(x)
+        list.append(self, x)
+
+    def extend(self, it) -> None:
+        n0 = len(self)
+        list.extend(self, it)
+        if len(self) > n0:
+            self.ks.blob_bytes += sum(map(_blen,
+                                          list.__getitem__(
+                                              self, slice(n0, None))))
+
+    def __setitem__(self, i, v) -> None:
+        if type(i) is slice:
+            old = sum(map(_blen, list.__getitem__(self, i)))
+            v = list(v)
+            list.__setitem__(self, i, v)
+            self.ks.blob_bytes += sum(map(_blen, v)) - old
+        else:
+            self.ks.blob_bytes += _blen(v) - _blen(list.__getitem__(self, i))
+            list.__setitem__(self, i, v)
+
+    def _unaccounted(self, *_a, **_k):
+        raise NotImplementedError(
+            "unaccounted BlobList mutation — this mutator would drift "
+            "KeySpace.blob_bytes silently; add byte accounting to "
+            "BlobList before using it on a blob plane")
+
+    # no blob plane uses these today (the accounting property test
+    # would not catch a silent bypass, so fail loudly instead)
+    pop = remove = insert = clear = _unaccounted
+    __delitem__ = __iadd__ = __imul__ = _unaccounted
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
 class _KeyCols(Columns):
     def __init__(self) -> None:
         super().__init__(
@@ -87,9 +154,14 @@ class KeySpace:
 
     def __init__(self) -> None:
         self.keys = _KeyCols()
-        self.key_bytes: list[bytes] = []
+        # exact byte total of every blob side list (key bytes, register
+        # values, element members/values) — maintained incrementally by
+        # BlobList through every mutation path; `used_bytes` folds it
+        # into the overload governor's memory accounting
+        self.blob_bytes = 0
+        self.key_bytes: list[bytes] = BlobList(self)
         self.key_index = StrTable(8096)
-        self.reg_val: list[Optional[bytes]] = []
+        self.reg_val: list[Optional[bytes]] = BlobList(self)
         # per-CRDT-plane write versions, bumped by op-path writes: a
         # device-resident merge engine drops ONLY the mirrors of planes
         # that actually changed (engine/tpu.py; a global version made
@@ -118,8 +190,8 @@ class KeySpace:
         self.node_ids: list[int] = []
 
         self.el = _ElCols()
-        self.el_member: list[Optional[bytes]] = []
-        self.el_val: list[Optional[bytes]] = []
+        self.el_member: list[Optional[bytes]] = BlobList(self)
+        self.el_val: list[Optional[bytes]] = BlobList(self)
         self.member_index = StrTable(8192)
         self.el_index = I64Dict(8192)
         self.el_rows_by_kid: dict[int, list[int]] = {}
@@ -988,8 +1060,13 @@ class KeySpace:
                             add_t=self.el.add_t[live],
                             add_node=self.el.add_node[live],
                             del_t=self.el.del_t[live])
-        members = [self.el_member[r] for r in live.tolist()]
-        self.el_val = [self.el_val[r] for r in live.tolist()]
+        # rebinding the blob planes bypasses BlobList accounting: retire
+        # the old lists' bytes, and the fresh BlobLists re-add their own
+        # (net zero — gc() already nulled every dead row's blobs)
+        self.blob_bytes -= sum(map(_blen, self.el_member)) + \
+            sum(map(_blen, self.el_val))
+        members = BlobList(self, (self.el_member[r] for r in live.tolist()))
+        self.el_val = BlobList(self, (self.el_val[r] for r in live.tolist()))
         self.el_member = members
         self.el = new_el
         self.el_dead = 0
@@ -1069,6 +1146,30 @@ class KeySpace:
             d["elems"] = sorted(self.elem_all(kid))
         return d
 
+    def used_bytes(self) -> int:
+        """The store's governed memory footprint (server/overload.py):
+        LIVE numeric rows + the incrementally-tracked blob and tensor
+        payload bytes.  Deliberately excludes index-table overhead and
+        pow2 column slack so shards=N sums to exactly the shards=1
+        figure (the accounting-invariance property test pins this) —
+        the watermarks are set against this gauge, so what matters is
+        that it tracks growth exactly, not that it equals RSS."""
+        return (self.keys.live_bytes() + self.cnt.live_bytes()
+                + self.el.live_bytes() + self.tns.live_bytes()
+                + self.blob_bytes + self.tns_bytes)
+
+    def release_warm_caches(self) -> None:
+        """Drop rebuildable warm-path caches (the hard-watermark
+        degradation step, server/overload.py): the incremental digest
+        crc caches — the next digest exchange re-fills them lazily, at
+        the documented off-loop-warm cost.  Taken under the crc lock so
+        an in-flight off-loop warm can never store a freed cache back."""
+        with self._crc_lock:
+            self._key_crc = None
+            self._key_crc_n = 0
+            self._member_crc = None
+            self._member_crc_n = 0
+
     def memory_report(self) -> dict:
         """Store memory accounting for INFO: exact numeric-plane bytes
         (column capacities) plus row/byte-string counts (the blob planes
@@ -1076,6 +1177,8 @@ class KeySpace:
         objects, so INFO reports counts and lets RSS cover the rest —
         reference src/lib.rs:63-78 leans on jemalloc the same way)."""
         return {
+            "used_bytes": self.used_bytes(),
+            "blob_bytes": self.blob_bytes,
             "numeric_bytes": (self.keys.nbytes() + self.cnt.nbytes()
                               + self.el.nbytes() + self.tns.nbytes()
                               + sum(a.nbytes for _, a

@@ -115,9 +115,18 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
     Py_ssize_t pos;
     PyObject *arr_t, *bulk_t, *int_t, *simple_t, *err_t, *nil_obj;
     Py_ssize_t max_msgs = 1024;
-    if (!PyArg_ParseTuple(args, "y*nOOOOOO|n", &view, &pos, &arr_t, &bulk_t,
-                          &int_t, &simple_t, &err_t, &nil_obj, &max_msgs))
+    // configurable parse-time bulk ceiling (CONSTDB_PROTO_MAX_BULK):
+    // a $-header past it defers to the pure parser, which raises the
+    // protocol error — never buffers toward the declared length.
+    // Clamped to the wire format's hard 512MB ceiling; <= 0 = default.
+    long long max_bulk = 0;
+    if (!PyArg_ParseTuple(args, "y*nOOOOOO|nL", &view, &pos, &arr_t, &bulk_t,
+                          &int_t, &simple_t, &err_t, &nil_obj, &max_msgs,
+                          &max_bulk))
         return nullptr;
+    const long long bulk_cap =
+        (max_bulk > 0 && max_bulk < resp::kMaxBulk) ? max_bulk
+                                                    : resp::kMaxBulk;
     const char* b = static_cast<const char*>(view.buf);
     const Py_ssize_t len = view.len;
     resp::Names& nm = resp::names();
@@ -195,7 +204,7 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
                 Py_INCREF(nil_obj);
                 obj = nil_obj;
             } else {
-                if (ln > resp::kMaxBulk) {
+                if (ln > bulk_cap) {
                     fallback = 1;  // pure parser raises "too large"
                     break;
                 }
@@ -252,7 +261,7 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
                         partial = true;
                         break;
                     }
-                    if (ln < 0 || ln > resp::kMaxBulk) {
+                    if (ln < 0 || ln > bulk_cap) {
                         fb = true;  // $-1 / oversized: general path
                         break;
                     }

@@ -156,6 +156,34 @@ print("tensor smoke verified:",
 EOF
 
 echo
+echo "== overload smoke (bench --mode serve --overload + chaos resource cells) =="
+# a memory-capped node under a firehose pipeline: survives, sheds with
+# the exact -OOM error, non-shed reply latency stays bounded, and the
+# accounting gauges match the pressure (server/overload.py).  Then the
+# chaos resource cells certify the convergence half: shed writes were
+# never partially applied or replicated, replication intake stayed
+# admitted, a peer converges byte-identical to the CPU reference, a
+# stalled client is cut at the outbuf cap, and a stalled peer recovers
+# through the repl-window pause -> eviction -> resync path.
+JAX_PLATFORMS=cpu CONSTDB_BENCH_OVL_OPS=12000 \
+    timeout -k 10 300 python bench.py --mode serve --overload \
+    > /tmp/_ci_overload.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_overload.json"))
+assert out["verified"], "overload smoke failed verification"
+assert out["survived"] and out["other_errors"] == 0
+assert out["shed"] > 0 and out["landed"] > 0, "no shed/landed split"
+assert out["reply_p99_ms"] < 1000, \
+    f"non-shed p99 {out['reply_p99_ms']}ms — shedding is livelocking"
+print("overload smoke verified:",
+      f"{out['value']:.0%} shed at {out['rps']} req/s,",
+      f"p99 {out['reply_p99_ms']}ms, state {out['overload_state']}")
+EOF
+JAX_PLATFORMS=cpu timeout -k 10 300 python -m constdb_tpu.chaos \
+    --resource --seed 7 || exit $?
+
+echo
 echo "== chaos smoke (fixed-seed certification cells) =="
 # the scripted chaos scenario — partitions + reorder + duplication +
 # mid-frame truncation + connection/process kills + clock jitter + one

@@ -34,10 +34,13 @@ def test_certify_default_cell(tmp_path):
     cell, full oracle verified."""
     stats = run_scenario(certify_scenario(7, Cell()))
     plane = stats["plane"]
-    # the schedule really injected the faults it promises
+    # the schedule really injected the faults it promises (the verified
+    # corrupt burst may consume a few one-shots when a carrying
+    # connection dies before delivery — fate-sharing; the scenario
+    # itself asserts a demotion was OBSERVED)
     assert plane.get("partitions", 0) >= 3
     assert plane.get("truncations", 0) == 1
-    assert plane.get("wire_corruptions", 0) == 1
+    assert plane.get("wire_corruptions", 0) >= 1
     assert stats["reconnects"] >= 1
 
 
@@ -71,6 +74,35 @@ def test_crash_styles_converge(tmp_path):
         ("certify",),
     ]
     run_scenario(Scenario(seed=5, steps=steps))
+
+
+def test_resource_cells_certify(tmp_path):
+    """The resource-fault cells (chaos/resource.py): a memory-capped
+    node under a firehose sheds with exact -OOM replies while
+    replication intake lands and the mesh converges to the CPU
+    reference; a stalled-reader client is cut at the outbuf cap without
+    touching other connections; a stalled-reader peer trips the repl
+    window pause and recovers through the certified resync path."""
+    from constdb_tpu.chaos import run_resource_scenario
+
+    stats = run_resource_scenario(7)
+    assert stats["firehose"]["shed"] > 0
+    assert stats["firehose"]["landed"] > 0
+    assert stats["stalled_client"]["outbuf_disconnects"] == 1
+    assert stats["stalled_peer"]["window_pauses"] >= 1
+    assert stats["stalled_peer"]["resyncs"] >= 1
+
+
+def test_resource_cells_replay_from_seed(tmp_path):
+    """Same seed, same shed/landed split and converged key count — the
+    resource schedule is deterministic like every chaos schedule."""
+    from constdb_tpu.chaos import run_resource_scenario
+
+    a = run_resource_scenario(23)
+    b = run_resource_scenario(23)
+    assert a["firehose"]["landed"] == b["firehose"]["landed"]
+    assert a["firehose"]["canonical_keys"] == \
+        b["firehose"]["canonical_keys"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,456 @@
+"""Overload governance (server/overload.py + the accounting layer).
+
+Covers the four pillars of the overload work: (1) exact incremental
+memory accounting — the `used_memory` property test across every write
+family, engine path, GC/compaction shrink, and the shards=N == shards=1
+summation law; (2) watermark shedding — client data writes shed with the
+exact -OOM error while deletes, reads, admin, and replication intake
+stay admitted, on both the per-command and coalesced serve paths; (3)
+slow-client protection — a non-reading client is disconnected at
+CONSTDB_CLIENT_OUTBUF_MAX without touching other connections; (4) boot
+resilience + durability satellites — corrupt snapshots quarantine
+through the real start_node path, and durable dumps fsync the parent
+directory after the atomic rename.
+
+The end-to-end resource-fault certification (firehose convergence,
+stalled peer window pause -> eviction -> resync) lives in the chaos
+harness (constdb_tpu/chaos/resource.py, run by tests/test_chaos.py and
+the ci.sh overload smoke)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from constdb_tpu.replica.coalesce import BatchBuilder
+from constdb_tpu.resp.codec import encode_msg
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, NoReply
+from constdb_tpu.server.commands import COLUMNAR_ENCODERS
+from constdb_tpu.server.node import Node
+from constdb_tpu.server.overload import OOM_ERR
+from constdb_tpu.store.keyspace import KeySpace
+
+
+# ------------------------------------------------------- accounting truth
+
+
+def blob_truth(ks: KeySpace) -> int:
+    return sum(len(x) for lst in (ks.key_bytes, ks.reg_val,
+                                  ks.el_member, ks.el_val)
+               for x in lst if x is not None)
+
+
+def used_truth(ks: KeySpace) -> int:
+    numeric = sum(t.n * sum(dt.itemsize for dt in t._spec.values())
+                  for t in (ks.keys, ks.cnt, ks.el, ks.tns))
+    tns = sum(p.nbytes for p in ks.tns_payload if p is not None)
+    return numeric + blob_truth(ks) + tns
+
+
+def check_exact(ks: KeySpace, where: str) -> None:
+    assert ks.blob_bytes == blob_truth(ks), where
+    assert ks.tns_bytes == sum(p.nbytes for p in ks.tns_payload
+                               if p is not None), where
+    assert ks.used_bytes() == used_truth(ks), where
+
+
+OPS = [  # one op per write family, mixed growth shapes
+    (b"set", [b"r1", b"hello"]),
+    (b"set", [b"r1", b"a-longer-replacement-value"]),
+    (b"incr", [b"c1", b"5"]),
+    (b"decr", [b"c1", b"2"]),
+    (b"sadd", [b"s1", b"m1", b"m2", b"m3"]),
+    (b"srem", [b"s1", b"m2"]),
+    (b"hset", [b"h1", b"f1", b"v1"]),
+    (b"hset", [b"h1", b"f1", b"value-grew"]),
+    (b"hdel", [b"h1", b"f1"]),
+    (b"mvset", [b"mv1", b"alpha"]),
+    (b"lpush", [b"l1", b"x"]),
+    (b"rpush", [b"l1", b"y"]),
+    (b"del", [b"r1"]),
+]
+
+
+def test_used_memory_tracks_every_write_family():
+    """Accounting invariance: used_memory deltas match recomputed
+    column/blob growth after every single op, across every family."""
+    node = Node(node_id=1)
+    for i, (name, args) in enumerate(OPS):
+        reply = node.execute([Bulk(name)] + [Bulk(a) for a in args])
+        assert not isinstance(reply, Err), (name, reply)
+        check_exact(node.ks, f"op {i}: {name}")
+    # tensor family: payload bytes ride tns_bytes
+    arr = np.arange(64, dtype="<f4").tobytes()
+    r = node.execute([Bulk(b"tensor.set"), Bulk(b"t1"), Bulk(b"sum"),
+                      Bulk(b"f32"), Bulk(b"64"), Bulk(arr)])
+    assert not isinstance(r, Err), r
+    r = node.execute([Bulk(b"tensor.merge"), Bulk(b"t1"), Bulk(arr)])
+    assert not isinstance(r, Err), r
+    check_exact(node.ks, "tensor ops")
+
+
+def test_used_memory_tracks_engine_merge_paths():
+    """The columnar merge paths (hostbatch group encode + both engines)
+    keep the gauge exact — the BlobList accounting covers the engines'
+    winner-assignment loops and flush slice writes."""
+    from constdb_tpu.engine.tpu import TpuMergeEngine
+
+    for engine in (None, TpuMergeEngine(resident=True, steady=True,
+                                        warmup=0)):
+        node = Node(node_id=1, engine=engine)
+        bb = BatchBuilder(node.ks)
+        u0 = 10_000_000
+        COLUMNAR_ENCODERS[b"set"](bb, [
+            (b"k%d" % (j % 7), 9, u0 + j,
+             [None] * 5 + [Bulk(b"k%d" % (j % 7)), Bulk(b"val%04d" % j)])
+            for j in range(40)])
+        COLUMNAR_ENCODERS[b"sadd"](bb, [
+            (b"s%d" % (j % 3), 9, u0 + 100 + j,
+             [None] * 5 + [Bulk(b"s%d" % (j % 3)), Bulk(b"mem%d" % j)])
+            for j in range(30)])
+        node.merge_batches([bb.finalize()])
+        node.ensure_flushed()
+        check_exact(node.ks, f"engine {getattr(engine, 'name', 'cpu')}")
+        if engine is not None:
+            engine.close()
+
+
+def test_used_memory_shrinks_through_gc_and_compaction():
+    node = Node(node_id=1)
+    for j in range(50):
+        node.execute([Bulk(b"sadd"), Bulk(b"s"), Bulk(b"m%02d" % j)])
+    for j in range(50):
+        node.execute([Bulk(b"srem"), Bulk(b"s"), Bulk(b"m%02d" % j)])
+    before = node.ks.used_bytes()
+    node.gc()  # standalone: horizon = own clock, tombstones collect
+    check_exact(node.ks, "after gc")
+    assert node.ks.blob_bytes < before  # member/value blobs freed
+    node.ks._compact_elements()
+    check_exact(node.ks, "after compaction")
+    assert node.ks.el.n == 0  # every row was dead
+
+
+def test_shard_sum_matches_single():
+    """shards=N accounting sums to exactly the shards=1 figure: live
+    numeric bytes (not pow2 capacities) + exact blob bytes partition
+    with the keys.  Driven through the replication rewrites (the stream
+    every shard worker applies)."""
+    from constdb_tpu.store.sharded_keyspace import shard_of
+
+    single = Node(node_id=1)
+    shards = [Node(node_id=1), Node(node_id=1)]
+    u = 10_000_000
+    stream = []
+    for j in range(60):
+        stream.append((b"set", [b"r%d" % (j % 11), b"val-%04d" % j]))
+        stream.append((b"cntset", [b"c%d" % (j % 5), b"%d" % j]))
+        stream.append((b"sadd", [b"s%d" % (j % 3), b"m%d" % j]))
+        stream.append((b"hset", [b"h%d" % (j % 4), b"f%d" % (j % 6),
+                                 b"hv%d" % j]))
+        if j % 7 == 0:
+            stream.append((b"srem", [b"s%d" % (j % 3), b"m%d" % (j - 1)]))
+            stream.append((b"delbytes", [b"r%d" % (j % 11)]))
+    for name, args in stream:
+        u += 7
+        margs = [Bulk(a) for a in args]
+        single.apply_replicated(name, margs, 9, u)
+        shards[shard_of(args[0], 2)].apply_replicated(name, margs, 9, u)
+    for n in (single, *shards):
+        check_exact(n.ks, "shard member")
+    assert sum(s.ks.used_bytes() for s in shards) == \
+        single.ks.used_bytes()
+
+
+# ------------------------------------------------------------ watermarks
+
+
+def capped_node(cap: int = 4096, soft_pct: float = 50.0) -> Node:
+    node = Node(node_id=1)
+    node.governor.configure(cap, soft_pct)
+    node.governor.check_every = 1
+    return node
+
+
+def fill(node: Node, n: int = 40, size: int = 128) -> None:
+    for j in range(n):
+        node.execute([Bulk(b"set"), Bulk(b"fill%d" % j),
+                      Bulk(b"x" * size)])
+
+
+def test_soft_watermark_sheds_exact_error():
+    node = capped_node()
+    fill(node)
+    assert node.governor.used_memory() >= node.governor.soft_bytes
+    logged = len(node.repl_log)
+    keys = node.ks.n_keys()
+    shed0 = node.stats.oom_shed_writes
+    r = node.execute([Bulk(b"set"), Bulk(b"shed-me"), Bulk(b"v")])
+    assert isinstance(r, Err) and r.val == OOM_ERR
+    # never partially applied, logged, or replicated
+    assert node.ks.lookup(b"shed-me") < 0
+    assert len(node.repl_log) == logged and node.ks.n_keys() == keys
+    assert node.stats.oom_shed_writes == shed0 + 1
+    for name, args in ((b"incr", [b"c", b"1"]),
+                       (b"sadd", [b"s", b"m"]),
+                       (b"cntundo", [b"c"]),
+                       (b"tensor.set", [b"t", b"-", b"f32", b"4",
+                                        b"\0" * 16])):
+        r = node.execute([Bulk(name)] + [Bulk(a) for a in args])
+        assert isinstance(r, Err) and r.val == OOM_ERR, name
+
+
+def test_exempt_paths_admitted_while_shedding():
+    node = capped_node()
+    fill(node)
+    # reads, deletes, removals, expiry, admin — all admitted
+    assert not isinstance(
+        node.execute([Bulk(b"get"), Bulk(b"fill0")]), Err)
+    assert node.execute([Bulk(b"del"), Bulk(b"fill0")]) == Int(1)
+    assert not isinstance(
+        node.execute([Bulk(b"expire"), Bulk(b"fill1"), Bulk(b"1000")]),
+        Err)
+    assert not isinstance(
+        node.execute([Bulk(b"info"), Bulk(b"memory")]), Err)
+    # replication intake NEVER sheds — the convergence-soundness law
+    before = node.stats.oom_shed_writes
+    node.apply_replicated(b"set", [Bulk(b"from-peer"), Bulk(b"x" * 512)],
+                          9, 1 << 60)
+    assert node.ks.lookup(b"from-peer") >= 0
+    assert node.stats.oom_shed_writes == before
+
+
+def test_recovery_unsheds():
+    node = capped_node()
+    fill(node)
+    assert isinstance(
+        node.execute([Bulk(b"set"), Bulk(b"nope"), Bulk(b"v")]), Err)
+    node.governor.configure(1 << 30)  # operator raises the cap
+    r = node.execute([Bulk(b"set"), Bulk(b"yes"), Bulk(b"v")])
+    assert not isinstance(r, Err)
+
+
+def test_hard_watermark_reclaims_warm_caches():
+    node = capped_node(cap=2048, soft_pct=50.0)
+    # grow past the HARD watermark via replication intake — client
+    # writes would shed at soft and never get there
+    for j in range(10):
+        node.apply_replicated(b"set", [Bulk(b"p%d" % j), Bulk(b"x" * 512)],
+                              9, (1 << 60) + j)
+    node.ks.key_crcs()  # warm a digest crc cache
+    assert node.ks._key_crc is not None
+    node.governor._last_hard = -10.0  # defeat the rate limit
+    r = node.execute([Bulk(b"set"), Bulk(b"x"), Bulk(b"y")])
+    assert isinstance(r, Err) and r.val == OOM_ERR
+    assert node.governor.state_name == "hard"
+    assert node.stats.oom_hard_reclaims >= 1
+    assert node.ks._key_crc is None  # warm cache dropped
+
+
+def test_serve_coalescer_sheds_planned_writes():
+    """The pipelined serve path demotes data writes to the per-command
+    path while shedding, so they return the exact OOM error and the run
+    never plans/lands them; exempt planners (srem) keep riding."""
+    from constdb_tpu.server.serve import ServeCoalescer
+
+    node = capped_node()
+    node.execute([Bulk(b"sadd"), Bulk(b"s"), Bulk(b"keep")])
+    fill(node)
+    coal = ServeCoalescer(node, max_run=64)
+    logged = len(node.repl_log)
+    shed0 = node.stats.oom_shed_writes
+    out = bytearray()
+    msgs = [Arr([Bulk(b"set"), Bulk(b"a%d" % j), Bulk(b"v")])
+            for j in range(6)] + \
+        [Arr([Bulk(b"srem"), Bulk(b"s"), Bulk(b"keep")])]
+    coal.run_chunk(msgs, out)
+    assert bytes(out).count(b"-" + OOM_ERR) == 6, bytes(out)[:200]
+    assert b":1\r\n" in bytes(out)  # the srem flip landed
+    assert node.ks.lookup(b"a0") < 0
+    assert len(node.repl_log) == logged + 1  # only the srem logged
+    assert node.stats.oom_shed_writes == shed0 + 6
+
+
+def test_info_overload_gauges():
+    node = capped_node()
+    fill(node)
+    node.execute([Bulk(b"set"), Bulk(b"x"), Bulk(b"y")])  # refresh state
+    reply = node.execute([Bulk(b"info"), Bulk(b"memory")])
+    text = bytes(reply.val)
+    assert b"used_memory:" in text
+    assert b"maxmemory:4096" in text
+    assert b"overload_state:" in text and b"overload_state:ok" not in text
+    reply = node.execute([Bulk(b"info"), Bulk(b"stats")])
+    text = bytes(reply.val)
+    for gauge in (b"oom_shed_writes:", b"oom_hard_reclaims:",
+                  b"client_outbuf_disconnects:", b"repl_window_pauses:"):
+        assert gauge in text, gauge
+
+
+def test_governor_check_cadence():
+    """The gate caches its verdict for check_every calls — pressure is
+    observed within one window, not on every single write."""
+    node = Node(node_id=1)
+    node.governor.configure(4096, 50.0)  # default check_every (64)
+    fill(node, n=80, size=256)
+    # well past the cap: the NEXT window must shed
+    shed = 0
+    for j in range(130):
+        r = node.execute([Bulk(b"set"), Bulk(b"w%d" % j), Bulk(b"v")])
+        shed += isinstance(r, Err)
+    assert shed >= 60  # at most one stale window of admits
+
+
+# ------------------------------------------------------ slow-client cap
+
+
+def test_outbuf_cap_disconnects_stalled_reader():
+    from constdb_tpu.server.io import start_node
+
+    async def run():
+        node = Node(node_id=1)
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               client_outbuf_max=1 << 16)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", app.port)
+            w.write(encode_msg(Arr([Bulk(b"set"), Bulk(b"big"),
+                                    Bulk(b"x" * (64 << 10))])))
+            await w.drain()
+            assert (await r.read(5)) == b"+OK\r\n"
+            # pipeline 1024 GETs of the 64KB value and stop reading
+            w.write(b"".join(encode_msg(Arr([Bulk(b"get"), Bulk(b"big")]))
+                             for _ in range(512)))
+            await w.drain()
+            for _ in range(500):
+                if node.stats.client_outbuf_disconnects:
+                    break
+                await asyncio.sleep(0.02)
+            assert node.stats.client_outbuf_disconnects == 1
+            # a healthy connection is untouched
+            r2, w2 = await asyncio.open_connection("127.0.0.1", app.port)
+            w2.write(encode_msg(Arr([Bulk(b"get"), Bulk(b"big")])))
+            await w2.drain()
+            got = await r2.readexactly(16)
+            assert got.startswith(b"$65536\r\n")
+            w2.close()
+            w.close()
+        finally:
+            await app.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------- boot resilience + durability
+
+
+def _dump_node(tmp_path, n_keys: int = 50) -> tuple[Node, str]:
+    from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
+
+    node = Node(node_id=5, alias="orig")
+    for j in range(n_keys):
+        node.execute([Bulk(b"set"), Bulk(b"k%d" % j), Bulk(b"v%d" % j)])
+    path = str(tmp_path / "boot.snapshot")
+    dump_keyspace(path, node.ks,
+                  NodeMeta(node_id=5, alias="orig",
+                           repl_last_uuid=node.repl_log.last_uuid))
+    return node, path
+
+
+def _boot_and_expect_quarantine(path: str) -> None:
+    from constdb_tpu.server.io import start_node
+
+    async def run():
+        node = Node()
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               snapshot_path=path)
+        try:
+            # booted EMPTY and alive, with the evidence renamed aside
+            assert node.ks.n_keys() == 0
+            assert node.stats.extra["boot_snapshot_quarantined"] == \
+                path + ".corrupt"
+            r, w = await asyncio.open_connection("127.0.0.1", app.port)
+            w.write(encode_msg(Arr([Bulk(b"set"), Bulk(b"alive"),
+                                    Bulk(b"1")])))
+            await w.drain()
+            assert (await r.read(5)) == b"+OK\r\n"
+            w.close()
+        finally:
+            await app.close()
+
+    asyncio.run(run())
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+
+
+def test_boot_quarantines_truncated_snapshot(tmp_path):
+    _node, path = _dump_node(tmp_path)
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[: len(data) // 2])
+    _boot_and_expect_quarantine(path)
+
+
+def test_boot_quarantines_bitflipped_snapshot(tmp_path):
+    _node, path = _dump_node(tmp_path)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    _boot_and_expect_quarantine(path)
+
+
+def test_clean_snapshot_still_boots(tmp_path):
+    from constdb_tpu.server.io import start_node
+
+    _node, path = _dump_node(tmp_path)
+
+    async def run():
+        node = Node()
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               snapshot_path=path)
+        try:
+            assert node.ks.n_keys() == 50
+            assert "boot_snapshot_quarantined" not in node.stats.extra
+        finally:
+            await app.close()
+
+    asyncio.run(run())
+
+
+def test_snapshot_fsync_covers_parent_dir(tmp_path, monkeypatch):
+    """write_snapshot_file(fsync=True) must fsync the file AND the
+    parent directory after os.replace — the rename is atomic but not
+    durable until the directory entry syncs."""
+    from constdb_tpu.engine.base import batch_from_keyspace
+    from constdb_tpu.persist.snapshot import (NodeMeta, dump_keyspace,
+                                              write_snapshot_file)
+
+    node = Node(node_id=1)
+    node.execute([Bulk(b"set"), Bulk(b"k"), Bulk(b"v")])
+    synced: list = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(
+        os.path.isdir(f"/proc/self/fd/{fd}") if os.path.exists(
+            f"/proc/self/fd/{fd}") else False), real_fsync(fd)))
+    path = str(tmp_path / "d.snapshot")
+    write_snapshot_file(path, NodeMeta(node_id=1), [],
+                        [batch_from_keyspace(node.ks)], fsync=True)
+    assert True in synced and False in synced, synced  # dir AND file
+    synced.clear()
+    dump_keyspace(str(tmp_path / "d2.snapshot"), node.ks,
+                  NodeMeta(node_id=1), fsync=True)
+    assert True in synced and False in synced, synced
+    synced.clear()
+    write_snapshot_file(str(tmp_path / "d3.snapshot"), NodeMeta(node_id=1),
+                        [], [batch_from_keyspace(node.ks)], fsync=False)
+    assert not synced  # fsync=False stays fsync-free
+
+
+def test_snapshot_fsync_env_gate(monkeypatch):
+    from constdb_tpu.bin.server import _snapshot_fsync
+
+    monkeypatch.delenv("CONSTDB_SNAPSHOT_FSYNC", raising=False)
+    assert _snapshot_fsync() is True
+    monkeypatch.setenv("CONSTDB_SNAPSHOT_FSYNC", "0")
+    assert _snapshot_fsync() is False

@@ -143,6 +143,45 @@ def test_pushback_order(parser_cls):
     assert parser.next_msg() == msgs[1]
 
 
+@pytest.mark.parametrize("parser_cls", PARSERS)
+@pytest.mark.parametrize("header", (
+    b"$99999999999\r\n",                # absurd bulk: 93GB declared
+    b"$536870913\r\n",                  # one past the 512MB hard ceiling
+    b"*1\r\n$99999999999\r\n",          # absurd bulk inside an array
+    b"*99999999\r\n",                   # absurd array header
+))
+def test_absurd_headers_rejected_at_parse_time(parser_cls, header):
+    """Overload satellite (CONSTDB_PROTO_MAX_BULK): a malicious declared
+    length is a PROTOCOL error the moment the header line parses — the
+    parser must never sit buffering toward it (the pre-limit behavior
+    would happily accumulate 93GB before erroring)."""
+    parser = parser_cls()
+    parser.feed(header)
+    with pytest.raises(InvalidRequestMsg):
+        parser.next_msg()
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_configured_bulk_cap_enforced(parser_cls):
+    """A below-default CONSTDB_PROTO_MAX_BULK is enforced at header
+    parse time in BOTH parsers (the native scanner takes the cap as an
+    argument and defers over-cap headers to the pure parser's raise)."""
+    parser = parser_cls(max_bulk=1024)
+    ok = Arr([Bulk(b"set"), Bulk(b"k"), Bulk(b"v" * 1024)])
+    parser.feed(encode_msg(ok))
+    assert parser.next_msg() == ok
+    parser = parser_cls(max_bulk=1024)
+    parser.feed(b"*3\r\n$3\r\nset\r\n$1\r\nk\r\n$1025\r\n")
+    with pytest.raises(InvalidRequestMsg):
+        while parser.next_msg() is None:
+            pass  # pragma: no cover - raise happens on the first call
+    # lone oversized header outside an array: same rejection
+    parser = parser_cls(max_bulk=1024)
+    parser.feed(b"$2048\r\n")
+    with pytest.raises(InvalidRequestMsg):
+        parser.next_msg()
+
+
 def test_parsers_agree_on_random_trees():
     """The native parser (when the extension is built) and the pure
     parser produce identical message objects for identical bytes."""
