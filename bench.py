@@ -128,14 +128,27 @@ def _uuids(rng, n, span_ms=600_000):
 
 
 def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
-                  members_per_set: int = 4):
+                  members_per_set: int = 4, hlc_order: bool = False):
     """R snapshot batches over one mixed N-key keyspace.
 
     40% counters / 30% registers / 30% sets.  Immutable columns (key bytes,
     enc, member bytes) are built once and shared across batches — replica
     snapshots of the same keyspace really do share this data.
+
+    `hlc_order`: sort every uuid draw so columns are near-monotone in
+    key order — the shape a REAL node's dump has (keys created over
+    time, dumped in creation order; HLC uuids are arrival-ordered).
+    The default uniform-random draw is the adversarial shape for the
+    compressed-container bytes leg (uuid columns become incompressible
+    noise no real store produces).
     """
     rng = np.random.default_rng(seed)
+
+    def draw(n):
+        u = _uuids(rng, n)
+        if hlc_order:
+            u.sort()
+        return u
     keys = [b"k%010d" % i for i in range(n_keys)]
     enc = np.empty(n_keys, dtype=np.int8)
     n_cnt = int(n_keys * 0.4)
@@ -169,7 +182,7 @@ def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
         b.rows_unique_per_slot = True
         b.keys = keys
         b.key_enc = enc
-        b.key_ct = _uuids(rng, n_keys)
+        b.key_ct = draw(n_keys)
         b.key_mt = b.key_ct + (rng.integers(0, 1000, n_keys) << SEQ_BITS)
         # ~2% of keys tombstoned later than their create time
         dt = np.where(rng.random(n_keys) < 0.02,
@@ -180,7 +193,7 @@ def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
         b.reg_val = [None] * n_cnt + [reg_pool[i] for i in reg_idx] + \
                     [None] * n_set
         b.reg_t = np.zeros(n_keys, dtype=_I64)
-        b.reg_t[n_cnt:n_cnt + n_reg] = _uuids(rng, n_reg)
+        b.reg_t[n_cnt:n_cnt + n_reg] = draw(n_reg)
         b.reg_node = np.zeros(n_keys, dtype=_I64)
         b.reg_node[n_cnt:n_cnt + n_reg] = r + 1
 
@@ -188,17 +201,17 @@ def make_workload(n_keys: int, n_replicas: int, seed: int = 7,
         b.cnt_ki = np.arange(n_cnt, dtype=_I64)
         b.cnt_node = np.full(n_cnt, r + 1, dtype=_I64)
         b.cnt_val = rng.integers(-1000, 1000, n_cnt).astype(_I64)
-        b.cnt_uuid = _uuids(rng, n_cnt)
+        b.cnt_uuid = draw(n_cnt)
         b.cnt_base = np.zeros(n_cnt, dtype=_I64)
         b.cnt_base_t = np.full(n_cnt, S.NEUTRAL_T, dtype=_I64)
 
         b.el_ki = set_ki
         b.el_member = el_member
         b.el_val = el_val
-        b.el_add_t = _uuids(rng, len(set_ki))
+        b.el_add_t = draw(len(set_ki))
         b.el_add_node = np.full(len(set_ki), r + 1, dtype=_I64)
         b.el_del_t = np.where(rng.random(len(set_ki)) < 0.1,
-                              _uuids(rng, len(set_ki)), 0).astype(_I64)
+                              draw(len(set_ki)), 0).astype(_I64)
         batches.append(b)
     return batches
 
@@ -1065,6 +1078,310 @@ def encode_msg_frame(items) -> bytes:
     from constdb_tpu.resp.message import Arr
 
     return encode_msg(Arr(items))
+
+
+# ---------------------------------------------------------------- fan-out
+
+
+async def _fanout_replay(entries, n_peers: int, cache_mb: int,
+                         wire_batch: int, apply_batch: int,
+                         latency_s: float, compress: bool = False):
+    """One fan-out leg: ONE pusher node drives N real `_push_loop`s over
+    N socketpairs into N independent receiver nodes (the broadcast
+    plane's steady-state shape).  `cache_mb` sizes the encode-once run
+    cache (0 = the pre-broadcast every-peer-re-encodes path).  Returns
+    (recv_nodes, wall_s, pusher, per_link_rows)."""
+    import socket
+    import types
+
+    from constdb_tpu.replica.coalesce import CoalescingApplier
+    from constdb_tpu.replica.link import (CAP_BATCH_STREAM, CAP_COMPRESS,
+                                          PARTSYNC, REPLACK, REPLBATCH,
+                                          REPLICATE, ReplicaLink)
+    from constdb_tpu.replica.manager import ReplicaMeta
+    from constdb_tpu.resp.codec import make_parser
+    from constdb_tpu.resp.message import as_bytes, as_int
+    from constdb_tpu.server.node import Node
+
+    loop = asyncio.get_running_loop()
+    pusher = Node(node_id=99, repl_log_cap=1 << 40)
+    pusher.wire_cache.configure(cache_mb << 20)
+    for uuid, name, args in entries:
+        pusher.repl_log.push(uuid, name, args)
+    last = entries[-1][0]
+    # repl_window=0: these receivers never REPLACK, so any finite
+    # window would park the drain forever once a leg's stream bytes
+    # pass it (flow control is not what this leg measures)
+    app = types.SimpleNamespace(node=pusher, heartbeat=0.2,
+                                reconnect_delay=1.0, handshake_timeout=5.0,
+                                work_dir=".", wire_batch=wire_batch,
+                                wire_latency=0.005, repl_window=0)
+    caps = CAP_BATCH_STREAM | (CAP_COMPRESS if compress else 0)
+
+    async def receiver(pull_reader, stash) -> None:
+        # a real mesh's peers apply on OTHER machines: during the timed
+        # window this 2-core box only pays the pusher's fan-out plus
+        # minimal frame parsing (coverage detection); each captured
+        # stream is applied and oracle-verified AFTER the wall stops
+        parser = make_parser()
+        covered = 0
+        while covered < last:
+            msg = parser.next_msg()
+            if msg is None:
+                data = await pull_reader.read(1 << 16)
+                if not data:
+                    raise ConnectionError("fanout leg: EOF")
+                parser.feed(data)
+                continue
+            items = msg.items
+            kind = as_bytes(items[0]).lower()
+            if kind in (REPLICATE, REPLBATCH):
+                covered = as_int(items[3])
+                stash.append((kind, items))
+            elif kind not in (REPLACK, PARTSYNC):
+                raise AssertionError(f"unexpected wire frame {kind!r}")
+
+    links, writers, recv_coros, stashes = [], [], [], []
+    for i in range(n_peers):
+        meta = ReplicaMeta(addr=f"bench-fan:{i}")
+        pusher.replicas.peers[meta.addr] = meta
+        link = ReplicaLink(app, meta)
+        link._peer_caps = caps
+        s_push, s_pull = socket.socketpair()
+        _pr, push_writer = await asyncio.open_connection(sock=s_push)
+        pull_reader, _pw = await asyncio.open_connection(sock=s_pull)
+        stash: list = []
+        links.append(link)
+        writers.append((push_writer, _pw))
+        stashes.append(stash)
+        recv_coros.append(receiver(pull_reader, stash))
+
+    t0 = loop.time()
+    push_tasks = [asyncio.create_task(lk._push_loop(w[0], peer_resume=0))
+                  for lk, w in zip(links, writers)]
+    try:
+        await asyncio.wait_for(asyncio.gather(*recv_coros), timeout=600)
+        wall = loop.time() - t0
+    finally:
+        for t in push_tasks:
+            t.cancel()
+        for pw, qw in writers:
+            for w in (pw, qw):
+                try:
+                    w.close()
+                except (ConnectionError, OSError):
+                    pass
+    # post-wall: land every captured stream through the real intake
+    recvs = []
+    for i, stash in enumerate(stashes):
+        recv = Node(node_id=i + 1)
+        applier = CoalescingApplier(recv, ReplicaMeta(f"bench-fan-src:{i}"),
+                                    max_frames=apply_batch,
+                                    max_latency=latency_s, now=loop.time)
+        for kind, items in stash:
+            if kind == REPLICATE:
+                applier.apply(items)
+            else:
+                applier.apply_wire_batch(items)
+        applier.flush()
+        recv.ensure_flushed()
+        recvs.append(recv)
+    per_link = [{"bytes_out": lk.bytes_out, "cache_hits": lk.cache_hits,
+                 "cache_misses": lk.cache_misses,
+                 "comp_raw": lk.comp_raw_bytes,
+                 "comp_wire": lk.comp_wire_bytes} for lk in links]
+    return recvs, wall, pusher, per_link
+
+
+def _fullsync_bytes_leg(n_keys: int, n_replicas: int, engine_kind: str,
+                        work_dir: str) -> dict:
+    """Compressed-vs-plain bulk sync bytes: the SAME keyspace dumped as
+    the plain full-sync stream (per-section zlib, the pre-CAP_COMPRESS
+    wire) and as the compressed container, both loaded back into fresh
+    stores and canonical()-compared byte-identically.  The workload is
+    HLC-ordered (make_workload hlc_order): a real node's dump iterates
+    keys in creation order, so its uuid columns are near-monotone —
+    the shape the container's transposition filter exploits."""
+    from constdb_tpu.persist.snapshot import (NodeMeta, batch_chunks,
+                                              load_snapshot,
+                                              write_snapshot_file)
+    from constdb_tpu.engine.base import batch_from_keyspace
+
+    batches = make_workload(n_keys, n_replicas, hlc_order=True)
+    if engine_kind == "cpu":
+        engine = CpuMergeEngine()
+    else:
+        from constdb_tpu.engine.tpu import TpuMergeEngine
+        engine = TpuMergeEngine()
+    ks = KeySpace()
+    for b in batches:
+        for chunk in batch_chunks(b, 1 << 16):
+            engine.merge(ks, chunk)
+    if getattr(engine, "needs_flush", False):
+        engine.flush(ks)
+    capture = batch_from_keyspace(ks)
+    meta = NodeMeta(node_id=1, alias="bench")
+    p_plain = os.path.join(work_dir, "fsync.plain.snapshot")
+    p_comp = os.path.join(work_dir, "fsync.z.snapshot")
+    # the acceptance denominator: the UNCOMPRESSED stream (level 0 —
+    # what the bytes are before any compression; the pre-PR wire
+    # additionally had the per-section zlib, reported as plain_bytes)
+    raw_bytes = write_snapshot_file(p_plain, meta, [], [capture],
+                                    compress_level=0)
+    t0 = time.perf_counter()
+    plain_bytes = write_snapshot_file(p_plain, meta, [], [capture],
+                                      compress_level=1)
+    t_plain = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    comp_bytes = write_snapshot_file(p_comp, meta, [], [capture],
+                                     container_level=6)
+    t_comp = time.perf_counter() - t0
+    # both variants must land IDENTICAL state (the verify half of the
+    # bulk-bytes acceptance: byte-identical post-apply canonical export)
+    sub_keys = subsample_keys(batches[0].keys, n_keys)
+    want = ks.canonical(keys=sub_keys)
+    canons = []
+    for p in (p_plain, p_comp):
+        ks2 = KeySpace()
+        load_snapshot(p, ks2, engine=CpuMergeEngine())
+        canons.append(ks2.canonical(keys=sub_keys))
+    verified = canons[0] == want and canons[1] == want
+    for p in (p_plain, p_comp):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    return {
+        "keys": n_keys, "replicas": n_replicas,
+        "uncompressed_bytes": raw_bytes,
+        "plain_bytes": plain_bytes, "compressed_bytes": comp_bytes,
+        "bytes_ratio_vs_uncompressed": round(comp_bytes / raw_bytes, 4),
+        "bytes_ratio_vs_plain_wire": round(comp_bytes / plain_bytes, 4),
+        "plain_dump_s": round(t_plain, 3),
+        "compressed_dump_s": round(t_comp, 3),
+        "verified": verified,
+    }
+
+
+def fanout_main(args) -> None:
+    """`bench.py --mode stream --peers N`: the broadcast replication
+    plane — encode-once fan-out scaling (1/2/4 peers, cache-on vs
+    cache-off interleaved, every peer oracle-verified) plus the
+    compressed-vs-plain bulk-sync bytes leg.  Emits ONE JSON line
+    (BENCH_r16)."""
+    import tempfile
+
+    n_frames = int(os.environ.get("CONSTDB_BENCH_FRAMES", 60_000))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_STREAM_KEYS", 20_000))
+    apply_batch = int(os.environ.get("CONSTDB_BENCH_APPLY_BATCH", 4096))
+    latency_s = float(os.environ.get("CONSTDB_BENCH_APPLY_LATENCY_MS",
+                                     1000.0)) / 1000.0
+    wire_batch = int(os.environ.get("CONSTDB_BENCH_WIRE_BATCH", 512))
+    reps = int(os.environ.get("CONSTDB_BENCH_FANOUT_REPS", 2))
+    cache_mb = int(os.environ.get("CONSTDB_BENCH_ENCODE_CACHE_MB", 64))
+    peer_counts = [int(p) for p in os.environ.get(
+        "CONSTDB_BENCH_FANOUT_PEERS", "1,2,4").split(",")]
+    max_peers = args.peers
+    fs_keys = int(os.environ.get("CONSTDB_BENCH_FSYNC_KEYS", 200_000))
+    fs_replicas = int(os.environ.get("CONSTDB_BENCH_FSYNC_REPLICAS", 8))
+    fs_engine = os.environ.get("CONSTDB_BENCH_FSYNC_ENGINE", "cpu")
+
+    ensure_native()
+    frames = make_frame_log(n_frames, n_keys)
+    entries = frames_to_entries(frames)
+
+    # oracle: the per-frame CPU replay of the same log
+    base_node, _, _ = replay_stream(frames, CpuMergeEngine,
+                                    apply_batch=1, latency_s=1.0)
+    want = base_node.canonical()
+
+    curve = []
+    verified = True
+    for peers in peer_counts:
+        if peers > max_peers:
+            continue
+        best = {True: None, False: None}
+        for _ in range(reps):
+            # interleaved cache-on / cache-off so drift hits both legs
+            for cache_on in (True, False):
+                recvs, wall, pusher, per_link = asyncio.run(
+                    _fanout_replay(entries, peers,
+                                   cache_mb if cache_on else 0,
+                                   wire_batch, apply_batch, latency_s))
+                diffs = sum(compare_canonical(r.canonical(), want)
+                            for r in recvs)
+                st = pusher.stats
+                hits, misses = (st.repl_encode_cache_hits,
+                                st.repl_encode_cache_misses)
+                leg = {
+                    "peers": peers,
+                    "cache": "on" if cache_on else "off",
+                    "wall_s": round(wall, 3),
+                    "fps_per_peer": round(n_frames / wall, 1),
+                    "agg_fps": round(n_frames * peers / wall, 1),
+                    "cache_hits": hits,
+                    "cache_misses": misses,
+                    "cache_hit_rate": round(hits / (hits + misses), 3)
+                    if hits + misses else 0.0,
+                    "wire_bytes": st.repl_wire_bytes_out,
+                    "per_link": per_link,
+                    "diffs": diffs,
+                }
+                prev = best[cache_on]
+                if diffs:
+                    best[cache_on] = leg
+                elif prev is None or (prev["diffs"] == 0
+                                      and wall < prev["wall_s"]):
+                    best[cache_on] = leg
+                print(f"[bench] fanout peers={peers} cache="
+                      f"{leg['cache']}: {leg['wall_s']}s = "
+                      f"{leg['agg_fps']:,.0f} agg frames/s, hit rate "
+                      f"{leg['cache_hit_rate']}, "
+                      f"{'OK' if diffs == 0 else 'MISMATCH'}",
+                      file=sys.stderr)
+        on, off = best[True], best[False]
+        verified &= on["diffs"] == 0 and off["diffs"] == 0
+        curve.append({"peers": peers, "cache_on": on, "cache_off": off,
+                      "speedup_vs_cache_off": round(
+                          on["agg_fps"] / off["agg_fps"], 2)})
+
+    print(f"[bench] fullsync bytes leg: {fs_keys} keys x {fs_replicas} "
+          f"replicas ({fs_engine})", file=sys.stderr)
+    with tempfile.TemporaryDirectory(prefix="constdb-fanout") as td:
+        fullsync = _fullsync_bytes_leg(fs_keys, fs_replicas, fs_engine, td)
+    verified &= fullsync["verified"]
+    print(f"[bench] fullsync bytes: uncompressed "
+          f"{fullsync['uncompressed_bytes']:,} / plain wire "
+          f"{fullsync['plain_bytes']:,} -> compressed "
+          f"{fullsync['compressed_bytes']:,} "
+          f"({fullsync['bytes_ratio_vs_uncompressed']:.3f}x of "
+          f"uncompressed, {fullsync['bytes_ratio_vs_plain_wire']:.3f}x "
+          f"of the plain wire), verified={fullsync['verified']}",
+          file=sys.stderr)
+
+    top = curve[-1]
+    out = {
+        "metric": "fanout_aggregate_frames_per_sec",
+        "value": top["cache_on"]["agg_fps"],
+        "unit": "frames/sec",
+        "mode": "stream-fanout",
+        "frames": n_frames,
+        "stream_keys": n_keys,
+        "wire_batch": wire_batch,
+        "apply_batch": apply_batch,
+        "encode_cache_mb": cache_mb,
+        "curve": curve,
+        "fanout_speedup_at_max_peers": top["speedup_vs_cache_off"],
+        "cache_hit_rate_at_max_peers": top["cache_on"]["cache_hit_rate"],
+        "fullsync": fullsync,
+        "engine": "cpu-hostbatch",
+        "backend": "none",
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
 
 
 # --------------------------------------------------------------------------
@@ -2011,13 +2328,14 @@ class _ResyncDump:
         self.node = node
         self.work_dir = work_dir
 
-    async def acquire(self):
+    async def acquire(self, compressed=False):
         from constdb_tpu.persist.share import Dump
         from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
         self.node.ensure_flushed()
         path = os.path.join(self.work_dir, "resync_full.snapshot")
         size = dump_keyspace(path, self.node.ks,
-                             NodeMeta(node_id=self.node.node_id))
+                             NodeMeta(node_id=self.node.node_id),
+                             container_level=6 if compressed else 0)
         return Dump(path=path, repl_last=self.node.repl_log.last_uuid,
                     size=size)
 
@@ -2408,9 +2726,17 @@ def main() -> None:
                     "below the workload's footprint; reports shed rate, "
                     "survival, and non-shed reply latency "
                     "(server/overload.py)")
+    ap.add_argument("--peers", type=int, default=0,
+                    help="stream mode: the broadcast FAN-OUT legs — one "
+                    "pusher driving 1..N real push loops, encode-once "
+                    "cache on vs off interleaved, every peer "
+                    "oracle-verified, plus the compressed-vs-plain "
+                    "bulk-sync bytes leg (BENCH_r16)")
     args, _ = ap.parse_known_args()
     if args.mode == "stream":
-        if args.wire:
+        if args.peers:
+            fanout_main(args)
+        elif args.wire:
             wire_main(args)
         else:
             stream_main(args)

@@ -68,6 +68,17 @@ def _snapshot_fsync() -> bool:
     return env_flag("CONSTDB_SNAPSHOT_FSYNC", True)
 
 
+def _dump_container_level(app: ServerApp) -> int:
+    """Background/shutdown dumps ride the compressed snapshot container
+    (persist/snapshot.py; boot restore sniffs the magic, pre-PR files
+    stay loadable).  Gates on the same per-app/env compression master
+    switch as every wire decision (CONSTDB_WIRE_COMPRESS=0 or
+    ServerApp(wire_compress=False) keeps dumps in the plain pre-PR
+    format)."""
+    from ..replica.link import wire_compress_of
+    return 6 if wire_compress_of(app) else 0
+
+
 async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
     """Periodic background dump (fork-free; see persist/snapshot.py)."""
     from ..engine.base import batch_from_keyspace
@@ -98,7 +109,8 @@ async def snapshot_cron(app: ServerApp, cfg: Config) -> None:
                     records, [capture],
                     chunk_keys=cfg.snapshot_chunk_keys,
                     compress_level=cfg.snapshot_compress_level,
-                    fsync=_snapshot_fsync())
+                    fsync=_snapshot_fsync(),
+                    container_level=_dump_container_level(app))
             log.info("background snapshot written to %s",
                      cfg.snapshot_path)
         except (OSError, RuntimeError) as e:
@@ -150,7 +162,8 @@ async def amain(cfg: Config) -> None:
                           node.replicas.records(),
                           chunk_keys=cfg.snapshot_chunk_keys,
                           compress_level=cfg.snapshot_compress_level,
-                          fsync=_snapshot_fsync())
+                          fsync=_snapshot_fsync(),
+                          container_level=_dump_container_level(app))
         log.info("final snapshot written to %s", cfg.snapshot_path)
     await app.close()
 
@@ -177,7 +190,8 @@ async def _dump_plane_snapshot(app: ServerApp, cfg: Config) -> None:
         records, captures,
         chunk_keys=cfg.snapshot_chunk_keys,
         compress_level=cfg.snapshot_compress_level,
-        fsync=_snapshot_fsync())
+        fsync=_snapshot_fsync(),
+        container_level=_dump_container_level(app))
 
 
 def main(argv=None) -> None:

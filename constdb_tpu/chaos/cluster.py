@@ -68,6 +68,8 @@ class NodeSpec:
     engine: str = "cpu"            # cpu | xla | xla-resident
     wire_batch: Optional[int] = None   # 1 = per-frame wire (cap withheld)
     delta_sync: Optional[bool] = None  # False = full snapshots only
+    wire_compress: Optional[bool] = None  # False = plain streams/dumps
+    #                                       (CAP_COMPRESS withheld)
     apply_batch: Optional[int] = None
     serve_batch: Optional[int] = None
     serve_shards: int = 1
@@ -91,6 +93,8 @@ class NodeSpec:
             kw["wire_batch"] = self.wire_batch
         if self.delta_sync is not None:
             kw["delta_sync"] = self.delta_sync
+        if self.wire_compress is not None:
+            kw["wire_compress"] = self.wire_compress
         if self.apply_batch is not None:
             kw["apply_batch"] = self.apply_batch
         if self.serve_batch is not None:

@@ -42,31 +42,42 @@ class Cell:
 
     wire: bool = True       # REPLBATCH columnar wire (CAP_BATCH_STREAM)
     delta: bool = True      # digest-driven delta resync (CAP_DELTA_SYNC)
+    compress: bool = True   # negotiated wire/bulk compression
+    #                         (CAP_COMPRESS — round 17)
     shards: int = 1         # serve workers per node (1 = single loop)
     engine: str = "cpu"     # cpu | xla | xla-resident
 
     @property
     def name(self) -> str:
         return (f"wire{int(self.wire)}-delta{int(self.delta)}"
+                f"-comp{int(self.compress)}"
                 f"-shards{self.shards}-{self.engine}")
 
     def specs(self, n: int = 3, mixed_idx: Optional[int] = None
               ) -> list[NodeSpec]:
         """Node configs for this cell.  `mixed_idx` plays the
-        mixed-version peer: wire batching and delta sync OFF, so its
-        handshakes advertise neither capability and every stream it
-        touches must negotiate down correctly."""
+        mixed-version peer: wire batching, delta sync, and compression
+        OFF, so its handshakes advertise none of the capabilities and
+        every stream it touches must negotiate down correctly.
+        Compression cells lower the payload floor so the scripted
+        bursts' REPLBATCH frames actually compress — the corrupt
+        one-shot then hits a COMPRESSED payload, certifying the
+        compression-demotion law, not just the batch codec's."""
         out = []
         for i in range(n):
             if i == mixed_idx:
                 out.append(NodeSpec(engine="cpu", wire_batch=1,
-                                    delta_sync=False))
+                                    delta_sync=False,
+                                    wire_compress=False))
             else:
                 out.append(NodeSpec(
                     engine=self.engine,
                     wire_batch=None if self.wire else 1,
                     delta_sync=None if self.delta else False,
-                    serve_shards=self.shards))
+                    wire_compress=None if self.compress else False,
+                    serve_shards=self.shards,
+                    extra={"wire_compress_min": 64}
+                    if self.compress else {}))
         return out
 
 
@@ -74,14 +85,21 @@ def matrix_cells() -> list[Cell]:
     """The full capability sweep.  Sharded cells collapse the wire
     dimension (a shard-per-core receiver never advertises
     CAP_BATCH_STREAM, and in an all-sharded mesh nobody does) and pin
-    the worker engine (serve workers run the cpu spec), so the sweep is
-    12 cells, not a blind 16."""
+    the worker engine (serve workers run the cpu spec); compression
+    (round 17) defaults ON across the sweep — every wire cell's
+    corrupt-REPLBATCH shot then hits a compressed payload — with
+    dedicated compress-OFF cells on the cpu engine pinning the plain
+    negotiation both with and without the batch wire."""
     cells = []
     for engine in ("cpu", "xla", "xla-resident"):
         for wire in (True, False):
             for delta in (True, False):
                 cells.append(Cell(wire=wire, delta=delta, shards=1,
                                   engine=engine))
+    cells.append(Cell(wire=True, delta=True, compress=False,
+                      engine="cpu"))
+    cells.append(Cell(wire=False, delta=False, compress=False,
+                      engine="cpu"))
     for delta in (True, False):
         cells.append(Cell(wire=False, delta=delta, shards=2,
                           engine="cpu"))
@@ -90,9 +108,11 @@ def matrix_cells() -> list[Cell]:
 
 def smoke_cells() -> list[Cell]:
     """One representative cell per negotiated fast path (the CI chaos
-    smoke): everything-on, everything-off (pure legacy paths), the
-    resident engine, and the sharded serving plane."""
-    return [Cell(), Cell(wire=False, delta=False),
+    smoke): everything-on (compression included — its corrupt shot hits
+    a compressed REPLBATCH), everything-off (pure legacy paths, plain
+    bytes end to end), the resident engine, and the sharded serving
+    plane."""
+    return [Cell(), Cell(wire=False, delta=False, compress=False),
             Cell(engine="xla-resident"), Cell(shards=2, wire=False)]
 
 

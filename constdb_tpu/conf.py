@@ -117,6 +117,20 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "max ms a drained op may sit in the push loop's aggregated "
            "wire buffer before a socket flush (idle cycles flush "
            "immediately, so a lone write is never delayed)"),
+    EnvVar("CONSTDB_WIRE_COMPRESS", "1",
+           "negotiated replication compression (CAP_COMPRESS): REPLBATCH "
+           "payloads above the floor, FULLSYNC/DELTASYNC windows, and "
+           "the compressed snapshot container all gate on it; 0 = every "
+           "peer gets the byte-exact plain stream and dumps stay plain"),
+    EnvVar("CONSTDB_WIRE_COMPRESS_MIN", "512",
+           "min REPLBATCH payload bytes before the negotiated stream "
+           "compression engages (smaller payloads ship plain — framing "
+           "overhead would beat the savings)"),
+    EnvVar("CONSTDB_ENCODE_CACHE_MB", "16",
+           "encode-once run cache cap (MB): finished wire encodings "
+           "published by the first push loop to drain a run and reused "
+           "by every other peer at the same cursor and caps-class; "
+           "0 disables (every peer re-encodes, the pre-broadcast path)"),
     EnvVar("CONSTDB_SERVE_BATCH", "512",
            "max pipelined client commands the serve path plans into one "
            "columnar merge; 1 = the exact per-command path"),

@@ -45,29 +45,42 @@ class Dump:
 
 
 class SharedDump:
-    """Produces and caches the node's current full-sync snapshot file."""
+    """Produces and caches the node's current full-sync snapshot file.
+
+    Two VARIANTS of the same state cut may coexist (round 17): the
+    plain snapshot stream every pre-compression peer must receive
+    byte-exactly, and the compressed container (utils/compressio.py)
+    streamed to CAP_COMPRESS peers.  Each variant is produced once and
+    reused by every concurrently or subsequently syncing peer of its
+    class — a mixed-capability mesh costs at most two dumps, never one
+    per peer."""
 
     def __init__(self, app: "ServerApp"):
         self.app = app
-        self._current: Optional[Dump] = None
-        self._inflight: Optional[asyncio.Task] = None
+        self._current: dict[bool, Optional[Dump]] = {False: None,
+                                                     True: None}
+        self._inflight: dict[bool, Optional[asyncio.Task]] = {False: None,
+                                                              True: None}
         self.dumps_taken = 0   # observability + tests
 
-    async def acquire(self) -> Dump:
-        """The freshest usable dump, producing one if needed.  Concurrent
-        callers share a single in-flight dump."""
+    async def acquire(self, compressed: bool = False) -> Dump:
+        """The freshest usable dump of the requested variant, producing
+        one if needed.  Concurrent callers share a single in-flight dump
+        per variant."""
         node = self.app.node
-        cur = self._current
+        cur = self._current[compressed]
         if cur is not None and node.repl_log.can_resume_from(cur.repl_last) \
                 and os.path.exists(cur.path):
             return cur
-        if self._inflight is None or self._inflight.done():
-            self._inflight = asyncio.create_task(self._dump())
+        inflight = self._inflight[compressed]
+        if inflight is None or inflight.done():
+            inflight = self._inflight[compressed] = \
+                asyncio.create_task(self._dump(compressed))
         # shield: one awaiter being cancelled must not kill the dump the
         # other peers are waiting on
-        return await asyncio.shield(self._inflight)
+        return await asyncio.shield(inflight)
 
-    async def _dump(self) -> Dump:
+    async def _dump(self, compressed: bool = False) -> Dump:
         app, node = self.app, self.app.node
         plane = node.serve_plane
         if plane is not None:
@@ -94,22 +107,43 @@ class SharedDump:
             records = node.replicas.records()
         meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                         addr=app.advertised_addr, repl_last_uuid=repl_last)
-        path = os.path.join(app.work_dir, f"fullsync.{node.node_id}.snapshot")
-        # the full-sync stream sends this very file, so the column
-        # compression rides the wire end-to-end (conf
-        # snapshot_compress_level; contrast reference
-        # src/conn/writer.rs:92-112, which streams raw)
-        size = await asyncio.to_thread(
-            write_snapshot_file, path, meta, records, captures,
-            chunk_keys=app.snapshot_chunk_keys,
-            compress_level=getattr(app, "snapshot_compress_level", 1))
+        suffix = ".z" if compressed else ""
+        path = os.path.join(app.work_dir,
+                            f"fullsync.{node.node_id}.snapshot{suffix}")
+        # the full-sync stream sends this very file, so the compression
+        # rides the wire end-to-end: the plain variant carries the
+        # per-section zlib (conf snapshot_compress_level — the exact
+        # pre-compression stream), the compressed variant the whole-
+        # stream container (contrast reference src/conn/writer.rs:92-112,
+        # which streams raw)
+        # the container writer's working buffer is bounded by its chunk
+        # size; register that bound as a used_memory source for the
+        # dump's duration (the governor's accounting-completeness law —
+        # server/overload.py)
+        gov = node.governor
+        src = (lambda: 1 << 20) if compressed else None
+        if src is not None:
+            gov.register_source(src)
+        try:
+            size = await asyncio.to_thread(
+                write_snapshot_file, path, meta, records, captures,
+                chunk_keys=app.snapshot_chunk_keys,
+                compress_level=getattr(app, "snapshot_compress_level", 1),
+                container_level=getattr(app, "bulk_compress_level", 6)
+                if compressed else 0)
+        finally:
+            if src is not None:
+                gov.unregister_source(src)
         self.dumps_taken += 1
         dump = Dump(path, repl_last, size)
-        self._current = dump
-        node.stats.extra["last_snapshot_bytes"] = size
-        log.info("full-sync dump #%d: %d bytes at uuid %d", self.dumps_taken,
+        self._current[compressed] = dump
+        key = "last_snapshot_z_bytes" if compressed \
+            else "last_snapshot_bytes"
+        node.stats.extra[key] = size
+        log.info("full-sync dump #%d%s: %d bytes at uuid %d",
+                 self.dumps_taken, " (compressed)" if compressed else "",
                  size, repl_last)
         return dump
 
     def invalidate(self) -> None:
-        self._current = None
+        self._current = {False: None, True: None}

@@ -36,6 +36,18 @@ immediately, exactly like the reference's short-read handling
 
 Varint scalars use the zigzag encoding from utils/varint.py (well-defined
 for negatives — the reference's encoder corrupts them, SURVEY.md §2.6).
+
+Compressed container (round 17): a snapshot stream may be wrapped whole
+in the chunked compression framing from utils/compressio.py
+(`container_level` on the writer entry points).  The container is
+magic-tagged (b"CSTPUZ1\\n" vs the plain b"CSTPU1\\n\\x00"), so
+`SnapshotLoader` sniffs the first bytes and reads either transparently —
+pre-PR plain files stay loadable, and every consumer (boot restore,
+FULLSYNC/DELTASYNC spill apply, sharded ingest) inherits the support
+for free.  Whole-stream compression beats the per-section zlib because
+it folds CROSS-section redundancy (the columnar key/uuid planes repeat
+heavily across chunks); container dumps therefore write their inner
+sections raw (compress_level=0) rather than compressing twice.
 """
 
 from __future__ import annotations
@@ -51,6 +63,8 @@ from ..engine.base import (ColumnarBatch, batch_from_keyspace,
                            has_values)
 from ..errors import InvalidSnapshot, InvalidSnapshotChecksum
 from ..utils.checksum import StreamChecksum
+from ..utils.compressio import (CompressFormatError, DecompressReader,
+                                is_compressed)
 from ..utils.varint import VarintReader, write_uvarint
 
 _I64 = np.int64
@@ -460,7 +474,17 @@ class SnapshotWriter:
     interoperate)."""
 
     def __init__(self, f: IO[bytes], compress_level: int = 1,
-                 alg: Optional[int] = None):
+                 alg: Optional[int] = None, container_level: int = 0):
+        self._zw = None
+        if container_level > 0:
+            # compressed container: the WHOLE inner stream (magic
+            # through digest) rides the chunked framing; callers
+            # normally pair this with compress_level=0 so sections are
+            # not compressed twice (module docstring)
+            from ..utils.compressio import CompressWriter
+            self._zw = CompressWriter(f, level=container_level,
+                                      chunk=1 << 20)
+            f = self._zw
         self._f = f
         self._level = compress_level
         self._sum = StreamChecksum(alg)
@@ -503,9 +527,13 @@ class SnapshotWriter:
 
     def finish(self) -> None:
         """End marker + digest.  The digest covers the marker, so dropping
-        trailing sections can't go unnoticed."""
+        trailing sections can't go unnoticed.  A container writer is
+        finished AFTER the digest — the whole inner stream, digest
+        included, rides the validated chunk framing."""
         self._emit(bytes([SEC_END]))
         self._f.write(self._sum.digest().to_bytes(8, "big"))
+        if self._zw is not None:
+            self._zw.finish()
         self._finished = True
 
 
@@ -530,11 +558,25 @@ class SnapshotLoader:
         bytes) without decoding — the sharded ingest path ships the
         payload to worker processes, which decode in parallel (the parent
         then pays only the read + decompress)."""
-        self._f = f
         self._off = 0
         self._done = False
         self._raw = raw_batches
-        head = self._read(len(MAGIC) + 1, checked=False)
+        # container sniff: a compressed container wraps a whole plain
+        # snapshot stream — read THROUGH the validating inflater, so
+        # every consumer (boot restore, sync spill apply, sharded
+        # ingest) handles both formats without knowing which it got
+        first = f.read(len(MAGIC))
+        if len(first) == len(MAGIC) and is_compressed(first):
+            try:
+                self._f = DecompressReader(f, head=first)
+            except CompressFormatError:
+                raise InvalidSnapshot(0) from None
+            first = b""
+        else:
+            self._f = f
+        self._off = len(first)
+        head = first + self._read(len(MAGIC) + 1 - len(first),
+                                  checked=False)
         if head[: len(MAGIC)] != MAGIC:
             raise InvalidSnapshot(0)
         try:
@@ -544,7 +586,12 @@ class SnapshotLoader:
         self._sum.update(head)
 
     def _read(self, n: int, checked: bool = True) -> bytes:
-        data = self._f.read(n)
+        try:
+            data = self._f.read(n)
+        except CompressFormatError:
+            # a corrupt container chunk is snapshot corruption: surface
+            # it through the loader's normal quarantine class
+            raise InvalidSnapshot(self._off) from None
         if len(data) != n:
             raise InvalidSnapshot(self._off + len(data))
         self._off += n
@@ -570,7 +617,10 @@ class SnapshotLoader:
             raise StopIteration
         kind = self._read(1)[0]
         if kind == SEC_END:
-            digest = self._f.read(8)
+            try:
+                digest = self._f.read(8)
+            except CompressFormatError:
+                raise InvalidSnapshot(self._off) from None
             if len(digest) != 8:
                 raise InvalidSnapshot(self._off + len(digest))
             self._off += 8
@@ -632,15 +682,21 @@ def dump_keyspace(path: str, ks, meta: NodeMeta,
                   replicas: Iterable[ReplicaRecord] = (),
                   chunk_keys: int = 1 << 16,
                   compress_level: int = 1,
-                  fsync: bool = False) -> int:
+                  fsync: bool = False,
+                  container_level: int = 0) -> int:
     """Atomic whole-keyspace dump (reference src/server.rs:183-220, minus
     the fork: the columnar capture is the consistent cut).  Returns the
     file size.  `fsync`: durable like write_snapshot_file — file data
-    before the rename, parent directory entry after it."""
+    before the rename, parent directory entry after it.
+    `container_level` > 0 writes the compressed container (inner
+    sections then ship raw — module docstring)."""
+    if container_level > 0:
+        compress_level = 0
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            w = SnapshotWriter(f, compress_level=compress_level)
+            w = SnapshotWriter(f, compress_level=compress_level,
+                               container_level=container_level)
             w.write_node(meta)
             records = list(replicas)
             if records:
@@ -668,7 +724,8 @@ def write_snapshot_file(path: str, meta: NodeMeta,
                         captures: Iterable[ColumnarBatch],
                         chunk_keys: int = 1 << 16,
                         compress_level: int = 1,
-                        fsync: bool = False) -> int:
+                        fsync: bool = False,
+                        container_level: int = 0) -> int:
     """Atomic snapshot dump of pre-captured columnar state: the ONE
     tmp-file + SnapshotWriter + replace recipe every dump site shares
     (persist/share.py full-sync dumps, bin/server.py background and
@@ -678,11 +735,16 @@ def write_snapshot_file(path: str, meta: NodeMeta,
     ColumnarBatch (chunked + encoded here) or pre-encoded section bytes
     (written as-is — shard workers encode their own bucket exports).
     Blocking file IO: call from a worker thread when on the event loop.
-    Returns the file size."""
+    Returns the file size.  `container_level` > 0 writes the compressed
+    container (inner sections then ship raw — module docstring; raw
+    captures keep whatever encoding their producer chose)."""
+    if container_level > 0:
+        compress_level = 0
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            w = SnapshotWriter(f, compress_level=compress_level)
+            w = SnapshotWriter(f, compress_level=compress_level,
+                               container_level=container_level)
             w.write_node(meta)
             w.write_replicas(records)
             for part in captures:

@@ -421,12 +421,44 @@ class CoalescingApplier:
             self._pending_beacon = 0
             return
         from . import wire
+        from ..utils.compressio import (CompressFormatError,
+                                        decompress_bytes, is_compressed)
         try:
+            if is_compressed(payload):
+                # negotiated stream compression (CAP_COMPRESS): inflate
+                # with per-chunk crc validation before the batch codec
+                # ever sees a byte — a defect in EITHER layer demotes
+                # identically below.  The inflated size is capped at the
+                # largest payload an honest pusher can produce (one
+                # proto-max value plus batch framing slack): a crafted
+                # container cannot bomb the intake past what the plain
+                # wire already admits (reject-before-allocate law).
+                from ..conf import env_int
+                cap = env_int("CONSTDB_PROTO_MAX_BULK", 512 << 20) \
+                    + (64 << 20)
+                raw = decompress_bytes(payload, max_raw=cap)
+                x = node.stats.extra
+                x["repl_comp_batches_in"] = \
+                    x.get("repl_comp_batches_in", 0) + 1
+                payload = raw
             wb = wire.decode_wire_batch(payload, node.ks, origin,
                                         first_prev)
             if wb.n_frames != n:
                 raise wire.WireFormatError(
                     f"header says {n} frames, payload holds {wb.n_frames}")
+        except CompressFormatError as e:
+            st = node.stats
+            st.repl_wire_demotions += 1
+            x = st.extra
+            x["repl_compress_demotions"] = \
+                x.get("repl_compress_demotions", 0) + 1
+            meta.compress_wire_off = True
+            log.error(
+                "compressed replbatch from %s is malformed (%s); "
+                "demoting this peer's stream to plain delivery and "
+                "resyncing from the landed watermark", meta.addr, e)
+            raise CstError(
+                f"{meta.addr}: malformed compressed replbatch") from None
         except wire.WireFormatError as e:
             st = node.stats
             st.repl_wire_demotions += 1

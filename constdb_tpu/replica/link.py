@@ -67,7 +67,8 @@ from typing import Optional, TYPE_CHECKING
 
 import numpy as np
 
-from ..errors import CstError, ReplicateCommandsLost
+from ..errors import (CstError, InvalidSnapshot, InvalidSnapshotChecksum,
+                      ReplicateCommandsLost)
 from ..persist.snapshot import SectionDemux, batch_chunks
 from ..resp.codec import RespParser, encode_into, encode_msg, make_parser
 from ..resp.message import Arr, Bulk, Int, as_bytes, as_int
@@ -101,7 +102,11 @@ DELTASYNC = b"deltasync"
 CAP_FULLSYNC_RESET = 1   # honors FULLSYNC's 4th (state-wipe) field
 CAP_DELTA_SYNC = 2       # answers digest frames / applies deltasync
 CAP_BATCH_STREAM = 4     # decodes REPLBATCH columnar run frames
-MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC | CAP_BATCH_STREAM
+CAP_COMPRESS = 8         # validates the chunked compression framing
+#                          (utils/compressio.py): REPLBATCH payloads
+#                          over the floor + FULLSYNC/DELTASYNC windows
+MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC | CAP_BATCH_STREAM \
+    | CAP_COMPRESS
 
 
 def my_caps(app, meta=None) -> int:
@@ -119,7 +124,12 @@ def my_caps(app, meta=None) -> int:
     REPLBATCH would route through the columnar merge engine the pin
     exists to bypass), and a peer that once shipped a malformed payload
     is pinned to per-frame delivery (`meta.batch_wire_off`,
-    replica/coalesce.py apply_wire_batch)."""
+    replica/coalesce.py apply_wire_batch).
+    CAP_COMPRESS follows the same two-leg discipline —
+    CONSTDB_WIRE_COMPRESS=0 stops both compressing outbound AND
+    inviting compressed frames — and is withheld per-peer after a
+    malformed compressed frame (`meta.compress_wire_off`), so the
+    redelivery window arrives plain."""
     caps = MY_CAPS
     if not getattr(app, "delta_sync", True):
         caps &= ~CAP_DELTA_SYNC
@@ -127,6 +137,10 @@ def my_caps(app, meta=None) -> int:
             getattr(app, "serve_plane", None) is not None or \
             (meta is not None and getattr(meta, "batch_wire_off", False)):
         caps &= ~CAP_BATCH_STREAM
+    if not wire_compress_of(app) or \
+            (meta is not None and
+             getattr(meta, "compress_wire_off", False)):
+        caps &= ~CAP_COMPRESS
     return caps
 
 
@@ -147,6 +161,27 @@ def wire_batch_limit(app) -> int:
         from ..conf import env_int
         return env_int("CONSTDB_WIRE_BATCH", 512)
     return wb
+
+
+def wire_compress_of(app) -> bool:
+    """Is negotiated replication compression on for this node (both
+    legs: compress outbound to CAP_COMPRESS peers AND advertise the
+    capability)?  CONSTDB_WIRE_COMPRESS=0 is the kill switch."""
+    wc = getattr(app, "wire_compress", None)
+    if wc is None:
+        from ..conf import env_flag
+        return env_flag("CONSTDB_WIRE_COMPRESS", True)
+    return bool(wc)
+
+
+def wire_compress_min(app) -> int:
+    """Min REPLBATCH payload bytes before the negotiated stream
+    compression engages (framing overhead beats the savings below)."""
+    wm = getattr(app, "wire_compress_min", None)
+    if wm is None:
+        from ..conf import env_int
+        return env_int("CONSTDB_WIRE_COMPRESS_MIN", 512)
+    return wm
 
 
 def wire_latency_of(app) -> float:
@@ -234,6 +269,12 @@ class ReplicaLink:
         # DIGESTACK landing inside a FULLSYNC/DELTASYNC byte window
         # would corrupt the peer's spill download
         self._stream_lock = asyncio.Lock()
+        # per-download spill-file serial: a reconnect/adopt overlap can
+        # briefly run TWO pull loops for one peer, and a shared spill
+        # path would interleave their downloads into one corrupt file
+        # (caught by the chaos harness as a spurious InvalidSnapshot on
+        # a perfectly healthy stream)
+        self._spill_seq = 0
         # reconnect observability (INFO repl_link_state/repl_reconnects)
         # + the backoff ladder's position: consecutive dial failures
         # since the last live connection
@@ -245,6 +286,14 @@ class ReplicaLink:
         # push loop is currently pausing the ring drain on it
         self.win_unacked = 0
         self.win_paused = False
+        # broadcast-plane observability (INFO replica<i> rows): bytes
+        # written to this peer, encode-cache reuse, and the negotiated
+        # compression's raw-vs-wire accounting for this link's stream
+        self.bytes_out = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.comp_raw_bytes = 0
+        self.comp_wire_bytes = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -292,6 +341,7 @@ class ReplicaLink:
         st = self.node.stats
         st.net_out_bytes += len(data)
         st.repl_out_bytes += len(data)
+        self.bytes_out += len(data)
         writer.write(data)
 
     def _flush_wire(self, writer, out: bytearray) -> bytearray:
@@ -316,17 +366,23 @@ class ReplicaLink:
                 Bulk(REPLICATE), Int(nid), Int(e.prev_uuid), Int(e.uuid),
                 Bulk(e.name), *e.args]))
 
-    def _encode_wire_run(self, out: bytearray, run: list,
-                         cursor: int) -> int:
+    def _encode_wire_run(self, out: bytearray, run: list, cursor: int,
+                         compress: bool = False,
+                         comp_min: int = 0) -> tuple:
         """Encode one drained run into `out`: maximal sub-runs of
         consecutive encodable ops become REPLBATCH frames
         (replica/wire.py), everything else — barriers, sub-runs below
         _MIN_WIRE_RUN, runs the codec demotes — ships as the exact
-        per-frame REPLICATE frames.  Returns the advanced cursor."""
+        per-frame REPLICATE frames.  `compress`: wrap payloads of at
+        least `comp_min` bytes in the negotiated compression framing
+        (utils/compressio.py), kept only when it actually shrinks them.
+        Returns (cursor, batches, batch_frames, comp_raw, comp_wire) —
+        the counts the encode-once cache republishes per reusing peer."""
         node = self.node
         nid = node.node_id
         st = node.stats
         enc_has = COLUMNAR_ENCODERS.__contains__
+        batches = batch_frames = comp_raw = comp_wire = 0
         i, n = 0, len(run)
         while i < n:
             j = i
@@ -336,12 +392,21 @@ class ReplicaLink:
                 sub = run[i:j]
                 payload = wire.build_wire_batch(sub, nid)
                 if payload is not None:
+                    if compress and len(payload) >= comp_min:
+                        from ..utils.compressio import compress_bytes
+                        z = compress_bytes(payload, level=1)
+                        if len(z) < len(payload):
+                            comp_raw += len(payload)
+                            comp_wire += len(z)
+                            payload = z
                     encode_into(out, Arr([
                         Bulk(REPLBATCH), Int(nid), Int(sub[0].prev_uuid),
                         Int(sub[-1].uuid), Int(len(sub)),
                         Bulk(payload)]))
                     st.repl_wire_batches_out += 1
                     st.repl_wire_batch_frames_out += len(sub)
+                    batches += 1
+                    batch_frames += len(sub)
                     i = j
                     cursor = sub[-1].uuid
                     continue
@@ -358,7 +423,10 @@ class ReplicaLink:
             self._encode_frames(out, run[i:stop])
             cursor = run[stop - 1].uuid
             i = stop
-        return cursor
+        if comp_raw:
+            st.repl_comp_raw_bytes += comp_raw
+            st.repl_comp_wire_bytes += comp_wire
+        return cursor, batches, batch_frames, comp_raw, comp_wire
 
     async def _close_conn(self) -> None:
         w, self._writer = self._writer, None
@@ -562,6 +630,8 @@ class ReplicaLink:
             EVENT_REPLICATED | EVENT_PULL_LANDED | EVENT_REPLICA_ACKED)
         wire_batch = wire_batch_limit(self.app)
         wire_latency = wire_latency_of(self.app)
+        wire_compress = wire_compress_of(self.app)
+        wire_comp_min = wire_compress_min(self.app)
         # replication flow control (CONSTDB_REPL_WINDOW): stream bytes
         # written to this connection but not yet covered by the peer's
         # REPLACK watermark.  `inflight` holds (cursor_after_flush,
@@ -680,8 +750,28 @@ class ReplicaLink:
                 # the peer can decode them; everything else — legacy
                 # peers, CONSTDB_WIRE_BATCH=1, barriers, demoted runs —
                 # is the byte-exact per-frame stream.
+                #
+                # Broadcast fan-out (round 17): the FIRST loop to drain
+                # a run publishes its finished wire bytes in the node's
+                # encode-once cache; every other loop at the same cursor
+                # and caps-class splices the published bytes instead of
+                # re-encoding, so N-peer steady-state encode work is
+                # O(ops), not O(N·ops).  The caps-class key pins every
+                # knob that changes the bytes: "b"/"bz" for the plain/
+                # compressed REPLBATCH stream, "f" for the byte-exact
+                # per-frame rendering legacy and demoted peers share.
                 batching = wire_batch > 1 and \
                     bool(self._peer_caps & CAP_BATCH_STREAM)
+                compressing = batching and \
+                    bool(self._peer_caps & CAP_COMPRESS) and \
+                    wire_compress
+                caps_class = ("bz" if compressing else "b") if batching \
+                    else "f"
+                cache = node.wire_cache
+                if cache.enabled:
+                    # ring-eviction coherence: entries below the
+                    # resumable horizon can never be read again
+                    cache.evict_below(node.repl_log.evicted_up_to)
                 out = bytearray()
                 t_flush = loop.time()
 
@@ -695,37 +785,76 @@ class ReplicaLink:
                     return self._flush_wire(writer, buf)
 
                 while not paused:
-                    # byte-capped runs: the flush bound below must get a
-                    # chance to engage BEFORE a backlog of huge values
-                    # is encoded into one frame/buffer (a lone oversized
-                    # entry still ships whole, as per-frame always did)
-                    run = node.repl_log.run_after(
-                        cursor, wire_batch if batching else _RUN_FRAMES,
-                        _WIRE_FLUSH_BYTES)
-                    if not run:
-                        break
-                    if run[0].prev_uuid > cursor:
-                        # the ring evicted past our cursor while this loop
-                        # yielded (the drain below): streaming the run
-                        # would hand the peer a gap, blow up its pull loop
-                        # (ReplicateCommandsLost) and force a teardown +
-                        # redial + snapshot over a FRESH connection.
-                        # Recover IN PLACE instead: stop here and let the
-                        # round decision re-send a full snapshot on this
-                        # same stream (eviction past the cursor implies
-                        # can_resume_from(cursor) is False).  This is the
-                        # fallback the module header documents — the
-                        # reference leaves the case unhandled
-                        # (pull.rs:167-172).
-                        log.warning(
-                            "push %s: repl_log evicted past send cursor "
-                            "mid-stream; resyncing in place", meta.addr)
-                        break
-                    if batching:
-                        cursor = self._encode_wire_run(out, run, cursor)
+                    hit = cache.get(caps_class, cursor) \
+                        if cache.enabled else None
+                    if hit is not None:
+                        # published by another peer's loop at this exact
+                        # cursor: splice the finished bytes and republish
+                        # the per-send wire counters from the entry
+                        out += hit.payload
+                        cursor = hit.end
+                        self.cache_hits += 1
+                        st = node.stats
+                        st.repl_encode_cache_hits += 1
+                        st.repl_wire_batches_out += hit.batches
+                        st.repl_wire_batch_frames_out += hit.batch_frames
+                        st.repl_comp_raw_bytes += hit.comp_raw
+                        st.repl_comp_wire_bytes += hit.comp_wire
+                        self.comp_raw_bytes += hit.comp_raw
+                        self.comp_wire_bytes += hit.comp_wire
                     else:
-                        self._encode_frames(out, run)
-                        cursor = run[-1].uuid
+                        # byte-capped runs: the flush bound below must
+                        # get a chance to engage BEFORE a backlog of
+                        # huge values is encoded into one frame/buffer
+                        # (a lone oversized entry still ships whole, as
+                        # per-frame always did)
+                        run = node.repl_log.run_after(
+                            cursor,
+                            wire_batch if batching else _RUN_FRAMES,
+                            _WIRE_FLUSH_BYTES)
+                        if not run:
+                            break
+                        if run[0].prev_uuid > cursor:
+                            # the ring evicted past our cursor while this
+                            # loop yielded (the drain below): streaming
+                            # the run would hand the peer a gap, blow up
+                            # its pull loop (ReplicateCommandsLost) and
+                            # force a teardown + redial + snapshot over a
+                            # FRESH connection.  Recover IN PLACE
+                            # instead: stop here and let the round
+                            # decision re-send a full snapshot on this
+                            # same stream (eviction past the cursor
+                            # implies can_resume_from(cursor) is False).
+                            # This is the fallback the module header
+                            # documents — the reference leaves the case
+                            # unhandled (pull.rs:167-172).
+                            log.warning(
+                                "push %s: repl_log evicted past send "
+                                "cursor mid-stream; resyncing in place",
+                                meta.addr)
+                            break
+                        seg = bytearray()
+                        start = cursor
+                        if batching:
+                            (cursor, nb, nbf, craw,
+                             cwire) = self._encode_wire_run(
+                                seg, run, cursor, compress=compressing,
+                                comp_min=wire_comp_min)
+                        else:
+                            self._encode_frames(seg, run)
+                            cursor = run[-1].uuid
+                            nb = nbf = craw = cwire = 0
+                        self.comp_raw_bytes += craw
+                        self.comp_wire_bytes += cwire
+                        if cache.enabled:
+                            self.cache_misses += 1
+                            node.stats.repl_encode_cache_misses += 1
+                            cache.put(caps_class, start, cursor,
+                                      bytes(seg), batches=nb,
+                                      batch_frames=nbf, comp_raw=craw,
+                                      comp_wire=cwire,
+                                      readers=self._expected_readers())
+                        out += seg
                     if len(out) >= _WIRE_FLUSH_BYTES or \
                             loop.time() - t_flush >= wire_latency:
                         out = flush_out(out)
@@ -777,6 +906,29 @@ class ReplicaLink:
             self.win_paused = False
             consumer.close()
 
+    def _expected_readers(self) -> int:
+        """How many OTHER live links may reuse a run encoding published
+        at this link's cursor — the encode-once cache's initial
+        ref-count.  A heuristic (peers can connect later, classes can
+        differ), so the cache's LRU byte bound is the safety net; what
+        it guarantees is the cheap case: a single-peer node publishes
+        nothing and pays nothing."""
+        n = 0
+        for m in self.node.replicas.live_peers():
+            lk = m.link
+            if lk is not None and lk is not self and not lk.closing:
+                n += 1
+        return n
+
+    def _bulk_compress(self) -> bool:
+        """Ship this peer's FULLSYNC/DELTASYNC window as the compressed
+        snapshot container?  Negotiated (CAP_COMPRESS) and gated on the
+        node-wide kill switch; a legacy or demoted peer gets the exact
+        plain byte stream."""
+        return bool(self._peer_caps & CAP_COMPRESS) and \
+            wire_compress_of(self.app) and \
+            not getattr(self.meta, "compress_wire_off", False)
+
     async def _send_snapshot(self, writer, reset: bool = False) -> int:
         """Fork-free full sync with bounded memory: acquire the node's
         SHARED on-disk dump (produced once, reused by every concurrently
@@ -786,7 +938,13 @@ class ReplicaLink:
         fixed-size pieces.  Returns the dump's repl watermark — the push
         loop's new send cursor (the repl_log gap above it streams next,
         which `can_resume_from` guarantees is still present)."""
-        dump = await self.app.shared_dump.acquire()
+        # a CAP_COMPRESS peer gets the compressed-container VARIANT of
+        # the shared dump — produced once, reused by every capable peer;
+        # the receiver's snapshot loader sniffs the container magic, so
+        # the FULLSYNC header and download path are unchanged on the
+        # wire (and a legacy peer's stream stays byte-exact pre-PR)
+        dump = await self.app.shared_dump.acquire(
+            compressed=self._bulk_compress())
         self.node.stats.repl_full_syncs += 1
         await self._stream_file(writer, dump.path, encode_msg(Arr([
             Bulk(FULLSYNC), Int(dump.size), Int(dump.repl_last),
@@ -1035,10 +1193,15 @@ class ReplicaLink:
         loop = asyncio.get_running_loop()
         # file write off-loop (ASYNC-BLOCK): the captures are already
         # materialized, so the worker thread only encodes + writes
+        # negotiated peers receive the delta as the compressed snapshot
+        # container (the columnar bucket layout with uuid deltas is
+        # highly compressible); the receiver's loader sniffs the magic
+        container = getattr(self.app, "bulk_compress_level", 6) \
+            if self._bulk_compress() else 0
         size = await loop.run_in_executor(
             None, lambda: write_snapshot_file(
                 path, nmeta, records, parts, chunk_keys=chunk_keys,
-                compress_level=level))
+                compress_level=level, container_level=container))
         try:
             await self._stream_file(writer, path, encode_msg(Arr([
                 Bulk(DELTASYNC), Int(size), Int(repl_last),
@@ -1311,24 +1474,39 @@ class ReplicaLink:
         a plain merge would let our stale keys resurrect mesh-wide.  Wipe
         local state first (Node.reset_for_full_resync) and rejoin from the
         snapshot like a fresh node."""
-        path = os.path.join(self.app.work_dir,
-                            f"snapshot.{self.meta.addr.replace(':', '_')}")
-        await self._download_spill(reader, parser, size, path)
-        node = self.node
-        if reset:
-            log.warning("peer %s demands a state-clearing resync (we were "
-                        "excluded from its GC horizon past the repl_log "
-                        "window); wiping local state", self.meta.addr)
-            if node.serve_plane is not None:
-                await node.serve_plane.reset_for_resync(keep_link=self)
-            else:
-                node.reset_for_full_resync(keep_link=self)
-            # THIS stream stays valid: the snapshot below + the gap-free
-            # frames that follow it re-establish our pull position
-            self._epoch = node.reset_epoch
-        applied_rows, replica_rows = await self._apply_spill(path, size)
-        self._finish_sync(path, applied_rows, replica_rows, repl_last,
-                          "snapshot")
+        self._spill_seq += 1
+        path = os.path.join(
+            self.app.work_dir,
+            f"snapshot.{self.meta.addr.replace(':', '_')}"
+            f".{self._spill_seq}")
+        try:
+            await self._download_spill(reader, parser, size, path)
+            node = self.node
+            if reset:
+                log.warning("peer %s demands a state-clearing resync (we "
+                            "were excluded from its GC horizon past the "
+                            "repl_log window); wiping local state",
+                            self.meta.addr)
+                if node.serve_plane is not None:
+                    await node.serve_plane.reset_for_resync(keep_link=self)
+                else:
+                    node.reset_for_full_resync(keep_link=self)
+                # THIS stream stays valid: the snapshot below + the
+                # gap-free frames that follow it re-establish our pull
+                # position
+                self._epoch = node.reset_epoch
+            applied_rows, replica_rows = await self._apply_spill_loud(
+                path, size)
+            self._finish_sync(path, applied_rows, replica_rows, repl_last,
+                              "snapshot")
+        finally:
+            # per-download spill names are never overwritten by a retry,
+            # so EVERY exit — a torn download included — must drop the
+            # file (ENOENT after the success path's unlink is fine)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     async def _receive_delta(self, reader, parser, size: int,
                              repl_last: int, buckets: int) -> None:
@@ -1341,12 +1519,22 @@ class ReplicaLink:
         state covers everything the pusher had at `repl_last`, because
         every bucket whose digests disagreed was just streamed and every
         bucket whose digests agreed already held identical state."""
-        path = os.path.join(self.app.work_dir,
-                            f"delta.in.{self.meta.addr.replace(':', '_')}")
-        await self._download_spill(reader, parser, size, path)
-        applied_rows, replica_rows = await self._apply_spill(path, size)
-        self._finish_sync(path, applied_rows, replica_rows, repl_last,
-                          f"delta ({buckets} buckets)")
+        self._spill_seq += 1
+        path = os.path.join(
+            self.app.work_dir,
+            f"delta.in.{self.meta.addr.replace(':', '_')}"
+            f".{self._spill_seq}")
+        try:
+            await self._download_spill(reader, parser, size, path)
+            applied_rows, replica_rows = await self._apply_spill_loud(
+                path, size)
+            self._finish_sync(path, applied_rows, replica_rows, repl_last,
+                              f"delta ({buckets} buckets)")
+        finally:
+            try:  # see _receive_snapshot: every exit drops the spill
+                os.unlink(path)
+            except OSError:
+                pass
 
     async def _download_spill(self, reader, parser, size: int,
                               path: str) -> None:
@@ -1373,6 +1561,47 @@ class ReplicaLink:
             except asyncio.CancelledError:
                 f.close()  # teardown path: close inline rather than leak
                 raise
+
+    async def _apply_spill_loud(self, path: str, size: int):
+        """`_apply_spill` with the compression-demotion discipline: a
+        raw window that arrived as a compressed container but failed
+        validation demotes THIS peer's compression loudly
+        (repl_compress_demotions counting + compress_wire_off, so the
+        CAP_COMPRESS invitation disappears from the next handshake and
+        the retried window arrives plain).  Deliberately NOT counted
+        into repl_wire_demotions: the chaos accounting law ties that
+        gauge to injected REPLBATCH corruption, and a window can fail
+        validation without any peer malice (e.g. a reconnect-overlap
+        race interleaving two downloads) — the demotion is then merely
+        conservative (speed, never state).  The watermark is untouched
+        either way — `_finish_sync` only runs on success, so the whole
+        window redelivers idempotently after the teardown."""
+        try:
+            return await self._apply_spill(path, size)
+        except (InvalidSnapshot, InvalidSnapshotChecksum):
+            # head sniff off-loop (ASYNC-BLOCK), like every other spill
+            # read on this path
+            loop = asyncio.get_running_loop()
+            head = b""
+            try:
+                f = await loop.run_in_executor(None, open, path, "rb")
+                try:
+                    head = await loop.run_in_executor(None, f.read, 8)
+                finally:
+                    f.close()
+            except OSError:
+                pass
+            from ..utils.compressio import is_compressed
+            if is_compressed(head):
+                x = self.node.stats.extra
+                x["repl_compress_demotions"] = \
+                    x.get("repl_compress_demotions", 0) + 1
+                self.meta.compress_wire_off = True
+                log.error(
+                    "compressed sync window from %s failed validation; "
+                    "demoting this peer to plain transfers and retrying "
+                    "from the untouched watermark", self.meta.addr)
+            raise
 
     async def _apply_spill(self, path: str, size: int):
         """Merge a downloaded snapshot-format spill file through

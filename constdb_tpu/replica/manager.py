@@ -66,6 +66,13 @@ class ReplicaMeta:
     # per-frame REPLICATE frames.  Sticky for the process lifetime: a
     # peer that ships one malformed batch will ship another.
     batch_wire_off: bool = field(default=False, compare=False)
+    # runtime flag (not replicated): this peer once sent us a compressed
+    # frame (REPLBATCH payload or bulk window) we could not validate
+    # (utils/compressio.py) — stop advertising CAP_COMPRESS to it, so
+    # the redelivery window (and everything after) arrives as the plain
+    # byte stream.  Same loud-demotion discipline as batch_wire_off;
+    # sticky for the process lifetime.
+    compress_wire_off: bool = field(default=False, compare=False)
     # runtime (not replicated): the peer's self-reported CLUSTER
     # COVERAGE — a uuid L such that the peer holds EVERY origin's ops
     # <= L (REPLACK item 5; -1 = legacy peer, never reported).  Gates

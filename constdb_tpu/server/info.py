@@ -121,6 +121,18 @@ def _section_stats(node, out):
     out.append(("repl_wire_batches_in", st.repl_wire_batches_in))
     out.append(("repl_wire_batch_frames_in", st.repl_wire_batch_frames_in))
     out.append(("repl_wire_demotions", st.repl_wire_demotions))
+    # broadcast plane (replica/encode_cache.py + CAP_COMPRESS): push-
+    # loop fan-out reuse of published wire encodings (hits/misses over
+    # drained runs, live resident bytes), and the outbound stream
+    # compression's raw-vs-wire ratio (1.0 = nothing compressed yet)
+    out.append(("repl_encode_cache_hits", st.repl_encode_cache_hits))
+    out.append(("repl_encode_cache_misses", st.repl_encode_cache_misses))
+    wire_cache = getattr(node, "wire_cache", None)
+    out.append(("repl_encode_cache_bytes",
+                wire_cache.used_bytes() if wire_cache is not None else 0))
+    out.append(("repl_compress_ratio",
+                round(st.repl_comp_raw_bytes / st.repl_comp_wire_bytes, 3)
+                if st.repl_comp_wire_bytes else 1.0))
     # anti-entropy resyncs this node pushed: digest-negotiated deltas
     # vs full snapshots (replica/link.py; the demotion counter rides
     # `extra` as repl_delta_demotions, with shard ids in the log)
@@ -247,10 +259,25 @@ def _section_replication(node, out):
         win = getattr(link, "win_unacked", 0) if link is not None else 0
         win_p = int(getattr(link, "win_paused", False)) \
             if link is not None else 0
+        # broadcast-plane per-peer wire observability (replica/link.py):
+        # bytes written to this peer, the negotiated compression's
+        # raw/wire ratio on its stream, encode-cache reuse counts
+        bytes_out = getattr(link, "bytes_out", 0) if link is not None \
+            else 0
+        craw = getattr(link, "comp_raw_bytes", 0) if link is not None \
+            else 0
+        cwire = getattr(link, "comp_wire_bytes", 0) if link is not None \
+            else 0
+        ratio = round(craw / cwire, 3) if cwire else 1.0
+        hits = getattr(link, "cache_hits", 0) if link is not None else 0
+        misses = getattr(link, "cache_misses", 0) \
+            if link is not None else 0
         out.append((f"replica{i}",
                     f"addr={addr},node_id={m.node_id},state={state},"
                     f"reconnects={recon},"
                     f"win_unacked={win},win_paused={win_p},"
+                    f"bytes_out={bytes_out},compressed_ratio={ratio},"
+                    f"cache_hits={hits},cache_misses={misses},"
                     f"i_sent={m.uuid_i_sent},i_acked={m.uuid_i_acked},"
                     f"he_sent={m.uuid_he_sent},he_acked={m.uuid_he_acked}"))
     if states:

@@ -56,6 +56,10 @@ class ServerApp:
                  apply_latency: Optional[float] = None,
                  wire_batch: Optional[int] = None,
                  wire_latency: Optional[float] = None,
+                 wire_compress: Optional[bool] = None,
+                 wire_compress_min: Optional[int] = None,
+                 encode_cache_mb: Optional[int] = None,
+                 bulk_compress_level: int = 6,
                  serve_batch: Optional[int] = None,
                  serve_shards: Optional[int] = None,
                  delta_sync: Optional[bool] = None,
@@ -142,6 +146,25 @@ class ServerApp:
         self.wire_latency = \
             (_env_float("CONSTDB_WIRE_LATENCY_MS", 5.0) / 1000.0) \
             if wire_latency is None else wire_latency
+        # broadcast plane (round 17): negotiated stream/bulk compression
+        # (CAP_COMPRESS — replica/link.py, utils/compressio.py) and the
+        # encode-once run cache cap.  None = the CONSTDB_WIRE_COMPRESS /
+        # CONSTDB_WIRE_COMPRESS_MIN / CONSTDB_ENCODE_CACHE_MB env
+        # defaults; wire_compress=False is the kill switch for BOTH legs
+        # (no outbound compression, no CAP_COMPRESS invitation), and
+        # encode_cache_mb=0 makes every push loop re-encode (the
+        # pre-broadcast path).  bulk_compress_level: zlib level for the
+        # FULLSYNC/DELTASYNC container (latency-insensitive, so higher
+        # than the per-section stream default).
+        from ..conf import env_flag as _env_flag
+        self.wire_compress = _env_flag("CONSTDB_WIRE_COMPRESS", True) \
+            if wire_compress is None else wire_compress
+        self.wire_compress_min = \
+            _env_int("CONSTDB_WIRE_COMPRESS_MIN", 512) \
+            if wire_compress_min is None else wire_compress_min
+        self.bulk_compress_level = bulk_compress_level
+        if encode_cache_mb is not None:
+            node.wire_cache.configure(max(0, encode_cache_mb) << 20)
         # client-path coalescing (server/serve.py): max pipelined
         # commands planned into one columnar micro-merge.  None = the
         # CONSTDB_SERVE_BATCH env default; <= 1 pins every connection to

@@ -54,6 +54,16 @@ class NodeStats:
     repl_wire_batches_in: int = 0
     repl_wire_batch_frames_in: int = 0
     repl_wire_demotions: int = 0
+    # broadcast plane (round 17): encode-once run cache reuse across the
+    # push-loop fan-out (replica/encode_cache.py; the resident bytes
+    # gauge reads node.wire_cache live), and negotiated stream
+    # compression accounting — raw payload bytes vs the framed bytes
+    # that actually shipped (REPLBATCH payloads over the floor; the
+    # ratio rides INFO as repl_compress_ratio)
+    repl_encode_cache_hits: int = 0
+    repl_encode_cache_misses: int = 0
+    repl_comp_raw_bytes: int = 0
+    repl_comp_wire_bytes: int = 0
     # anti-entropy resyncs SENT by this node's push legs
     # (replica/link.py): digest-negotiated deltas vs full snapshots,
     # the delta payload bytes that replaced them, and digest rounds run
@@ -191,6 +201,14 @@ class Node:
         self.governor = OverloadGovernor(self)
         from ..replica.manager import ReplicaManager
         self.replicas = ReplicaManager()
+        # encode-once run cache: finished wire encodings shared across
+        # the push-loop fan-out (replica/encode_cache.py; a registered
+        # used_memory source — server/overload.py).  Env-configured
+        # here; ServerApp overrides via wire_cache.configure.
+        from ..conf import env_int
+        from ..replica.encode_cache import RunEncodeCache
+        self.wire_cache = RunEncodeCache(
+            max(0, env_int("CONSTDB_ENCODE_CACHE_MB", 16)) << 20)
         # bumped by reset_for_full_resync; replica links stamp it at
         # connection install and refuse stale-epoch REPLACK beacons (a
         # beacon from a pre-wipe stream would re-advance a zeroed pull

@@ -27,8 +27,11 @@ Accounting sources (`used_memory`):
   * the repl-log ring (`total_bytes`; a MergedReplLog sums segments)
   * device pools — the engine's pinned win-value and tensor payload
     bytes (`_pool_bytes`/`_tns_bytes`)
+  * the encode-once run cache (`node.wire_cache` — the broadcast
+    plane's published wire encodings, replica/encode_cache.py)
   * registered extra sources (per-connection applier buffers register a
-    callable here; they unregister on teardown)
+    callable here, as does the shared-dump compression writer's working
+    buffer; they unregister on teardown)
 
 The check is cheap (a few dozen attribute reads) but not free, so the
 gate caches its verdict for `check_every` writes; the server cron calls
@@ -126,10 +129,12 @@ class OverloadGovernor:
         # getattr: a serve worker's repl_log is the plane's _TapLog
         # (drained into the parent's segments per ack — the parent's
         # MergedReplLog accounts those bytes)
+        wire_cache = getattr(node, "wire_cache", None)
         total = node.ks.used_bytes() \
             + (getattr(node.repl_log, "total_bytes", 0) or 0) \
             + (getattr(eng, "_pool_bytes", 0) or 0) \
-            + (getattr(eng, "_tns_bytes", 0) or 0)
+            + (getattr(eng, "_tns_bytes", 0) or 0) \
+            + (wire_cache.used_bytes() if wire_cache is not None else 0)
         for fn in self.sources:
             total += fn()
         return total
@@ -212,6 +217,11 @@ class OverloadGovernor:
         if release is not None:
             release(node.ks)
         node.ks.release_warm_caches()
+        wire_cache = getattr(node, "wire_cache", None)
+        if wire_cache is not None:
+            # the encode-once cache is exactly a rebuildable warm cache:
+            # dropping it costs re-encodes, never correctness
+            wire_cache.clear()
         if self.reclaim_gc:
             # gc() re-flushes (a no-op now) and compacts when dead rows
             # dominate; collection is bounded by the cluster horizon

@@ -93,6 +93,36 @@ print("wire smoke verified:",
 EOF
 
 echo
+echo "== broadcast smoke (bench --mode stream --peers 4) =="
+# tiny oracle-verified run of the broadcast plane: one pusher fanning
+# out to 4 peers with the encode-once cache on vs off (every peer's
+# captured stream applied + export-compared against the per-frame CPU
+# oracle), plus the compressed-vs-plain bulk-sync bytes leg (the
+# differential suites proper run inside tier-1 —
+# tests/test_encode_cache.py / tests/test_wire_compress.py)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_FRAMES=5000 CONSTDB_BENCH_FANOUT_REPS=1 \
+CONSTDB_BENCH_FSYNC_KEYS=20000 CONSTDB_BENCH_FSYNC_REPLICAS=2 \
+    timeout -k 10 300 python bench.py --mode stream --peers 4 \
+    > /tmp/_ci_fanout.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_fanout.json"))
+assert out["verified"], "broadcast smoke failed oracle verification"
+top = out["curve"][-1]
+assert top["cache_on"]["cache_hit_rate"] >= 0.7, \
+    f"encode-once reuse collapsed: {top['cache_on']['cache_hit_rate']}"
+assert top["speedup_vs_cache_off"] >= 1.5, \
+    f"fan-out stopped paying: {top['speedup_vs_cache_off']}x"
+fs = out["fullsync"]
+assert fs["bytes_ratio_vs_uncompressed"] <= 0.4, \
+    f"bulk compression stopped paying: {fs['bytes_ratio_vs_uncompressed']}"
+print("broadcast smoke verified:",
+      f"{top['speedup_vs_cache_off']}x agg fan-out at 4 peers,",
+      f"hit rate {top['cache_on']['cache_hit_rate']},",
+      f"bulk {fs['bytes_ratio_vs_uncompressed']}x of uncompressed")
+EOF
+
+echo
 echo "== resident smoke (pallas-interpret snapshot + stream) =="
 # tiny oracle-verified runs of the device-resident steady path with the
 # Pallas kernels forced through the interpreter: a kernel that drifts

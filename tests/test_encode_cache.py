@@ -1,0 +1,203 @@
+"""Encode-once run cache (replica/encode_cache.py + the push loop).
+
+The load-bearing claims, each pinned here:
+  * cache mechanics: ref-counted entries drop when the last expected
+    reader consumes them, the byte-capped LRU evicts oldest-first, ring
+    eviction sweeps dead cursor ranges, and cap 0 disables everything;
+  * fan-out reuse: two push loops draining the same log publish/reuse
+    ONE encoding per run and both receivers land the per-frame oracle's
+    exact state — for the batch class AND the per-frame ("f") class two
+    legacy peers share (the satellite fix: one legacy peer must not
+    reintroduce per-peer re-encody for its whole cursor range);
+  * caps-class keying: peers in different classes never share bytes
+    (a batch peer's stream is not served from a frame peer's entry);
+  * governor accounting: published bytes count into used_memory and the
+    hard-watermark reclaim drops them.
+"""
+
+import asyncio
+import os
+import sys
+import types
+
+import pytest  # noqa: F401
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_link_pushloop import _SharedDumpStub, _Writer  # noqa: E402
+from test_wire_batch import (mixed_bodies, perframe_reference,  # noqa: E402
+                             replay_stream_frames, scan, u)
+
+from constdb_tpu.replica.encode_cache import RunEncodeCache  # noqa: E402
+from constdb_tpu.replica.link import (CAP_BATCH_STREAM,  # noqa: E402
+                                      REPLBATCH, REPLICATE, ReplicaLink)
+from constdb_tpu.replica.manager import ReplicaMeta  # noqa: E402
+from constdb_tpu.resp.message import Bulk, Int  # noqa: E402
+from constdb_tpu.server.node import Node  # noqa: E402
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_refcount_and_lru_bound():
+    c = RunEncodeCache(cap_bytes=100)
+    c.put("b", 0, 10, b"x" * 40, readers=2)
+    assert c.bytes == 40
+    e = c.get("b", 0)
+    assert e is not None and e.end == 10 and e.refs == 1
+    assert c.get("b", 0) is not None  # second (last) expected reader
+    assert c.get("b", 0) is None      # consumed: entry dropped
+    assert c.bytes == 0
+
+    # LRU byte bound: oldest entries leave first
+    c.put("b", 0, 1, b"a" * 60, readers=9)
+    c.put("b", 1, 2, b"b" * 60, readers=9)  # 120 > 100: first evicted
+    assert c.get("b", 0) is None
+    assert c.get("b", 1) is not None
+    # zero readers / zero cap publish nothing
+    c.put("b", 5, 6, b"c" * 10, readers=0)
+    assert c.get("b", 5) is None
+    off = RunEncodeCache(cap_bytes=0)
+    off.put("b", 0, 1, b"zz", readers=5)
+    assert not off.enabled and off.get("b", 0) is None
+
+
+def test_ring_eviction_sweep_and_class_isolation():
+    c = RunEncodeCache(cap_bytes=1 << 20)
+    c.put("b", 100, 200, b"x" * 8, readers=3)
+    c.put("f", 100, 200, b"y" * 8, readers=3)
+    c.put("b", 300, 400, b"z" * 8, readers=3)
+    # classes are isolated: a frame peer never reads the batch bytes
+    assert c.get("f", 100).payload == b"y" * 8
+    assert c.get("b", 100).payload == b"x" * 8
+    # ring evicted past 250: the 100-cursor entries are unreachable
+    c.evict_below(250)
+    assert c.get("b", 100) is None and c.get("f", 100) is None
+    assert c.get("b", 300) is not None
+
+
+def test_governor_counts_cache_bytes():
+    node = Node(node_id=1)
+    node.governor.configure(maxmemory=1 << 30)
+    base = node.governor.used_memory()
+    node.wire_cache.put("b", 0, 10, b"p" * 5000, readers=4)
+    assert node.governor.used_memory() == base + 5000
+    # the hard-watermark reclaim treats it as a droppable warm cache
+    node.governor._on_hard()
+    assert node.wire_cache.used_bytes() == 0
+    assert node.governor.used_memory() == base
+
+
+# ------------------------------------------------------------ push fan-out
+
+
+def drive_fanout(tmp_path, bodies, caps_list, rounds=400,
+                 cache_mb=None):
+    """Drive one push loop PER entry of caps_list over the same filled
+    log (real ReplicaLink metas registered in the manager, so the
+    expected-reader count is live).  Returns (node, writers)."""
+    async def main():
+        node = Node(node_id=1, repl_log_cap=100_000)
+        if cache_mb is not None:
+            node.wire_cache.configure(cache_mb << 20)
+        app = types.SimpleNamespace(node=node, heartbeat=0.05,
+                                    reconnect_delay=0.05,
+                                    handshake_timeout=1.0,
+                                    work_dir=str(tmp_path))
+        app.shared_dump = _SharedDumpStub(node, str(tmp_path))
+        last = 0
+        for i, body in enumerate(bodies, 1):
+            args = [Int(a) if isinstance(a, int) else Bulk(a)
+                    for a in body[1:]]
+            node.repl_log.push(u(i), body[0], args)
+            last = u(i)
+        links, writers = [], []
+        for i, caps in enumerate(caps_list):
+            meta = ReplicaMeta(addr=f"fan:{i}")
+            node.replicas.peers[meta.addr] = meta
+            link = ReplicaLink(app, meta)
+            link._peer_caps = caps
+            links.append(link)
+            writers.append(_Writer())
+        tasks = [asyncio.create_task(lk._push_loop(w, peer_resume=0))
+                 for lk, w in zip(links, writers)]
+        try:
+            for _ in range(rounds):
+                await asyncio.sleep(0.01)
+                done = 0
+                for w in writers:
+                    covered = 0
+                    for kind, items in scan(w.buf):
+                        if kind in (REPLICATE, REPLBATCH):
+                            covered = int(items[3].val)
+                    done += covered >= last
+                if done == len(writers):
+                    break
+        finally:
+            for t in tasks:
+                t.cancel()
+        return node, writers
+    return asyncio.run(main())
+
+
+def test_batch_fanout_encodes_once(tmp_path):
+    bodies = mixed_bodies(400, seed=5)
+    node, writers = drive_fanout(tmp_path, bodies,
+                                 [CAP_BATCH_STREAM, CAP_BATCH_STREAM,
+                                  CAP_BATCH_STREAM])
+    st = node.stats
+    assert st.repl_encode_cache_hits > 0, "fan-out never reused"
+    assert st.repl_encode_cache_misses > 0
+    # every peer landed the per-frame oracle's exact state
+    entries = node.repl_log.run_after(0, len(bodies) + 1)
+    want = perframe_reference(entries, origin=node.node_id).canonical()
+    for w in writers:
+        got = replay_stream_frames(scan(w.buf))
+        assert got.canonical() == want
+
+
+def test_frame_class_fanout_shares_legacy_rendering(tmp_path):
+    """The satellite fix: TWO legacy peers at the same cursor share one
+    per-frame rendering — and it stays byte-exact."""
+    bodies = mixed_bodies(200, seed=9)
+    node, writers = drive_fanout(tmp_path, bodies, [0, 0])
+    assert node.stats.repl_encode_cache_hits > 0, \
+        "legacy fan-out never reused the per-frame rendering"
+    entries = node.repl_log.run_after(0, len(bodies) + 1)
+    want = perframe_reference(entries, origin=node.node_id).canonical()
+    for w in writers:
+        frames = scan(w.buf)
+        assert all(k != REPLBATCH for k, _ in frames)
+        got = replay_stream_frames(frames)
+        assert got.canonical() == want
+
+
+def test_mixed_classes_never_share(tmp_path):
+    """One batch peer + one legacy peer: each gets its own class's
+    bytes (the legacy stream holds no REPLBATCH, the batch stream
+    does), and both land identical state."""
+    bodies = mixed_bodies(200, seed=3)
+    node, writers = drive_fanout(tmp_path, bodies, [CAP_BATCH_STREAM, 0])
+    batch_frames = scan(writers[0].buf)
+    legacy_frames = scan(writers[1].buf)
+    assert any(k == REPLBATCH for k, _ in batch_frames)
+    assert all(k != REPLBATCH for k, _ in legacy_frames)
+    entries = node.repl_log.run_after(0, len(bodies) + 1)
+    want = perframe_reference(entries, origin=node.node_id).canonical()
+    assert replay_stream_frames(batch_frames).canonical() == want
+    assert replay_stream_frames(legacy_frames).canonical() == want
+
+
+def test_cache_disabled_still_exact(tmp_path):
+    """CONSTDB_ENCODE_CACHE_MB=0 (cap 0): every loop re-encodes — the
+    pre-broadcast path — and streams stay exact."""
+    bodies = mixed_bodies(150, seed=7)
+    node, writers = drive_fanout(tmp_path, bodies,
+                                 [CAP_BATCH_STREAM, CAP_BATCH_STREAM],
+                                 cache_mb=0)
+    assert not node.wire_cache.enabled
+    assert node.stats.repl_encode_cache_hits == 0
+    entries = node.repl_log.run_after(0, len(bodies) + 1)
+    want = perframe_reference(entries, origin=node.node_id).canonical()
+    for w in writers:
+        assert replay_stream_frames(scan(w.buf)).canonical() == want

@@ -51,7 +51,7 @@ class _SharedDumpStub:
         self.work_dir = work_dir
         self.dumps = 0
 
-    async def acquire(self):
+    async def acquire(self, compressed=False):
         from constdb_tpu.persist.share import Dump
         self.dumps += 1
         path = os.path.join(self.work_dir, f"dump{self.dumps}.snapshot")

@@ -233,6 +233,60 @@ def test_recovery_unsheds():
     assert not isinstance(r, Err)
 
 
+def test_capped_node_sheds_while_fanning_out(tmp_path):
+    """Accounting completeness under the broadcast plane (round 17): a
+    memory-capped node fanning out to FOUR peers — encode-once cache
+    and per-link buffers included in used_memory — still sheds with the
+    exact -OOM error, never crashes, and every write it DID land
+    replicates to all four peers."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from cluster_util import Client, close_cluster, make_cluster
+
+    async def main():
+        apps = await make_cluster(5, str(tmp_path))
+        try:
+            a = apps[0]
+            a.node.governor.configure(maxmemory=120_000, soft_pct=60.0)
+            a.node.governor.check_every = 8
+            c = await Client().connect(a.advertised_addr)
+            for peer in apps[1:]:
+                await c.cmd("meet", peer.advertised_addr)
+            shed = landed = 0
+            last_landed = None
+            for i in range(600):
+                r = await c.cmd("set", f"fan{i:04d}", "x" * 256)
+                if isinstance(r, Err):
+                    assert r.val == OOM_ERR, r.val
+                    shed += 1
+                else:
+                    landed += 1
+                    last_landed = f"fan{i:04d}".encode()
+                if shed >= 5 and landed >= 20:
+                    break
+            assert shed >= 5, "capped fan-out node never shed"
+            assert landed >= 20, "everything shed — cap far too low"
+            # the cache bytes really are part of the governed total
+            assert a.node.governor.used_memory() >= \
+                a.node.wire_cache.used_bytes()
+            # every landed write reaches all four peers (replication
+            # stays admitted and the fan-out keeps flowing while the
+            # node sheds client writes)
+            deadline = asyncio.get_running_loop().time() + 20.0
+            while True:
+                ok = all(p.node.ks.lookup(last_landed) >= 0
+                         for p in apps[1:])
+                if ok:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "landed write never reached all 4 peers"
+                await asyncio.sleep(0.05)
+            await c.close()
+        finally:
+            await close_cluster(apps)
+    asyncio.run(main())
+
+
 def test_hard_watermark_reclaims_warm_caches():
     node = capped_node(cap=2048, soft_pct=50.0)
     # grow past the HARD watermark via replication intake — client

@@ -145,8 +145,9 @@ def test_full_sync_dump_shared_and_reused(tmp_path):
             # re-dump (the cached file can no longer be topped up)
             for i in range(300):
                 await c[0].cmd("set", f"m{i}", f"w{i}")
-            assert not apps[0].node.repl_log.can_resume_from(
-                apps[0].shared_dump._current.repl_last)
+            cur = next(d for d in apps[0].shared_dump._current.values()
+                       if d is not None)
+            assert not apps[0].node.repl_log.can_resume_from(cur.repl_last)
             fresh = (await make_cluster(1, str(tmp_path)))[0]
             try:
                 cf = await Client().connect(fresh.advertised_addr)
@@ -502,8 +503,13 @@ def test_full_sync_stream_is_compressed(tmp_path):
     async def main():
         sizes = {}
         for level in (0, 1):
+            # wire_compress=False pins the PLAIN dump variant — the
+            # byte stream a pre-CAP_COMPRESS peer receives, whose
+            # section-level compression this test certifies (the
+            # container variant is covered by tests/test_wire_compress)
             apps = await make_cluster(2, str(tmp_path),
-                                      snapshot_compress_level=level)
+                                      snapshot_compress_level=level,
+                                      wire_compress=False)
             try:
                 a, b = apps
                 c = await Client().connect(a.advertised_addr)

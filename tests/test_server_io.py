@@ -222,7 +222,10 @@ def test_repl_bytes_and_cpu_section(tmp_path):
     link bytes into the net totals (round-1 blind spot), and the CPU
     section exists (reference stats.rs)."""
     async def main():
-        apps = await make_cluster(2, str(tmp_path))
+        # wire_compress=False: this test pins RAW byte accounting (the
+        # ~5KB of replicated values must show up on the gauges); the
+        # compressed stream's accounting rides tests/test_wire_compress
+        apps = await make_cluster(2, str(tmp_path), wire_compress=False)
         c = await Client().connect(apps[0].advertised_addr)
         try:
             for i in range(100):
