@@ -1649,7 +1649,8 @@ def serve_workload(conn_id: int, n_ops: int, n_keys: int, pipeline: int,
 
 
 def _serve_bench_server(pipe, serve_batch: int, engine_kind: str,
-                        serve_shards: int = 1) -> None:
+                        serve_shards: int = 1, aof_policy=None,
+                        aof_dir: str = "") -> None:
     """Forked server worker: one real ServerApp on a fresh port.  Sends
     the port up, serves until the parent says stop, then ships back the
     canonical export + serve stats.  `serve_shards > 1` runs the
@@ -1678,9 +1679,12 @@ def _serve_bench_server(pipe, serve_batch: int, engine_kind: str,
 
     async def main():
         node = Node(node_id=1, alias="bench", engine=make_engine())
+        kw = {}
+        if aof_policy is not None:
+            kw = dict(aof=True, aof_fsync=aof_policy, aof_dir=aof_dir)
         app = await start_node(node, host="127.0.0.1", port=0,
                                work_dir="/tmp", serve_batch=serve_batch,
-                               serve_shards=serve_shards)
+                               serve_shards=serve_shards, **kw)
         pipe.send(app.port)
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, pipe.recv)  # block until "stop"
@@ -1708,6 +1712,12 @@ def _serve_bench_server(pipe, serve_batch: int, engine_kind: str,
                     "barriers": x.get(f"serve_shard{s}_barriers", 0),
                     "keys": x.get(f"serve_shard{s}_keys", 0)}
                 for s in range(serve_shards)} if serve_shards > 1 else {},
+            "aof_size_bytes": node.oplog.size_bytes()
+            if node.oplog is not None else 0,
+            "aof_fsyncs": node.oplog.fsyncs
+            if node.oplog is not None else 0,
+            "aof_encoded_batches": node.oplog.encoded_batches
+            if node.oplog is not None else 0,
         }))
         await app.close()
 
@@ -1815,7 +1825,7 @@ async def _serve_drive(port: int, per_conn: list, rtts: list,
 
 
 def _serve_leg(serve_batch: int, engine_kind: str, per_conn: list,
-               serve_shards: int = 1):
+               serve_shards: int = 1, aof_policy=None, aof_dir: str = ""):
     """One full serve-bench leg: fork a server, drive the workload,
     collect (wall_s, rtts, reply_hashes, canonical, server_stats)."""
     import asyncio
@@ -1827,7 +1837,8 @@ def _serve_leg(serve_batch: int, engine_kind: str, per_conn: list,
     # daemonic process may not — those legs run non-daemonic with an
     # explicit terminate guard instead
     p = ctx.Process(target=_serve_bench_server,
-                    args=(child, serve_batch, engine_kind, serve_shards),
+                    args=(child, serve_batch, engine_kind, serve_shards,
+                          aof_policy, aof_dir),
                     daemon=serve_shards <= 1)
     p.start()
     child.close()
@@ -1935,6 +1946,130 @@ def serve_main(args) -> None:
     print(json.dumps(out))
     if not verified:
         sys.exit(1)
+
+
+def serve_aof_main(args) -> None:
+    """`bench.py --mode serve --aof`: the durability legs — the SAME
+    pipelined serve workload against AOF-off / everysec / always
+    servers, interleaved best-of-N, visible-value exports verified
+    identical across legs, so the fsync tax is measured, not guessed.
+    The `always` leg's produced log is then REPLAYED through the real
+    recovery path (persist/oplog.py) with the replayed export verified
+    against the leg's, yielding recovery seconds per GB of log."""
+    import shutil
+    import tempfile
+
+    n_ops = int(os.environ.get("CONSTDB_BENCH_AOF_OPS", 60_000))
+    n_conns = int(os.environ.get("CONSTDB_BENCH_SERVE_CONNS", 4))
+    pipeline = int(os.environ.get("CONSTDB_BENCH_SERVE_PIPELINE", 64))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_SERVE_KEYS", 2000))
+    serve_batch = int(os.environ.get("CONSTDB_BENCH_SERVE_BATCH", 512))
+    engine_kind = os.environ.get("CONSTDB_BENCH_SERVE_ENGINE", "cpu")
+    reps = int(os.environ.get("CONSTDB_BENCH_AOF_REPS", 2))
+
+    ensure_native()
+    per_ops = n_ops // n_conns
+    per_conn = [serve_workload(ci, per_ops, n_keys, pipeline)
+                for ci in range(n_conns)]
+    total = per_ops * n_conns
+    print(f"[bench] aof workload: {total} ops over {n_conns} conns x "
+          f"{pipeline}-deep pipelines", file=sys.stderr)
+
+    policies = (None, "everysec", "always")
+    best: dict = {p: None for p in policies}
+    best_dir: dict = {p: "" for p in policies}
+    root = tempfile.mkdtemp(prefix="constdb-aofbench-")
+    try:
+        for rep in range(reps):
+            for pol in policies:
+                aof_dir = os.path.join(root, f"{pol}-{rep}") if pol \
+                    else ""
+                leg = _serve_leg(serve_batch, engine_kind, per_conn,
+                                 aof_policy=pol, aof_dir=aof_dir)
+                tag = pol or "off"
+                print(f"[bench] rep {rep + 1} aof={tag}: {leg[0]:.3f}s "
+                      f"= {total / leg[0]:,.0f} req/s "
+                      f"({leg[4]['aof_size_bytes']} log bytes, "
+                      f"{leg[4]['aof_fsyncs']} fsyncs)", file=sys.stderr)
+                if best[pol] is None or leg[0] < best[pol][0]:
+                    best[pol] = leg
+                    best_dir[pol] = aof_dir
+
+        off = best[None]
+        stripped_off = strip_canonical_times(off[3])
+        legs_out = []
+        verified = True
+        for pol in policies:
+            wall, rtts, hashes, canon, stats = best[pol]
+            ok = hashes == off[2] and \
+                strip_canonical_times(canon) == stripped_off
+            verified = verified and ok
+            lat_ms = np.asarray(rtts) * 1000.0
+            legs_out.append({
+                "aof": pol or "off",
+                "rps": round(total / wall, 1),
+                "wall_s": round(wall, 3),
+                "vs_off": round(off[0] / wall, 3),
+                "reply_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "reply_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "aof_size_bytes": stats["aof_size_bytes"],
+                "aof_fsyncs": stats["aof_fsyncs"],
+                "aof_encoded_batches": stats["aof_encoded_batches"],
+                "replies_ok": hashes == off[2],
+            })
+
+        # recovery replay of the `always` leg's log, timed (the real
+        # boot path: persist/oplog.py recover through the merge engine)
+        from constdb_tpu.persist import oplog as OL
+        from constdb_tpu.server.node import Node as _Node
+        rec_dir = best_dir["always"]
+        log_bytes = sum(
+            os.path.getsize(os.path.join(rec_dir, f))
+            for f in os.listdir(rec_dir) if f.endswith(".log"))
+        t0 = time.perf_counter()
+        rnode = _Node(node_id=1, alias="recover")
+        info = OL.recover(rnode, rec_dir)
+        rec_wall = time.perf_counter() - t0
+        # GC-invariant oracle: replayed visible values == the leg's
+        for _ in range(64):
+            rnode.gc()
+            if not rnode.ks.garbage:
+                break
+        recov_ok = {k: v for k, v in
+                    strip_canonical_times(rnode.canonical()).items()
+                    if v[1]} == \
+            {k: v for k, v in
+             strip_canonical_times(best["always"][3]).items() if v[1]}
+        verified = verified and recov_ok
+        rec_per_gb = rec_wall / max(log_bytes / 1e9, 1e-9)
+        print(f"[bench] recovery: {info.frames + info.batch_frames} ops "
+              f"from {log_bytes} log bytes in {rec_wall:.3f}s = "
+              f"{rec_per_gb:,.1f} s/GB; replay "
+              f"{'OK' if recov_ok else 'MISMATCH'}", file=sys.stderr)
+
+        out = {
+            "metric": "serve_aof_everysec_vs_off",
+            "value": legs_out[1]["vs_off"],
+            "unit": "ratio",
+            "mode": "serve-aof",
+            "ops": total,
+            "conns": n_conns,
+            "pipeline": pipeline,
+            "legs": legs_out,
+            "recovery_wall_s": round(rec_wall, 3),
+            "recovery_log_bytes": log_bytes,
+            "recovery_s_per_gb": round(rec_per_gb, 2),
+            "recovery_ops": info.frames + info.batch_frames,
+            "recovery_verified": recov_ok,
+            "engine": engine_kind,
+            "verified": verified,
+            "host": host_fingerprint(),
+        }
+        print(json.dumps(out))
+        if not verified:
+            sys.exit(1)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 async def _overload_drive(port: int, per_conn: list, tallies: list,
@@ -2721,6 +2856,11 @@ def main() -> None:
                     help="serve mode: comma list of shard counts (e.g. "
                     "1,2) — runs the shard-per-core scaling curve "
                     "instead of the coalesced-vs-per-command comparison")
+    ap.add_argument("--aof", action="store_true",
+                    help="serve mode: the DURABILITY legs — AOF off / "
+                    "everysec / always interleaved on the same workload "
+                    "(fsync tax), plus a timed recovery replay of the "
+                    "always leg's log (s/GB) — BENCH_r17")
     ap.add_argument("--overload", action="store_true",
                     help="serve mode: the OVERLOAD leg — maxmemory set "
                     "below the workload's footprint; reports shed rate, "
@@ -2742,7 +2882,9 @@ def main() -> None:
             stream_main(args)
         return
     if args.mode == "serve":
-        if args.overload:
+        if args.aof:
+            serve_aof_main(args)
+        elif args.overload:
             serve_overload_main(args)
         elif args.serve_shards:
             serve_shards_main(args)

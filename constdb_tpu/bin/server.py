@@ -133,7 +133,12 @@ async def amain(cfg: Config) -> None:
         gc_peer_retention=float(cfg.gc_peer_retention),
         ingest_shards=cfg.ingest_shards,
         ingest_shard_min_bytes=cfg.ingest_shard_min_bytes,
-        serve_shards=cfg.serve_shards or None)
+        serve_shards=cfg.serve_shards or None,
+        aof=cfg.aof or None,
+        aof_fsync=cfg.aof_fsync or None,
+        aof_rewrite_pct=cfg.aof_rewrite_pct
+        if cfg.aof_rewrite_pct >= 0 else None,
+        aof_dir=cfg.aof_dir)
     log.info("constdb-tpu node %d (engine=%s) serving on %s",
              node.node_id, node.engine.name, app.advertised_addr)
 

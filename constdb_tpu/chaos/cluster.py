@@ -74,6 +74,11 @@ class NodeSpec:
     serve_batch: Optional[int] = None
     serve_shards: int = 1
     repl_log_cap: int = 1_024_000
+    # durable op log (persist/oplog.py): the fsync policy name enables
+    # AOF for this node ("always" | "everysec" | "no"); None = off.
+    # The cluster pins each node's aof dir to its index so kill9/cold
+    # restarts recover from the node's OWN log, no harness-side dump.
+    aof: Optional[str] = None
     extra: dict = field(default_factory=dict)
 
     def build_engine(self):
@@ -101,6 +106,9 @@ class NodeSpec:
             kw["serve_batch"] = self.serve_batch
         if self.serve_shards > 1:
             kw["serve_shards"] = self.serve_shards
+        if self.aof is not None:
+            kw["aof"] = True
+            kw["aof_fsync"] = self.aof
         return kw
 
 
@@ -207,10 +215,15 @@ class ChaosCluster:
                         repl_log_cap=spec.repl_log_cap,
                         clock=self.clocks[i])
         port = self.apps[i].port if self.apps[i] is not None else 0
+        kw = spec.app_kwargs()
+        if spec.aof is not None:
+            # stable per-node dir: a restarted node recovers from its
+            # OWN durable log, the way a real process would
+            kw["aof_dir"] = os.path.join(self.work_dir, f"aof.n{i}")
         app = await start_node(node, host="127.0.0.1", port=port,
                                work_dir=self.work_dir,
                                snapshot_path=snapshot_path,
-                               **spec.app_kwargs())
+                               **kw)
         self._wire(i, app)
         return app
 
@@ -244,9 +257,21 @@ class ChaosCluster:
         real io.py boot-restore path (start_node), including the merged
         repl-log watermark fences.  The undo log, reconnect ladders, and
         every in-memory watermark die with the process, exactly as a
-        real crash loses them."""
+        real crash loses them.
+
+        AOF variant: an AOF-enabled node takes NO harness-side dump —
+        the clean shutdown group-commits its own log and recovery comes
+        entirely from the node's own snapshot + oplog tail (the
+        durability path under certification)."""
         app = self.apps[i]
         old = app.node
+        if self.specs[i].aof is not None:
+            await app.close()
+            if hasattr(old.engine, "close"):
+                old.engine.close()
+            self._bank_stats(old)
+            self.incarnations[i] += 1
+            return await self.start_one(i)
         snap = os.path.join(self.work_dir, f"chaos.{old.node_id}.snapshot")
         # watermarks (meta + records) BEFORE the state export — the
         # consistency-cut rule every dump site follows (persist/
@@ -272,6 +297,81 @@ class ChaosCluster:
         self.incarnations[i] += 1
         return await self.start_one(i, snapshot_path=snap)
 
+    async def kill9(self, i: int, torn: bool = False,
+                    rng: Optional[random.Random] = None) -> ServerApp:
+        """`kill -9` (+ optional power loss) and cold restart from the
+        node's OWN durable op log — no harness-side dump, no graceful
+        group commit:
+
+          * process death: bytes the OpLog had buffered in memory die
+            with it; bytes already written survive in the page cache
+            (exactly a SIGKILL's semantics) — the op log is frozen
+            AS-IS before the teardown's close path could flush it.
+          * `torn=True` additionally models power loss: each segment is
+            truncated at a SEEDED offset inside its un-fsynced suffix —
+            possibly mid-record, the torn-tail case recovery must
+            repair loudly.
+
+        After recovery the journal obligation is pruned of the node's
+        never-durable ops: by the emit-only-durable law they were never
+        advertised to any peer, so they cease to exist mesh-wide
+        (oracle.prune_origin); fsync-acknowledged writes are below the
+        durable fence and MUST therefore still converge byte-identically
+        — the zero-acked-loss certification."""
+        app = self.apps[i]
+        old = app.node
+        lg = old.oplog
+        assert lg is not None, "kill9 targets AOF-enabled nodes"
+        paths = [lg.seg_path(lg.dir, lg.generation, s)
+                 for s in range(lg.n_segments)]
+        # freeze the log exactly as the dying process leaves it: close()
+        # must NOT run its final drain + group commit — and a real
+        # SIGKILL stops EVERYTHING at that same instant, so no in-flight
+        # serve chunk may land, ack, or journal after the freeze (a
+        # graceful close would keep quiescing worker chunks whose
+        # mirror the frozen log silently drops: journaled-but-never-
+        # logged ops that no fence can account for).  Connections and
+        # the worker pool die first, then the teardown runs.
+        lg._closed = True
+        for t in list(app._conn_tasks):
+            t.cancel()
+        if app.serve_plane is not None:
+            await app.serve_plane.close()
+        await app.close()
+        # the durable point is read AFTER close: an in-flight group
+        # commit can SETTLE during the teardown awaits (releasing the
+        # emission floor — a stopping push loop may legally emit those
+        # just-durable ops), so a snapshot taken before close could
+        # mark emitted-and-durable bytes as torn-able and the
+        # truncation would forge exactly the emitted-but-lost
+        # divergence the emit-only-durable law forbids (found by the
+        # everysec cell flaking under load)
+        synced = list(lg.synced_sizes)
+        if hasattr(old.engine, "close"):
+            old.engine.close()
+        self._bank_stats(old)
+        if torn:
+            r = rng if rng is not None else \
+                random.Random((self.seed << 4) ^ (0x70A9 + i))
+            for s, path in enumerate(paths):
+                if not os.path.exists(path):
+                    continue
+                size = os.path.getsize(path)
+                lo = min(synced[s] if s < len(synced) else size, size)
+                if size > lo:
+                    cut = r.randrange(lo, size)
+                    with open(path, "r+b") as f:
+                        f.truncate(cut)
+        self.incarnations[i] += 1
+        app2 = await self.start_one(i)
+        if self.journal is not None:
+            fence = app2.node.stats.extra.get("aof_recovered_fence", 0)
+            pruned = self.journal.prune_origin(app2.node.node_id, fence)
+            if pruned:
+                self.retired_stats["journal_pruned"] = \
+                    self.retired_stats.get("journal_pruned", 0) + pruned
+        return app2
+
     async def restart_warm(self, i: int) -> ServerApp:
         """Process hiccup: the Node object (state, undo log, repl_log)
         survives, every connection does not."""
@@ -280,9 +380,17 @@ class ChaosCluster:
         port = app.port
         await app.close()
         self.incarnations[i] += 1
+        kw = self.specs[i].app_kwargs()
+        if self.specs[i].aof is not None:
+            kw["aof_dir"] = os.path.join(self.work_dir, f"aof.n{i}")
         app2 = ServerApp(node, host="127.0.0.1", port=port,
-                         work_dir=self.work_dir,
-                         **self.specs[i].app_kwargs())
+                         work_dir=self.work_dir, **kw)
+        if self.specs[i].aof is not None:
+            # the Node (and its state) survives a warm restart, but the
+            # old app's close() closed its op log — re-open it, no
+            # replay needed (persist/oplog.py rearm)
+            from ..persist.oplog import rearm
+            rearm(app2)
         await app2.start()
         self._wire(i, app2)
         return app2

@@ -60,6 +60,20 @@ class OpJournal:
                 args: list) -> None:
         self.ops.setdefault((origin, uuid), (name, args))
 
+    def prune_origin(self, origin: int, above: int) -> int:
+        """Drop `origin`'s journaled ops with uuid > `above` — the
+        kill9/torn-write accounting: ops a crashed node appended but
+        never made durable were, by the emit-only-durable law
+        (persist/oplog.py), never advertised to any peer either, so
+        they cease to exist mesh-wide and leave the convergence
+        obligation.  `above` is the crashed node's recovered local
+        watermark; anything it DID recover (or ever emitted) is at or
+        below it and stays in the obligation.  Returns the count."""
+        dead = [k for k in self.ops if k[0] == origin and k[1] > above]
+        for k in dead:
+            del self.ops[k]
+        return len(dead)
+
     def reference_canonical(self, collected: bool = False) -> dict:
         """The certified reference: a fresh CPU-engine node applying
         every journaled rewrite through the REAL per-key apply path, in

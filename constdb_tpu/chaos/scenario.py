@@ -46,12 +46,18 @@ class Cell:
     #                         (CAP_COMPRESS — round 17)
     shards: int = 1         # serve workers per node (1 = single loop)
     engine: str = "cpu"     # cpu | xla | xla-resident
+    aof: Optional[str] = None  # durable op log fsync policy (round 18):
+    #                            "always" | "everysec" | "no"; None =
+    #                            off.  AOF cells grow kill9_mid_write +
+    #                            torn_write steps — cold restarts that
+    #                            recover from the node's OWN log.
 
     @property
     def name(self) -> str:
         return (f"wire{int(self.wire)}-delta{int(self.delta)}"
                 f"-comp{int(self.compress)}"
-                f"-shards{self.shards}-{self.engine}")
+                f"-shards{self.shards}-{self.engine}"
+                + (f"-aof-{self.aof}" if self.aof else ""))
 
     def specs(self, n: int = 3, mixed_idx: Optional[int] = None
               ) -> list[NodeSpec]:
@@ -68,7 +74,8 @@ class Cell:
             if i == mixed_idx:
                 out.append(NodeSpec(engine="cpu", wire_batch=1,
                                     delta_sync=False,
-                                    wire_compress=False))
+                                    wire_compress=False,
+                                    aof=self.aof))
             else:
                 out.append(NodeSpec(
                     engine=self.engine,
@@ -76,6 +83,7 @@ class Cell:
                     delta_sync=None if self.delta else False,
                     wire_compress=None if self.compress else False,
                     serve_shards=self.shards,
+                    aof=self.aof,
                     extra={"wire_compress_min": 64}
                     if self.compress else {}))
         return out
@@ -103,6 +111,16 @@ def matrix_cells() -> list[Cell]:
     for delta in (True, False):
         cells.append(Cell(wire=False, delta=delta, shards=2,
                           engine="cpu"))
+    # durability cells (round 18): every AOF cell adds kill9_mid_write
+    # + torn_write cold restarts recovering from the node's own log.
+    # `always` carries the zero-acked-loss law; `everysec` certifies
+    # the weaker contract (durable-prefix recovery + re-convergence);
+    # one sharded cell drives the per-shard segment merge.
+    cells.append(Cell(aof="always"))
+    cells.append(Cell(aof="everysec"))
+    cells.append(Cell(wire=False, delta=False, compress=False,
+                      aof="always"))
+    cells.append(Cell(wire=False, shards=2, aof="always"))
     return cells
 
 
@@ -113,7 +131,8 @@ def smoke_cells() -> list[Cell]:
     bytes end to end), the resident engine, and the sharded serving
     plane."""
     return [Cell(), Cell(wire=False, delta=False, compress=False),
-            Cell(engine="xla-resident"), Cell(shards=2, wire=False)]
+            Cell(engine="xla-resident"), Cell(shards=2, wire=False),
+            Cell(aof="always"), Cell(aof="everysec")]
 
 
 @dataclass
@@ -193,8 +212,22 @@ def certify_scenario(seed: int, cell: Optional[Cell] = None,
         ("ops", ops // 2),
         ("clock_jump", 2, -20_000),
         ("ops", ops // 2),
-        ("certify",),
     ]
+    if cell.aof:
+        # durability primitives (round 18): kill -9 mid-firehose and a
+        # torn-tail power loss, each followed by a cold restart that
+        # recovers from the node's OWN op log (no harness-side dump).
+        # The oracle then certifies that every fsync-acknowledged write
+        # survived and the mesh re-converged byte-identically — the
+        # never-durable suffix is pruned from the journal obligation
+        # under the emit-only-durable law (cluster.kill9).
+        steps += [
+            ("kill9_mid_write", 0),
+            ("ops", ops),
+            ("torn_write", 1),
+            ("ops", ops),
+        ]
+    steps += [("certify",)]
     return Scenario(seed=seed, cell=cell, steps=steps,
                     ops_per_burst=ops)
 
@@ -400,6 +433,26 @@ async def _corrupt_burst(sc: Scenario, cluster: ChaosCluster, plane,
         f"swallowed silently")
 
 
+async def _kill9_mid_write(cluster: ChaosCluster, wl: "_Workload",
+                           i: int, torn: bool) -> None:
+    """kill -9 (optionally with a torn-tail power loss) while a
+    pipelined firehose is mid-flight on node `i`, then cold-restart
+    from the node's own op log.  The firehose's unacked suffix dies
+    with the connection — exactly the window the durability laws are
+    about (cluster.kill9 prunes the never-durable part of the journal
+    obligation)."""
+    task = asyncio.create_task(wl.pipelined_writes(cluster, i, 96))
+    # seeded-but-unconditional draw: the rng stream must not depend on
+    # scheduling (scenario replays stay a pure function of the seed)
+    await asyncio.sleep(0.004 + wl.rng.random() * 0.02)
+    await cluster.kill9(i, torn=torn)
+    try:
+        await task
+    except (ConnectionError, OSError, asyncio.TimeoutError,
+            asyncio.IncompleteReadError):
+        pass
+
+
 async def _run_scenario_async(sc: Scenario) -> dict:
     import tempfile
 
@@ -450,6 +503,11 @@ async def _run_scenario_async(sc: Scenario) -> dict:
                         wl.clear_undo(i)
                     else:
                         await cluster.restart_warm(i)
+                elif kind in ("kill9_mid_write", "torn_write"):
+                    i = step[1]
+                    await _kill9_mid_write(cluster, wl, i,
+                                           torn=kind == "torn_write")
+                    wl.clear_undo(i)
                 elif kind == "clock_jump":
                     cluster.clock_jump(step[1], step[2])
                 elif kind == "probe_setup":
@@ -505,15 +563,36 @@ def _check_probes(sc: Scenario, cluster, wl: _Workload, canon: dict,
                   probe_member: bytes) -> None:
     """No-resurrection laws over the converged canonical export.  A
     canonical() entry is (enc, ct, mt, dt, expire, content); element
-    content rows are (member, add_t, add_node, del_t, val)."""
+    content rows are (member, add_t, add_node, del_t, val).
+
+    Durability interplay (AOF cells): a kill9/torn crash legally
+    ERASES acked-but-never-fsynced ops under `everysec` — the oracle
+    prunes them from the journal obligation (emit-only-durable) and
+    the mesh converges WITHOUT them.  A retired key whose DELETE op no
+    longer exists in the journal is therefore legitimately live again
+    (the delete never durably happened); the law being probed —
+    nothing resurrects a delete that still EXISTS — only applies while
+    the journal holds it.  `certify_state` (which already ran) pins
+    the canonical to the pruned journal either way."""
+    def journal_has(name: bytes, key: bytes) -> bool:
+        j = cluster.journal
+        if j is None:
+            return True
+        return any(n == name and a and getattr(a[0], "val", None) == key
+                   for (_o, _u), (n, a) in j.ops.items())
+
     for key in wl.retired_regs:
         ent = canon.get(key)
+        if ent is not None and not ent[1] < ent[3] and \
+                not journal_has(b"delbytes", key):
+            continue  # the delete was crash-erased before any fsync
         assert ent is None or ent[1] < ent[3], \
             f"[chaos {sc.name}] retired key {key!r} resurrected: {ent}"
     s = canon.get(b"probe:s")
     if s is not None:
         members = {m for m, _at, _an, dlt, _v in s[5] if dlt == 0}
-        assert probe_member not in members, \
+        assert probe_member not in members or \
+            not journal_has(b"srem", b"probe:s"), \
             f"[chaos {sc.name}] removed member resurrected after " \
             f"partition heal: {sorted(members)}"
 

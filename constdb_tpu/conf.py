@@ -215,6 +215,26 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "fsync background/shutdown snapshot dumps — file AND parent "
            "directory after the atomic rename — so a crash right after "
            "the dump cannot lose it; 0 trades that for dump latency"),
+    EnvVar("CONSTDB_AOF", "0",
+           "durable op log (persist/oplog.py): every repl-log append "
+           "mirrors into crc-framed append-only segments in "
+           "<work_dir>/aof and boot replays snapshot + oplog tail "
+           "through the real merge path; 0 = in-memory only (a crash "
+           "between snapshot dumps loses acknowledged writes)"),
+    EnvVar("CONSTDB_AOF_FSYNC", "everysec",
+           "group-commit policy: always = a serve chunk is acked only "
+           "after its covering fsync lands (one fsync per pipelined "
+           "chunk); everysec = background fsync every second; no = the "
+           "OS decides (records still written through)"),
+    EnvVar("CONSTDB_AOF_REWRITE_PCT", "100",
+           "log-rewrite compaction trigger: when the oplog grows this "
+           "percent past its post-rewrite base size, the node rewrites "
+           "it as base snapshot + fresh segments (atomic rename + "
+           "parent fsync); 0 disables auto-rewrite"),
+    EnvVar("CONSTDB_AOF_REWRITE_MIN_MB", "16",
+           "oplog size floor (MB) below which the rewrite trigger "
+           "never fires — tiny logs are cheaper to replay than to "
+           "compact"),
 )}
 
 
@@ -304,6 +324,17 @@ class Config:
     #                        + engine + repl-log segment, the event loop
     #                        routing by key hash.  0 = the CONSTDB_SERVE_SHARDS
     #                        env default (1); 1 = the exact single-loop path.
+    aof: bool = False      # durable op log (persist/oplog.py): mirror
+    #                        every repl-log append into crc-framed
+    #                        append-only segments under aof_dir and
+    #                        replay snapshot + oplog tail on boot.
+    #                        False = the CONSTDB_AOF env default decides.
+    aof_fsync: str = ""    # "always" | "everysec" | "no"; "" = the
+    #                        CONSTDB_AOF_FSYNC env default (everysec)
+    aof_rewrite_pct: int = -1  # log-rewrite growth trigger (percent over
+    #                        the post-rewrite base; 0 = off); -1 = the
+    #                        CONSTDB_AOF_REWRITE_PCT env default (100)
+    aof_dir: str = ""      # segment directory; "" = <work_dir>/aof
     # a peer silent for longer than this stops pinning the GC tombstone
     # horizon.  0 (default) = never exclude — the reference's behavior,
     # where one dead peer pins tombstone collection mesh-wide forever
@@ -333,6 +364,10 @@ def load_config(argv: list[str] | None = None) -> Config:
     ap.add_argument("--engine", choices=["auto", "tpu", "tpu!", "cpu"])
     ap.add_argument("--snapshot", dest="snapshot_path")
     ap.add_argument("--snapshot-interval", type=int, dest="snapshot_interval")
+    ap.add_argument("--aof", action="store_const", const=True, dest="aof",
+                    default=None, help="enable the durable op log")
+    ap.add_argument("--aof-fsync", dest="aof_fsync",
+                    choices=["always", "everysec", "no"])
     ap.add_argument("--log-level", dest="log_level")
     ns = ap.parse_args(argv)
 

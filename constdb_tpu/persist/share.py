@@ -105,6 +105,24 @@ class SharedDump:
             captures = [batch_from_keyspace(node.ks)]  # on the loop
             repl_last = node.repl_log.last_uuid
             records = node.replicas.records()
+        if node.oplog is not None and node.oplog.policy != "no":
+            # emit-only-durable (persist/oplog.py): the dump streams
+            # state effects of every op in the capture — group-commit
+            # AFTER the capture, so everything it contains is durable
+            # before a peer can hold it.  Capture-THEN-commit is the
+            # load-bearing order: a commit taken first covers only its
+            # own capture instant, and ops landing DURING its fsync
+            # would be in the state cut but not in the durable prefix —
+            # exactly the emitted-but-torn-away divergence the chaos
+            # everysec cell caught.  The yield first: on a SHARDED node
+            # the worker exports can resolve before earlier serve acks'
+            # done-callbacks ran (the quiesce race serve_shards.py
+            # documents), so ops already IN the captures may not have
+            # mirrored into the op log yet — one loop turn runs those
+            # queued callbacks, and the commit's capture then covers
+            # them.
+            await asyncio.sleep(0)
+            await node.oplog.ack_barrier()
         meta = NodeMeta(node_id=node.node_id, alias=node.alias,
                         addr=app.advertised_addr, repl_last_uuid=repl_last)
         suffix = ".z" if compressed else ""

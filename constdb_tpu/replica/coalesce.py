@@ -475,6 +475,11 @@ class CoalescingApplier:
         st.repl_wire_batch_frames_in += n
         node.hlc.observe(last)
         node.merge_stream_batch(wb, n)
+        if node.oplog is not None:
+            # the (decompressed) payload IS the columnar wire encoding
+            # and was just crc-validated whole: splice it into the
+            # durable op log verbatim — zero re-encode (persist/oplog.py)
+            node.oplog.append_batch(origin, first_prev, last, n, payload)
         self.cursor = last
         self._advance(last, wake=True)
 
@@ -526,6 +531,17 @@ class CoalescingApplier:
             # drop them; the wiped store is re-seeded by the resync
             self._pending_beacon = 0
             return
+        if node.oplog is not None:
+            # mirror the frames this flush LANDS, in uuid order, before
+            # the merge: appended-but-unlanded on a crash replays as an
+            # idempotent superset, while land-without-append could lose
+            # an acked-upstream op (persist/oplog.py)
+            allrecs = sorted(
+                (r[2], r[1], name, r[3])
+                for name, recs in buf.items() for r in recs)
+            for uuid, origin, name, items in allrecs:
+                node.oplog.append_frame(origin, uuid, name,
+                                        list(items[5:]))
         bb = BatchBuilder(node.ks)
         failures: list = []
         for name, recs in buf.items():
@@ -578,6 +594,8 @@ class CoalescingApplier:
                 self.flush()
         node.stats.repl_apply_barriers += 1
         node.apply_replicated(name, items[5:], origin, uuid)
+        if node.oplog is not None:
+            node.oplog.append_frame(origin, uuid, name, list(items[5:]))
         self.cursor = uuid
         if not self._frames:
             self._advance(uuid)

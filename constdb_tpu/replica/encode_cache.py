@@ -85,14 +85,26 @@ class RunEncodeCache:
 
     # ---------------------------------------------------------------- ops
 
-    def get(self, caps_class: str, cursor: int) -> Optional[CachedRun]:
+    def get(self, caps_class: str, cursor: int,
+            below: Optional[int] = None) -> Optional[CachedRun]:
         """The published encoding starting exactly after `cursor`, or
         None (the caller encodes and `put`s).  Consuming the last
         expected reader's reference drops the entry.  (Hit/miss GAUGES
         live on NodeStats — repl_encode_cache_hits/misses, counted by
-        the push loop per DRAINED run, not per empty poll.)"""
+        the push loop per DRAINED run, not per empty poll.)
+
+        `below`: the caller's emission floor (repl-log floor
+        discipline) — an entry whose run reaches at/past it is NOT
+        handed out (and its refs are untouched: the caller will be
+        back once the floor clears).  Load-bearing for the durable op
+        log's emit-only-durable law: the serve path publishes a run's
+        encoding at flush time, BEFORE its group commit lands, and an
+        ungated splice would emit ops a torn tail could still lose
+        (persist/oplog.py; caught by the chaos everysec cell)."""
         e = self._map.get((caps_class, cursor))
         if e is None:
+            return None
+        if below is not None and e.end >= below:
             return None
         e.refs -= 1
         if e.refs <= 0:

@@ -785,8 +785,16 @@ class ReplicaLink:
                     return self._flush_wire(writer, buf)
 
                 while not paused:
-                    hit = cache.get(caps_class, cursor) \
-                        if cache.enabled else None
+                    hit = None
+                    if cache.enabled:
+                        # the splice honors the same emission floor
+                        # run_after applies (encode_cache.get docstring:
+                        # a published-but-not-yet-durable run must not
+                        # be emitted through the cache side door)
+                        fl = getattr(node.repl_log, "floor", None)
+                        hit = cache.get(
+                            caps_class, cursor,
+                            below=fl() if callable(fl) else None)
                     if hit is not None:
                         # published by another peer's loop at this exact
                         # cursor: splice the finished bytes and republish
@@ -876,8 +884,30 @@ class ReplicaLink:
                     continue
 
                 now = asyncio.get_running_loop().time()
-                if (meta.uuid_he_sent > meta.uuid_he_acked
+                # durable-ack cap (persist/oplog.py): the advertised
+                # pull watermark and coverage may only name intake
+                # frames the op log has made durable — a torn tail must
+                # never clip a frame a peer was already TOLD we hold
+                # (its GC gates tombstone collection on these values).
+                # Without an op log both caps are identity.
+                oplog = node.oplog
+                ack_val = meta.uuid_he_sent
+                if oplog is not None:
+                    # clamped to the last advertised value: a reconnect
+                    # redelivery re-appends frames BELOW an ack already
+                    # sent, but the original copies are in the durable
+                    # prefix — regressing the advertisement would only
+                    # confuse monotonicity monitors, never durability
+                    ack_val = max(oplog.cap_ack(meta.node_id, ack_val),
+                                  meta.uuid_he_acked)
+                if (ack_val > meta.uuid_he_acked
                         or now - last_ack >= self.app.heartbeat):
+                    # coverage is only computed when an ack actually
+                    # goes out — it is an O(peers) scan and this loop
+                    # wakes per delivered batch under firehose intake
+                    coverage = node.replicas.cluster_coverage()
+                    if oplog is not None:
+                        coverage = oplog.cap_coverage(coverage)
                     # beacon: with the log fully drained, every uuid this
                     # node will EVER stream from now on exceeds its current
                     # HLC — peers may advance their pull watermark to it, so
@@ -888,11 +918,20 @@ class ReplicaLink:
                     # (manager.min_uuid; legacy receivers ignore extras).
                     drained = cursor >= node.repl_log.last_uuid
                     beacon = node.hlc.current if drained else 0
+                    if beacon and oplog is not None:
+                        # the beacon is the promise "every uuid I will
+                        # EVER mint exceeds B" — with a durable op log,
+                        # B is capped at the last group-committed HLC
+                        # mark, or a crash could rewind the clock below
+                        # an already-sent beacon and peers would dup-
+                        # skip the re-minted window forever
+                        # (persist/oplog.py beacon_cap)
+                        beacon = min(beacon, oplog.beacon_cap)
                     self._write(writer, encode_msg(Arr([
-                        Bulk(REPLACK), Int(meta.uuid_he_sent), Int(now_ms()),
+                        Bulk(REPLACK), Int(ack_val), Int(now_ms()),
                         Int(beacon),
-                        Int(node.replicas.cluster_coverage())])))
-                    meta.uuid_he_acked = meta.uuid_he_sent
+                        Int(coverage)])))
+                    meta.uuid_he_acked = ack_val
                     last_ack = now
                 await writer.drain()
                 await consumer.wait(timeout=self.app.heartbeat)
@@ -1202,6 +1241,15 @@ class ReplicaLink:
             None, lambda: write_snapshot_file(
                 path, nmeta, records, parts, chunk_keys=chunk_keys,
                 compress_level=level, container_level=container))
+        if node.oplog is not None and node.oplog.policy != "no":
+            # emit-only-durable (persist/oplog.py): every op whose
+            # effect is in the captured bucket exports was appended
+            # before the capture — group-commit AFTER the capture and
+            # BEFORE the stream, so a peer can never hold an op a torn
+            # tail could still lose (capture-THEN-commit: a commit
+            # taken earlier would not cover ops landing during its own
+            # fsync, which the state capture then picks up)
+            await node.oplog.ack_barrier()
         try:
             await self._stream_file(writer, path, encode_msg(Arr([
                 Bulk(DELTASYNC), Int(size), Int(repl_last),
@@ -1633,6 +1681,12 @@ class ReplicaLink:
         if repl_last > self.meta.uuid_he_sent:
             self.meta.uuid_he_sent = repl_last
         node.hlc.observe(repl_last)
+        if node.oplog is not None:
+            # bulk-delivered state is NOT in the durable op log: stop
+            # persisting watermark records (they would claim coverage
+            # the log cannot replay) and schedule a rewrite to re-base
+            # the log on a snapshot covering it (persist/oplog.py)
+            node.oplog.note_bulk_sync()
         log.info("loaded %s from %s: %d rows", what, self.meta.addr,
                  applied_rows)
         try:

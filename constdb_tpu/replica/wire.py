@@ -205,9 +205,12 @@ def _encode_builder(bb: BatchBuilder, origin: int, base: int) -> bytes:
     not cover MUST NOT ship)."""
     n = len(bb.keys)
     mt = bb.mt
-    uuids = np.fromiter(mt, dtype=_I64, count=n)
-    ct = np.fromiter(bb.ct, dtype=_I64, count=n)
-    dt = np.fromiter(bb.dt, dtype=_I64, count=n)
+    # np.array over the builder lists, not fromiter: same values, ~2x
+    # less fixed cost per column — this runs once per REPLBATCH run AND
+    # once per durable-op-log batch record (persist/oplog.py)
+    uuids = np.array(mt, dtype=_I64)
+    ct = np.array(bb.ct, dtype=_I64)
+    dt = np.array(bb.dt, dtype=_I64)
     del_mask = dt != 0
     if not np.array_equal(np.where(del_mask, 0, uuids), ct) or \
             not np.array_equal(np.where(del_mask, uuids, 0), dt):
@@ -272,18 +275,18 @@ def _encode_builder(bb: BatchBuilder, origin: int, base: int) -> bytes:
     body += len(e_ki).to_bytes(4, "little")
     body += len(t_ki).to_bytes(4, "little")
     _pack_blobs(body, bb.keys)
-    _pack_ints(body, np.fromiter(bb.enc, dtype=_I64, count=n))
+    _pack_ints(body, np.array(bb.enc, dtype=_I64))
     _pack_ints(body, del_mask.astype(_I64))
     _pack_ints(body, du)
     _pack_blobs(body, reg_val)
     for col in (c_ki, c_node, c_kind, c_pay):
-        _pack_ints(body, np.fromiter(col, dtype=_I64, count=len(c_ki)))
+        _pack_ints(body, np.array(col, dtype=_I64))
     for col in (e_ki, e_flags, e_cnt):
-        _pack_ints(body, np.fromiter(col, dtype=_I64, count=len(e_ki)))
+        _pack_ints(body, np.array(col, dtype=_I64))
     _pack_blobs(body, e_members)
     _pack_blobs(body, e_vals)
     for col in (t_ki, t_cnt):
-        _pack_ints(body, np.fromiter(col, dtype=_I64, count=len(t_ki)))
+        _pack_ints(body, np.array(col, dtype=_I64))
     _pack_blobs(body, t_cfg)
     _pack_blobs(body, t_pay)
     return MAGIC + zlib.crc32(body).to_bytes(4, "little") + bytes(body)

@@ -232,6 +232,36 @@ def _section_cpu(node, out):
         pass
 
 
+def _section_durability(node, out):
+    """Durable op log (persist/oplog.py): enablement, size, group-commit
+    health, compaction, and what the last boot recovery found."""
+    lg = getattr(node, "oplog", None)
+    out.append(("aof_enabled", int(lg is not None)))
+    x = node.stats.extra
+    if lg is None:
+        src = x.get("aof_recovery_source")
+        if src:  # recovered once, then disabled mid-run (tests)
+            out.append(("aof_recovery_source", src))
+        return
+    out.append(("aof_fsync_policy", lg.policy))
+    out.append(("aof_size_bytes", lg.size_bytes()))
+    out.append(("aof_base_size_bytes", lg.base_size))
+    out.append(("aof_generation", lg.generation))
+    out.append(("aof_segments", lg.n_segments))
+    out.append(("aof_appended_ops", lg.appended_ops))
+    out.append(("aof_spliced_batches", lg.spliced_batches))
+    out.append(("aof_encoded_batches", lg.encoded_batches))
+    out.append(("aof_fsyncs", lg.fsyncs))
+    out.append(("aof_last_fsync_lag_ms", lg.last_fsync_lag_ms))
+    out.append(("aof_rewrites", lg.rewrites))
+    out.append(("aof_rewrite_in_progress", int(lg._rewriting)))
+    out.append(("aof_tail_truncated", lg.tail_truncated))
+    out.append(("aof_pending_floor", lg.durable_floor() or 0))
+    out.append(("aof_recovery_source",
+                x.get("aof_recovery_source", "empty")))
+    out.append(("aof_recovered_ops", x.get("aof_recovered_ops", 0)))
+
+
 def _section_replication(node, out):
     peers = node.replicas.describe() if node.replicas else []
     live = [m for _, m in peers if m.alive]
@@ -326,6 +356,7 @@ SECTIONS = {
     "memory": _section_memory,
     "stats": _section_stats,
     "cpu": _section_cpu,
+    "durability": _section_durability,
     "replication": _section_replication,
     "keyspace": _section_keyspace,
 }

@@ -69,7 +69,12 @@ class ServerApp:
                  maxmemory: Optional[int] = None,
                  maxmemory_soft_pct: Optional[float] = None,
                  client_outbuf_max: Optional[int] = None,
-                 repl_window: Optional[int] = None):
+                 repl_window: Optional[int] = None,
+                 aof: Optional[bool] = None,
+                 aof_fsync: Optional[str] = None,
+                 aof_rewrite_pct: Optional[int] = None,
+                 aof_rewrite_min_mb: Optional[int] = None,
+                 aof_dir: str = ""):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -216,6 +221,22 @@ class ServerApp:
             if client_outbuf_max is None else client_outbuf_max
         self.repl_window = env_int("CONSTDB_REPL_WINDOW", 16 << 20) \
             if repl_window is None else repl_window
+        # durable op log (persist/oplog.py): every repl-log append
+        # mirrors into crc-framed segment files, group-committed under
+        # CONSTDB_AOF_FSYNC and compacted past CONSTDB_AOF_REWRITE_PCT.
+        # None = the env defaults; start_node runs the boot recovery
+        # (snapshot + oplog tail through the real merge path) and arms
+        # node.oplog before the listener opens.
+        from ..conf import env_flag as _aof_flag, env_str
+        self.aof = _aof_flag("CONSTDB_AOF", False) if aof is None else aof
+        self.aof_fsync = (env_str("CONSTDB_AOF_FSYNC", "everysec")
+                          or "everysec") if aof_fsync is None else aof_fsync
+        self.aof_rewrite_pct = env_int("CONSTDB_AOF_REWRITE_PCT", 100) \
+            if aof_rewrite_pct is None else aof_rewrite_pct
+        self.aof_rewrite_min_mb = \
+            env_int("CONSTDB_AOF_REWRITE_MIN_MB", 16) \
+            if aof_rewrite_min_mb is None else aof_rewrite_min_mb
+        self.aof_dir = aof_dir or os.path.join(work_dir, "aof")
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -318,6 +339,10 @@ class ServerApp:
                 await m.link.stop()
         if self.serve_plane is not None:
             await self.serve_plane.close()
+        if self.node.oplog is not None:
+            # final group commit + close (policy `no` drains without
+            # forcing an fsync — that is its contract)
+            self.node.oplog.close()
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -356,6 +381,11 @@ class ServerApp:
                 # pool growth move used_memory without any client write
                 # ever consulting the gate (server/overload.py)
                 self.node.governor.tick()
+                oplog = self.node.oplog
+                if oplog is not None:
+                    # everysec group commits, watermark records, and the
+                    # rewrite-compaction check (persist/oplog.py)
+                    await oplog.cron(self)
                 due = now - last_gc >= self.gc_interval
                 early = woke and now - last_gc >= self.gc_interval / 4
                 if due or early:
@@ -438,6 +468,7 @@ class ServerApp:
                             # replies for commands pipelined BEFORE the
                             # SYNC must reach the client before the
                             # handshake reply takes over the stream
+                            await self._aof_ack_barrier()
                             out = self._flush_out(writer, out)
                             self._upgrade_to_replica(msg, reader, writer,
                                                      parser)
@@ -457,6 +488,7 @@ class ServerApp:
                             if i:
                                 await self._run_chunk(plane, coal,
                                                       msgs[:i], out)
+                            await self._aof_ack_barrier()
                             out = self._flush_out(writer, out)
                             self._upgrade_to_replica(msg, reader, writer,
                                                      parser)
@@ -468,6 +500,11 @@ class ServerApp:
                 if upgraded:
                     return  # connection now owned by the replica link
                 if out:
+                    # fsync=always ack gate: replies reach the socket
+                    # only after the group commit covering this chunk's
+                    # appends lands — one fsync per pipelined chunk,
+                    # riding the coalescer's end-of-chunk flush barrier
+                    await self._aof_ack_barrier()
                     out = self._flush_out(writer, out)
                     if self._outbuf_overflow(writer):
                         return  # disconnected loudly; finally cleans up
@@ -500,6 +537,7 @@ class ServerApp:
                             reply = self.node.execute(msg)
                             if not isinstance(reply, NoReply):
                                 encode_into(out, reply)
+                await self._aof_ack_barrier()
                 if sync_at >= 0:
                     out = self._flush_out(writer, out)
                     self._upgrade_to_replica(syn, reader, writer, parser)
@@ -516,6 +554,13 @@ class ServerApp:
             # an upgraded connection is owned by its replica link now
             if not upgraded and not writer.is_closing():
                 writer.close()
+
+    async def _aof_ack_barrier(self) -> None:
+        """fsync=always group commit before replies flush (no-op for
+        every other policy, and when nothing is pending)."""
+        oplog = self.node.oplog
+        if oplog is not None and oplog.ack_barrier_needed:
+            await oplog.ack_barrier()
 
     async def _run_chunk(self, plane, coal, msgs: list,
                          out: bytearray) -> None:
@@ -670,6 +715,34 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
     """Convenience: build + start a ServerApp (optionally restoring the
     boot snapshot — a capability the reference lacks, SURVEY.md §5.4)."""
     app = ServerApp(node, **kwargs)
+    if app.aof:
+        # durable op log: boot recovery = chosen snapshot (the AOF base
+        # when one exists, the boot snapshot otherwise) + the oplog
+        # tail replayed through the REAL merge path, with torn-tail
+        # repair and the watermark consistency-cut rules
+        # (persist/oplog.py).  A corrupt snapshot quarantines and falls
+        # back to AOF-only replay — the log is quarantined too only
+        # when it is itself unreadable.
+        from ..persist import oplog as oplog_mod
+        if app.serve_shards > 1:
+            if not node.node_id:
+                nid = oplog_mod.prescan_node_id(app.aof_dir,
+                                                app.snapshot_path)
+                if nid:
+                    node.node_id = nid
+
+            async def _restore_aof_plane() -> None:
+                await oplog_mod.recover_into_plane(app)
+
+            app._boot_restore = _restore_aof_plane
+            await app.start()
+            return app
+        info = oplog_mod.recover(node, app.aof_dir,
+                                 boot_snapshot=app.snapshot_path,
+                                 engine=node.engine)
+        oplog_mod.arm(app, info)
+        await app.start()
+        return app
     if app.serve_shards > 1:
         # shard-per-core node: workers ARE the store, so the boot
         # snapshot fans out to them — which requires the plane up first

@@ -216,6 +216,11 @@ class Node:
         self.reset_epoch = 0
         # the ServerApp driving this node's IO, when one exists
         self.app = None
+        # durable op log (persist/oplog.py) when AOF is enabled — armed
+        # by server/io.py AFTER boot recovery; every repl-log append
+        # (replicate_cmd, the serve coalescer's push_many, the sharded
+        # ack mirror) and every replicated-intake land mirrors into it
+        self.oplog = None
         # the shard-per-core serving plane (server/serve_shards.py) when
         # CONSTDB_SERVE_SHARDS > 1; None = the exact single-loop path.
         # With a plane active this node's ks/engine hold NO data — every
@@ -248,8 +253,14 @@ class Node:
 
     def replicate_cmd(self, uuid: int, name: bytes, args: list) -> None:
         """Append to the repl_log and wake pushers (reference
-        src/server.rs:270-288)."""
+        src/server.rs:270-288).  The durable op log mirrors the append
+        BEFORE the pusher wake: under fsync=always the emission floor
+        holds the entry back until its group commit lands anyway, and
+        the mirror-first order is what makes the chaos journal's
+        obligation set equal the on-disk set (persist/oplog.py)."""
         self.repl_log.push(uuid, name, args)
+        if self.oplog is not None:
+            self.oplog.append_local(uuid, name, args)
         self.events.trigger(EVENT_REPLICATED, uuid)
 
     # ------------------------------------------------------------------- GC
@@ -398,6 +409,13 @@ class Node:
         # server/io.py start_node).
         self.repl_log.last_uuid = fence
         self.repl_log.evicted_up_to = fence
+        if self.oplog is not None:
+            # every logged record describes discarded state; the log is
+            # truncated and recovery is fenced so a crash before the
+            # post-resync rewrite lands boots empty + full-syncs instead
+            # of resurrecting pre-wipe keys (persist/oplog.py on_wipe —
+            # it also reinstalls the emission floor on the fresh ring)
+            self.oplog.on_wipe(fence)
         self._kick_peers_after_wipe(keep_link)
 
     def _kick_peers_after_wipe(self, keep_link=None) -> None:

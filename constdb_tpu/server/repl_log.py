@@ -49,6 +49,17 @@ class ReplLog:
         # (constdb_tpu/chaos/oracle.py); the ring's eviction makes the
         # log itself useless as a post-hoc record.  None = no observer.
         self.on_append = None
+        # emission floor: None, or a callable returning the smallest
+        # uuid the push stream may NOT emit yet (entries with
+        # uuid >= floor() are invisible to next_after/run_after — the
+        # MergedReplLog floor discipline, here for the plain ring).
+        # The durable op log installs its fsync horizon here
+        # (persist/oplog.py: emit-only-durable law), so a peer can
+        # never hold an op a torn tail could still lose.  `last_uuid`
+        # stays the true newest on purpose: the drained-beacon check
+        # (cursor >= last_uuid) must keep failing below the floor, or a
+        # REPLACK beacon would let peers skip the gated window.
+        self.floor = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -128,9 +139,17 @@ class ReplLog:
         return uuid >= self.evicted_up_to
 
     def next_after(self, uuid: int) -> Optional[ReplEntry]:
-        """The oldest entry with uuid > `uuid` (the next frame to push)."""
+        """The oldest VISIBLE entry with uuid > `uuid` (the next frame
+        to push; entries at/above the emission floor are invisible)."""
         i = bisect_right(self._uuids, uuid)
-        return self._entries[i] if i < len(self._entries) else None
+        if i >= len(self._entries):
+            return None
+        e = self._entries[i]
+        if self.floor is not None:
+            f = self.floor()
+            if f is not None and e.uuid >= f:
+                return None
+        return e
 
     def run_after(self, uuid: int, max_n: int,
                   max_bytes: Optional[int] = None) -> list:
@@ -159,6 +178,13 @@ class ReplLog:
         # BACK, and an uncapped islice would wrap onto them
         run = list(islice(entries, 0, min(max_n, n - i)))
         entries.rotate(i)
+        if self.floor is not None:
+            f = self.floor()
+            if f is not None:
+                for k, e in enumerate(run):
+                    if e.uuid >= f:
+                        del run[k:]
+                        break
         if max_bytes is not None:
             total = 0
             for k, e in enumerate(run):

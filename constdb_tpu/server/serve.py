@@ -529,8 +529,16 @@ class ServeCoalescer:
             # construction (demotion happens at plan time) — encoding is
             # pure list comprehension and cannot reject
             SERVE_ENCODERS[name](bb, recs, nodeid)
+        prev_uuid = node.repl_log.last_uuid  # the run's chain base
         node.merge_serve_batch(bb, n)
         node.repl_log.push_many(log)
+        if node.oplog is not None:
+            # mirror the run as ONE columnar batch record whose payload
+            # is the exact REPLBATCH wire encoding — serialized straight
+            # from this flush's builder, no re-encode — and publish the
+            # finished frame into the encode-once cache so the peer
+            # fan-out splices these very bytes (persist/oplog.py)
+            node.oplog.append_local_run(log, prev_uuid, builder=bb)
         node.events.trigger(EVENT_REPLICATED, log[-1][0])
         lat = self._lat_pending
         if lat:

@@ -283,6 +283,12 @@ class ServeShardPlane:
         node = self.node
         if entries:
             self.merged.segments[shard].push_many(entries)
+            if node.oplog is not None:
+                # the shard's durable segment mirrors in the same ack
+                # order as its repl-log segment (persist/oplog.py:
+                # per-shard segment files, merged by HLC at replay)
+                for uuid, name, args in entries:
+                    node.oplog.append_local(uuid, name, args, seg=shard)
         if token is not None:
             self._inflight.pop(token, None)
         if entries:
@@ -429,6 +435,11 @@ class ServeShardPlane:
         self.merged = merged
         node.repl_log = merged
         self._inflight.clear()
+        if node.oplog is not None:
+            # same rule as Node.reset_for_full_resync: the log describes
+            # discarded state — truncate + fence + reinstall the floor
+            # on the fresh merged log (persist/oplog.py on_wipe)
+            node.oplog.on_wipe(fence)
         node._kick_peers_after_wipe(keep_link)
 
 
@@ -498,6 +509,10 @@ class ShardApplier:
             node = self.node
             node.stats.repl_apply_barriers += 1
             node.apply_replicated(name, items[5:], as_int(items[1]), uuid)
+            if node.oplog is not None:
+                node.oplog.append_frame(as_int(items[1]), uuid, name,
+                                        list(items[5:]),
+                                        seg=self.plane.n_shards)
             self.cursor = uuid
             if not self._frames:
                 self._advance(uuid)
@@ -506,6 +521,9 @@ class ShardApplier:
         if not self._frames:
             self._first_ts = self._now()
         encode_into(self._bufs[shard], Arr(items))
+        if self.node.oplog is not None:
+            self.node.oplog.append_frame(as_int(items[1]), uuid, name,
+                                         list(items[5:]), seg=shard)
         self._counts[shard] += 1
         f = self._frames + 1
         self._frames = f
@@ -565,6 +583,9 @@ class ShardApplier:
             entries, deleted, stats = await f
             if entries:  # leftover tap from an earlier worker error
                 self.plane.merged.segments[s].push_many(entries)
+                if node.oplog is not None:
+                    for uuid, name, args in entries:
+                        node.oplog.append_local(uuid, name, args, seg=s)
             if deleted:
                 node.events.trigger(EVENT_DELETED)
             self.plane._fold_stats(s, stats)

@@ -214,12 +214,130 @@ JAX_PLATFORMS=cpu timeout -k 10 300 python -m constdb_tpu.chaos \
     --resource --seed 7 || exit $?
 
 echo
+echo "== durability smoke (AOF kill -9 + bench --mode serve --aof) =="
+# a REAL server process with the durable op log under fsync=always:
+# firehose it over a socket, kill -9 mid-stream, restart from the
+# node's own log, and oracle-compare — every acknowledged write must
+# be present (or superseded by a LATER write of the same key that also
+# survived), the recovery gauges must report the replay, and a second
+# clean restart must be idempotent.  Then the tiny bench legs verify
+# off/everysec/always exports match and the recovery replay
+# round-trips (tests/test_oplog.py runs the differential suites in
+# tier-1; the chaos kill9/torn cells run in the chaos smoke below).
+JAX_PLATFORMS=cpu timeout -k 10 300 python - <<'EOF' || exit $?
+import asyncio, os, signal, socket, subprocess, sys, tempfile, time
+
+async def main():
+    with tempfile.TemporaryDirectory(prefix="constdb-dur-") as work:
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]; s.close()
+        args = [sys.executable, "-m", "constdb_tpu.bin.server",
+                "--port", str(port), "--work-dir", work,
+                "--aof", "--aof-fsync", "always", "--node-id", "1"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(args, env=env)
+        from constdb_tpu.chaos.cluster import Client
+        c = Client()
+        for _ in range(100):
+            try:
+                await c.connect(f"127.0.0.1:{port}"); break
+            except OSError:
+                await asyncio.sleep(0.1)
+        else:
+            raise SystemExit("server never came up")
+        # firehose: sequential acked writes (the client-side journal),
+        # then a pipelined burst we kill the server in the middle of
+        acked = {}
+        for i in range(400):
+            k = f"k{i % 16}"
+            r = await c.cmd("set", k, f"v{i:06d}")
+            acked[k] = i
+        from constdb_tpu.resp.codec import encode_msg
+        from constdb_tpu.resp.message import Arr, Bulk
+        buf = bytearray()
+        for i in range(400, 2400):
+            buf += encode_msg(Arr([Bulk(b"set"), Bulk(b"k%d" % (i % 16)),
+                                   Bulk(b"v%06d" % i)]))
+        c.writer.write(bytes(buf))
+        await c.writer.drain()
+        # count replies until the kill lands mid-stream (the short
+        # sleep lets the server get INTO the burst first, so the kill
+        # really is mid-write, not before it)
+        got = 0
+        t0 = time.monotonic()
+        await asyncio.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)
+        try:
+            while got < 2000 and time.monotonic() - t0 < 5:
+                data = await asyncio.wait_for(c.reader.read(1 << 16), 2.0)
+                if not data:
+                    break
+                c.parser.feed(data)
+                while c.parser.next_msg() is not None:
+                    acked[f"k{(400 + got) % 16}"] = 400 + got
+                    got += 1
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        proc.wait(timeout=10)
+        print(f"[smoke] killed -9 mid-firehose after {400 + got} acked "
+              f"writes")
+        # cold restart: recovery from the node's own log
+        proc = subprocess.Popen(args, env=env)
+        c2 = Client()
+        for _ in range(150):
+            try:
+                await c2.connect(f"127.0.0.1:{port}"); break
+            except OSError:
+                await asyncio.sleep(0.1)
+        else:
+            raise SystemExit("server never came back after kill -9")
+        lost = []
+        for k, serial in acked.items():
+            r = await c2.cmd("get", k)
+            v = r.val.decode() if hasattr(r, "val") and r.val else ""
+            if not v.startswith("v") or int(v[1:]) < serial:
+                lost.append((k, serial, v))
+        assert not lost, f"acked writes lost after kill -9: {lost[:5]}"
+        info = (await c2.cmd("info", "durability")).val.decode()
+        assert "aof_enabled:1" in info
+        assert "aof_recovery_source:log-only" in info, info
+        ops = int(next(l for l in info.splitlines()
+                       if l.startswith("aof_recovered_ops:"))
+                  .split(":")[1])
+        assert ops >= 400 + got, (ops, 400 + got)
+        await c2.close()
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.wait(timeout=15)
+        print(f"[smoke] durability smoke verified: {ops} ops replayed, "
+              f"zero acked writes lost")
+
+asyncio.run(main())
+EOF
+JAX_PLATFORMS=cpu CONSTDB_BENCH_AOF_OPS=6000 CONSTDB_BENCH_SERVE_CONNS=2 \
+CONSTDB_BENCH_AOF_REPS=1 \
+    timeout -k 10 300 python bench.py --mode serve --aof \
+    > /tmp/_ci_aof.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_aof.json"))
+assert out["verified"], "aof bench legs failed oracle verification"
+assert out["recovery_verified"], "aof recovery replay mismatched"
+assert out["recovery_ops"] > 0
+print("aof bench smoke verified:",
+      [(leg["aof"], leg["rps"]) for leg in out["legs"]],
+      f"recovery {out['recovery_s_per_gb']} s/GB")
+EOF
+
+echo
 echo "== chaos smoke (fixed-seed certification cells) =="
 # the scripted chaos scenario — partitions + reorder + duplication +
 # mid-frame truncation + connection/process kills + clock jitter + one
 # mixed-version peer — on one representative capability cell per fast
 # path (everything-on, everything-off, resident engine, sharded
-# serving), with the full invariant oracle verified: convergence to the
+# serving, and the AOF always/everysec durability cells, whose
+# schedules add kill9_mid_write + torn_write cold restarts recovering
+# from the node's own op log), with the full invariant oracle verified:
+# convergence to the
 # CPU-engine reference, digest agreement, watermark monotonicity,
 # no-resurrection, GC drain, and loud demotion accounting.  Fixed seed:
 # a failure here replays exactly (the full matrix + randomized soak are

@@ -50,6 +50,29 @@ def test_certify_legacy_cell(tmp_path):
     run_scenario(certify_scenario(7, Cell(wire=False, delta=False)))
 
 
+def test_certify_aof_cell(tmp_path):
+    """Durability cell (round 18): the full acceptance schedule PLUS
+    kill9_mid_write and torn_write — cold restarts that recover from
+    the node's OWN op log under fsync=always, with the oracle
+    asserting every fsync-acknowledged write survived and the mesh
+    re-converged byte-identically to the journal reference (the
+    never-durable suffix is pruned under the emit-only-durable law)."""
+    stats = run_scenario(certify_scenario(7, Cell(aof="always")))
+    # the durability steps really ran: both crash styles recover from
+    # the log (restart_cold takes no harness-side dump on AOF specs)
+    assert stats["journal_ops"] > 0
+
+
+@pytest.mark.slow  # ~5s: the 1s group-commit cadence paces every
+#                    crash/restart window (the cell also runs in the
+#                    ci.sh chaos smoke and the full matrix)
+def test_certify_aof_everysec_cell(tmp_path):
+    """The weaker everysec contract under the same schedule: durable-
+    prefix recovery, zero divergence, watermarks never claim coverage
+    beyond the fsync cut."""
+    run_scenario(certify_scenario(11, Cell(aof="everysec")))
+
+
 def test_certify_replays_from_seed(tmp_path):
     """Determinism pin: the same seed replays the same decision stream —
     identical journaled op set and identical converged state."""
@@ -122,16 +145,25 @@ def test_chaos_soak_randomized(tmp_path):
         run_scenario(soak_scenario(seed))
 
 
-def test_cold_restart_does_not_resurrect_collected_tombstones(tmp_path):
+@pytest.mark.parametrize("aof", [None, "always"],
+                         ids=["snapshot", "aof"])
+def test_cold_restart_does_not_resurrect_collected_tombstones(tmp_path,
+                                                              aof):
     """Regression (round-5 chaos find): a cold-restarted node must
     resume pulling each peer from its SNAPSHOT-RECORDED watermark.
     With the watermark lost (resume 0), peers replay their whole
     repl_log ring — including ADDS whose tombstones the mesh already
     GC-collected — and the deleted member resurrects with no surviving
-    delete op anywhere."""
+    delete op anywhere.
+
+    The `aof` variant runs the SAME regression on the durable-op-log
+    cold restart (no harness-side dump — recovery comes from the
+    node's own log, whose WMARK records carry the watermarks under the
+    persisted consistency-cut law)."""
     async def main():
         cluster = ChaosCluster(str(tmp_path), seed=1,
-                               specs=[NodeSpec(), NodeSpec()])
+                               specs=[NodeSpec(aof=aof),
+                                      NodeSpec(aof=aof)])
         await cluster.start()
         try:
             a, b = cluster.apps

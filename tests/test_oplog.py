@@ -1,0 +1,699 @@
+"""Durable op log (persist/oplog.py): framing fuzz, replay
+differentials, watermark consistency cuts, rewrite compaction, the
+boot-quarantine fallback, and the INFO Durability section.
+
+The load-bearing suites:
+
+  * the torn-tail fuzz sweep — truncate the log at EVERY byte offset
+    and flip EVERY bit across record boundaries; recovery must always
+    land on a valid record prefix, never crash-loop, never replay a
+    corrupt record (the compressio every-bit-flip discipline, applied
+    to the AOF framing);
+  * the replay differential — a recovered node's canonical export AND
+    full-state digest equal a never-crashed reference node fed the
+    same stream (boot replay routes through the real merge path);
+  * the persisted consistency-cut regression — recovered watermarks
+    never claim pull coverage beyond the fsync cut (no adopt-then-skip
+    resurrection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from constdb_tpu.chaos.cluster import FAST, Client
+from constdb_tpu.persist import oplog as OL
+from constdb_tpu.persist.oplog import (MAGIC, OpLog, RecoveryInfo,
+                                       scan_segment)
+from constdb_tpu.resp.codec import encode_msg
+from constdb_tpu.resp.message import Arr, Bulk
+from constdb_tpu.server.io import start_node
+from constdb_tpu.server.node import Node
+from constdb_tpu.store.digest import full_state_digest
+
+
+# ---------------------------------------------------------------- helpers
+
+
+async def _pipelined(addr: str, cmds: list) -> list:
+    """One pipelined chunk; returns the replies in order."""
+    c = await Client().connect(addr)
+    try:
+        buf = bytearray()
+        for parts in cmds:
+            buf += encode_msg(Arr([Bulk(p) for p in parts]))
+        c.writer.write(bytes(buf))
+        await c.writer.drain()
+        out = []
+        while len(out) < len(cmds):
+            msg = c.parser.next_msg()
+            if msg is not None:
+                out.append(msg)
+                continue
+            data = await asyncio.wait_for(c.reader.read(1 << 16), 10.0)
+            assert data, "EOF mid-pipeline"
+            c.parser.feed(data)
+        return out
+    finally:
+        await c.close()
+
+
+def _workload_cmds(n: int = 120) -> list:
+    cmds = []
+    for i in range(n):
+        k = i % 7
+        if k < 3:
+            cmds.append([b"set", b"reg%d" % (i % 9), b"v%d" % i])
+        elif k < 5:
+            cmds.append([b"incr", b"cnt%d" % (i % 4), b"%d" % (1 + i % 3)])
+        elif k == 5:
+            cmds.append([b"sadd", b"s%d" % (i % 3), b"m%d" % (i % 11)])
+        else:
+            cmds.append([b"hset", b"h%d" % (i % 2), b"f%d" % (i % 5),
+                         b"w%d" % i])
+    # a few deletes and removes so tombstones replay too
+    cmds += [[b"del", b"reg0"], [b"srem", b"s0", b"m0"],
+             [b"set", b"reg0", b"back"]]
+    return cmds
+
+
+async def _start(tmp, name, policy="always", **kw):
+    node = Node(node_id=kw.pop("node_id", 1), alias=name,
+                repl_log_cap=kw.pop("repl_log_cap", 1_024_000))
+    return await start_node(node, host="127.0.0.1", port=0,
+                            work_dir=os.path.join(str(tmp), name),
+                            aof=True, aof_fsync=policy,
+                            aof_dir=os.path.join(str(tmp), name, "aof"),
+                            **FAST, **kw)
+
+
+async def _drain_gc(app) -> None:
+    """Collect every pending tombstone so canonical exports compare
+    GC-invariantly (replicas legally collect at different times — the
+    same fixpoint rule certify_state applies)."""
+    node = app.node
+    for _ in range(64):
+        if node.serve_plane is not None:
+            await node.serve_plane.gc(node.gc_horizon())
+            await asyncio.sleep(0)
+        else:
+            node.gc()
+            if not node.ks.garbage:
+                return
+            await asyncio.sleep(0)
+
+
+async def _canon(app):
+    await _drain_gc(app)
+    if app.node.serve_plane is not None:
+        return await app.serve_plane.canonical()
+    return app.node.canonical()
+
+
+# ------------------------------------------------------- replay differential
+
+
+def test_replay_differential_and_digest(tmp_path):
+    """A recovered node == a never-crashed reference fed the same
+    stream: canonical export AND full-state digest, byte-identical.
+    Also pins the recovery gauges and the Durability INFO section."""
+    async def main():
+        app = await _start(tmp_path, "a")
+        cmds = _workload_cmds()
+        await _pipelined(app.advertised_addr, cmds)
+        canon = await _canon(app)
+        dig = full_state_digest(app.node.ks)
+        await app.close()
+
+        # reference node: same stream, never crashed
+        ref = Node(node_id=1, alias="ref")
+        rapp = await start_node(ref, host="127.0.0.1", port=0,
+                                work_dir=str(tmp_path / "ref"), **FAST)
+        await _pipelined(rapp.advertised_addr, cmds)
+
+        app2 = await _start(tmp_path, "a")
+        try:
+            assert app2.node.stats.extra["aof_recovery_source"] == \
+                "log-only"
+            assert app2.node.stats.extra["aof_recovered_ops"] == len(cmds)
+            assert (await _canon(app2)) == canon
+            assert full_state_digest(app2.node.ks) == dig
+            # LWW winners equal the reference's (timestamps differ per
+            # node run, so compare VALUES, not stamps)
+            rcanon = ref.canonical()
+            assert set(rcanon) == set(canon)
+            # INFO section present and sane
+            c = await Client().connect(app2.advertised_addr)
+            info = (await c.cmd("info", "durability")).val.decode()
+            await c.close()
+            assert "aof_enabled:1" in info
+            assert "aof_recovery_source:log-only" in info
+            assert "aof_tail_truncated:0" in info
+        finally:
+            await app2.close()
+            await rapp.close()
+    asyncio.run(main())
+
+
+def test_replayed_node_reconverges_with_reference_peer(tmp_path):
+    """End-to-end: crash + AOF recovery, then the recovered node joins
+    a never-crashed peer and both land on the same canonical."""
+    async def main():
+        a = await _start(tmp_path, "a", node_id=1)
+        b = await _start(tmp_path, "b", node_id=2, policy="everysec")
+        c = await Client().connect(a.advertised_addr)
+        await c.cmd("meet", b.advertised_addr)
+        await c.close()
+        await _pipelined(a.advertised_addr, _workload_cmds(80))
+        await _pipelined(b.advertised_addr, _workload_cmds(40))
+        deadline = asyncio.get_running_loop().time() + 20
+        while a.node.canonical() != b.node.canonical():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        canon = a.node.canonical()
+        await a.close()
+        a2 = await _start(tmp_path, "a", node_id=1)
+        try:
+            deadline = asyncio.get_running_loop().time() + 20
+            while a2.node.canonical() != canon or \
+                    b.node.canonical() != canon:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            # the recovered node's log replayed BOTH its own serve runs
+            # (batch records) and b's spliced intake
+            assert a2.node.stats.extra["aof_recovered_ops"] > 0
+        finally:
+            await a2.close()
+            await b.close()
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ torn-tail fuzz
+
+
+def _build_small_log(tmp_path) -> tuple:
+    """A small single-segment log with mixed record types; returns
+    (segment path, records, canonical, digest) of a full replay."""
+    async def main():
+        app = await _start(tmp_path, "fz")
+        await _pipelined(app.advertised_addr, _workload_cmds(24))
+        lg = app.node.oplog
+        path = lg.seg_path(lg.dir, lg.generation, 0)
+        canon = app.node.canonical()
+        await app.close()
+        records, valid, total = scan_segment(path)
+        assert valid == total
+        return path, records, canon
+    return asyncio.run(main())
+
+
+def _recover_fresh(aof_dir: str):
+    node = Node(node_id=1, alias="fz")
+    info = OL.recover(node, aof_dir)
+    return node, info
+
+
+def test_torn_tail_fuzz_every_offset(tmp_path):
+    """Truncate the log at EVERY byte offset: recovery always lands on
+    a valid record prefix (never crashes, never replays a corrupt
+    record), and the prefix grows monotonically with the offset."""
+    path, records, _canon = _build_small_log(tmp_path)
+    data = open(path, "rb").read()
+    aof_dir = os.path.dirname(path)
+    prev_ops = 0
+    last_full = -1
+    for cut in range(len(MAGIC), len(data) + 1):
+        open(path, "wb").write(data[:cut])
+        node, info = _recover_fresh(aof_dir)
+        got = info.frames + info.batch_frames
+        if cut == len(data):
+            assert info.tail_truncated == 0 and got >= prev_ops
+        else:
+            assert info.tail_truncated in (0, 1)
+        assert got >= last_full  # prefixes only ever grow
+        last_full = max(last_full, got)
+        prev_ops = got
+    # restore the intact file for the bit-flip sweep
+    open(path, "wb").write(data)
+
+
+def test_torn_tail_truncation_boundaries(tmp_path):
+    """The tier-1 compact twin of the full sweep: every truncation
+    offset across the LAST THREE record boundaries, plus the header
+    edge cases."""
+    path, records, canon = _build_small_log(tmp_path)
+    data = open(path, "rb").read()
+    aof_dir = os.path.dirname(path)
+    # find the byte offsets of the last three record starts
+    starts = []
+    pos = len(MAGIC)
+    while pos + 8 <= len(data):
+        ln = int.from_bytes(data[pos:pos + 4], "little")
+        starts.append(pos)
+        pos += 8 + ln
+    assert pos == len(data)
+    full_ops = None
+    boundaries = set(starts) | {len(data)}
+    for cut in range(starts[-3], len(data) + 1):
+        open(path, "wb").write(data[:cut])
+        node, info = _recover_fresh(aof_dir)
+        got = info.frames + info.batch_frames
+        if cut == len(data):
+            assert info.tail_truncated == 0
+            full_ops = got
+        elif cut in boundaries:
+            # an exact record boundary is a VALID prefix — nothing torn
+            assert info.tail_truncated == 0
+        else:
+            # a partial tail truncates loudly and the file shrinks to
+            # the valid prefix ON DISK (the next boot is clean)
+            assert info.tail_truncated == 1
+            assert os.path.getsize(path) <= cut
+            node2, info2 = _recover_fresh(aof_dir)
+            assert info2.tail_truncated == 0
+            assert info2.frames + info2.batch_frames == got
+    assert full_ops is not None
+    # a clipped HEADER is unreadable (not torn): quarantined, loudly
+    open(path, "wb").write(data[:4])
+    node, info = _recover_fresh(aof_dir)
+    assert info.quarantined == 1
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+
+
+@pytest.mark.slow  # ~6s: every (offset, bit) pair spins a recovery;
+#                    the boundary-targeted compact twin stays tier-1
+def test_bit_flip_sweep_never_replays_garbage(tmp_path):
+    """Flip every bit of the log body: recovery must stop at (or
+    before) the flipped record — never crash, never apply a record
+    whose bytes changed."""
+    path, records, _canon = _build_small_log(tmp_path)
+    data = bytearray(open(path, "rb").read())
+    aof_dir = os.path.dirname(path)
+    intact = bytes(data)
+    for off in range(len(MAGIC), len(data)):
+        for bit in range(8):
+            data[off] ^= 1 << bit
+            open(path, "wb").write(data)
+            node, info = _recover_fresh(aof_dir)
+            assert info.frames + info.batch_frames <= len(records) * 600
+            data[off] ^= 1 << bit
+    open(path, "wb").write(intact)
+
+
+def test_bit_flip_boundaries_compact(tmp_path):
+    """Tier-1 twin: flip one bit in each region of the LAST record
+    (length field, crc field, type byte, payload) — recovery lands on
+    the prefix BEFORE it each time, and the flipped record's ops are
+    never applied."""
+    path, records, canon = _build_small_log(tmp_path)
+    data = bytearray(open(path, "rb").read())
+    aof_dir = os.path.dirname(path)
+    from constdb_tpu.persist.oplog import REC_WMARK
+    starts = []
+    pos = len(MAGIC)
+    while pos + 8 <= len(data):
+        starts.append((pos, data[pos + 8]))
+        pos += 8 + int.from_bytes(data[pos:pos + 4], "little")
+    # the last OP-carrying record (a trailing WMARK flip changes no
+    # replayed-op count; its own decode-failure path is separate)
+    last, end = None, len(data)
+    for p0, rtype in reversed(starts):
+        if rtype != REC_WMARK:
+            last = p0
+            break
+        end = p0
+    assert last is not None
+    node_full, info_full = _recover_fresh(aof_dir)
+    full_ops = info_full.frames + info_full.batch_frames
+    for off in (last, last + 4, last + 8, last + 9,
+                (last + 8 + end) // 2, end - 1):
+        data[off] ^= 0x10
+        open(path, "wb").write(data)
+        node, info = _recover_fresh(aof_dir)
+        got = info.frames + info.batch_frames
+        assert got < full_ops, f"flipped record replayed (off {off})"
+        data[off] ^= 0x10
+    open(path, "wb").write(data)
+
+
+# ----------------------------------------------------- watermark cut law
+
+
+def test_recovered_watermarks_never_claim_beyond_cut(tmp_path):
+    """The adopt-then-skip regression pin: watermark records appended
+    to the log are durable-capped AND positioned after the frames they
+    cover, so however the tail tears, the recovered uuid_he_sent never
+    exceeds the newest intake frame of that origin actually replayed.
+    (A higher claim would make the peer skip redelivery of frames the
+    recovered state lacks — silent divergence forever.)"""
+    async def main():
+        a = await _start(tmp_path, "a", node_id=1)
+        b = await _start(tmp_path, "b", node_id=2)
+        ca = await Client().connect(a.advertised_addr)
+        await ca.cmd("meet", b.advertised_addr)
+        await ca.close()
+        # writes on b stream into a; wait until a landed them
+        await _pipelined(b.advertised_addr, _workload_cmds(60))
+        deadline = asyncio.get_running_loop().time() + 20
+        while a.node.canonical() != b.node.canonical():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        # force a WMARK record + group commit, then MORE intake that
+        # stays unsynced in a's log
+        await a.node.oplog.cron(a)
+        lg = a.node.oplog
+        path = lg.seg_path(lg.dir, lg.generation, 0)
+        synced = lg.synced_sizes[0]
+        await _pipelined(b.advertised_addr,
+                         [[b"set", b"late%d" % i, b"x"]
+                          for i in range(40)])
+        deadline = asyncio.get_running_loop().time() + 20
+        while a.node.canonical() != b.node.canonical():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        live_wm = a.node.replicas.get(b.advertised_addr).uuid_he_sent
+        # kill -9 with a torn tail: clip a's log inside the unsynced
+        # suffix (never below the last group commit)
+        lg._drain_all()
+        size = os.path.getsize(path)
+        lg._closed = True
+        await a.close()
+        if size > synced:
+            with open(path, "r+b") as f:
+                f.truncate(synced + (size - synced) // 2)
+        a2 = await _start(tmp_path, "a", node_id=1)
+        try:
+            m = a2.node.replicas.get(b.advertised_addr)
+            assert m is not None
+            # the recovered claim never exceeds what the log replayed
+            # of b's stream — and never exceeds the live pre-crash one
+            assert m.uuid_he_sent <= live_wm
+            assert m.uuid_he_sent <= a2.node.hlc.current
+            # and b redelivers the clipped window: both converge again
+            deadline = asyncio.get_running_loop().time() + 20
+            while a2.node.canonical() != b.node.canonical():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+        finally:
+            await a2.close()
+            await b.close()
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------- fsync gating
+
+
+def test_always_policy_gates_emission_and_acks(tmp_path):
+    """Emit-only-durable: with appends pending (no fsync yet), the
+    repl log's floor hides them from run_after and cap_ack withholds
+    the intake watermark; a group commit releases both."""
+    async def main():
+        app = await _start(tmp_path, "a")
+        node = app.node
+        lg = node.oplog
+        # append a local op WITHOUT the ack barrier (replicate_cmd path)
+        uuid = node.hlc.tick(True)
+        node.ks.touch()
+        node.replicate_cmd(uuid, b"set", [Bulk(b"k"), Bulk(b"v")])
+        assert lg.durable_floor() == uuid
+        assert node.repl_log.run_after(0, 16) == []
+        assert node.repl_log.next_after(0) is None
+        # intake cap: a pending intake record withholds the ack
+        lg.append_frame(99, uuid + 5, b"set", [Bulk(b"x"), Bulk(b"y")])
+        assert lg.cap_ack(99, uuid + 10) == uuid + 4
+        assert lg.cap_coverage(uuid + 10) == uuid + 4
+        await lg.ack_barrier()
+        assert lg.durable_floor() is None
+        assert len(node.repl_log.run_after(0, 16)) == 1
+        assert lg.cap_ack(99, uuid + 10) == uuid + 10
+        await app.close()
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------- compaction
+
+
+def test_cap_ack_cached_min_tracks_deque(tmp_path):
+    """cap_ack/cap_coverage are O(1) per ack-loop wake via a cached
+    per-origin minimum; the cache must agree with a full deque scan
+    through out-of-order appends (reconnect redeliveries append BELOW
+    the current min) and partial settles."""
+    lg = OpLog(str(tmp_path / "aof"), fsync_policy="always")
+
+    def scan_min(origin):
+        d = lg._intake_pend.get(origin)
+        return min(u for _s, u in d) if d else None
+
+    lg._track_intake(7, 100)
+    lg._track_intake(7, 104)
+    lg._track_intake(7, 96)   # the redelivery-below-min shape
+    lg._track_intake(9, 50)
+    assert lg.cap_ack(7, 1000) == scan_min(7) - 1 == 95
+    assert lg.cap_ack(9, 1000) == 49
+    assert lg.cap_ack(5, 1000) == 1000          # no pending intake
+    assert lg.cap_coverage(1000) == 49
+    # settle the first two of origin 7 and all of origin 9: the cached
+    # min must be REcomputed (96 released-order-wise sits behind 104)
+    marks, _files, oldest = lg._capture()
+    upto_partial = lg._intake_pend[7][1][0]     # seq of uuid 104
+    lg._settle((upto_partial, marks[1], marks[2]), oldest)
+    assert lg.cap_ack(7, 1000) == scan_min(7) - 1 == 95
+    assert lg.cap_ack(9, 1000) == 49            # seq after the cut: kept
+    # full settle clears both dicts in lockstep
+    marks, _files, oldest = lg._capture()
+    lg._settle(marks, oldest)
+    assert not lg._intake_pend and not lg._intake_min
+    assert lg.cap_ack(7, 1000) == 1000
+    assert lg.cap_coverage(1000) == 1000
+    lg.close()
+
+
+def test_rewrite_compaction_roundtrip(tmp_path):
+    """The rewrite cuts a base snapshot + fresh generation atomically;
+    recovery from base+tail is byte-identical, old generations are
+    gone, and the INFO gauge counts it."""
+    async def main():
+        app = await _start(tmp_path, "a")
+        await _pipelined(app.advertised_addr, _workload_cmds(100))
+        lg = app.node.oplog
+        size_before = lg.size_bytes()
+        assert size_before > 100
+        lg.rewrite_min_bytes = 1
+        lg.base_size = 1
+        assert lg.rewrite_due()
+        gen0 = lg.generation
+        await lg.rewrite(app)
+        assert lg.rewrites == 1
+        assert lg.generation == gen0 + 1
+        # regression: the rewrite must NOT double-register the buffer
+        # gauge with the governor (arm()'s permanent source already
+        # includes the rewrite working set) — a second equal entry
+        # double-counted every oplog byte in used_memory during
+        # compaction and could spuriously shed near maxmemory_soft
+        assert app.node.governor.sources.count(lg.used_buffer_bytes) == 1
+        assert lg.size_bytes() < size_before
+        assert os.path.exists(
+            lg.base_snapshot_path(lg.dir, lg.generation))
+        assert not os.path.exists(lg.seg_path(lg.dir, gen0, 0))
+        # post-rewrite writes land in the new generation and replay
+        await _pipelined(app.advertised_addr,
+                         [[b"set", b"post", b"rewrite"]])
+        canon = app.node.canonical()
+        await app.close()
+        app2 = await _start(tmp_path, "a")
+        try:
+            assert app2.node.stats.extra["aof_recovery_source"] == \
+                "aof-base-snapshot+log"
+            assert app2.node.canonical() == canon
+        finally:
+            await app2.close()
+    asyncio.run(main())
+
+
+def test_bulk_sync_schedules_rewrite(tmp_path):
+    """Out-of-log state (a received full sync) suppresses watermark
+    records and re-bases the log via an immediate rewrite, after which
+    a crash recovers the bulk-delivered state from the new base."""
+    async def main():
+        a = await _start(tmp_path, "a", node_id=1)
+        # b holds pre-existing state a must receive OUT of the stream:
+        # the tiny ring cap evicts b's ops, so a's resume-from-0 takes
+        # the full/delta sync path (the out-of-log delivery class)
+        b = await _start(tmp_path, "b", node_id=2, repl_log_cap=512)
+        await _pipelined(b.advertised_addr, _workload_cmds(60))
+        ca = await Client().connect(a.advertised_addr)
+        await ca.cmd("meet", b.advertised_addr)
+        await ca.close()
+        deadline = asyncio.get_running_loop().time() + 20
+        while a.node.canonical() != b.node.canonical():
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        lg = a.node.oplog
+        # the full sync marked the log dirty; drive the cron rewrite
+        deadline = asyncio.get_running_loop().time() + 20
+        while lg.rewrites == 0:
+            assert asyncio.get_running_loop().time() < deadline, \
+                "bulk sync never triggered the re-basing rewrite"
+            await asyncio.sleep(0.1)
+        canon = a.node.canonical()
+        await a.close()
+        await b.close()
+        a2 = await _start(tmp_path, "a", node_id=1)
+        try:
+            assert a2.node.canonical() == canon
+        finally:
+            await a2.close()
+    asyncio.run(main())
+
+
+# ------------------------------------------------- quarantine fallback
+
+
+def test_corrupt_boot_snapshot_falls_back_to_aof(tmp_path):
+    """The boot-quarantine satellite: a corrupt snapshot quarantines
+    and recovery falls back to AOF-only replay (pre-AOF behavior was
+    booting EMPTY); the oplog itself is quarantined only when its
+    header is unreadable."""
+    async def main():
+        # build a node with BOTH a boot snapshot and an oplog
+        work = str(tmp_path / "a")
+        snap = os.path.join(work, "boot.snapshot")
+        node = Node(node_id=1, alias="a")
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               work_dir=work, snapshot_path=snap,
+                               aof=True, aof_fsync="always",
+                               aof_dir=os.path.join(work, "aof"), **FAST)
+        await _pipelined(app.advertised_addr, _workload_cmds(50))
+        canon = await _canon(app)
+        from constdb_tpu.persist.snapshot import NodeMeta, dump_keyspace
+        node.ensure_flushed()
+        dump_keyspace(snap, node.ks,
+                      NodeMeta(node_id=1, repl_last_uuid=0))
+        await app.close()
+        # corrupt the snapshot: flip a byte mid-file
+        data = bytearray(open(snap, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(snap, "wb").write(data)
+        node2 = Node(node_id=1, alias="a")
+        app2 = await start_node(node2, host="127.0.0.1", port=0,
+                                work_dir=work, snapshot_path=snap,
+                                aof=True, aof_fsync="always",
+                                aof_dir=os.path.join(work, "aof"),
+                                **FAST)
+        try:
+            x = node2.stats.extra
+            assert "boot_snapshot_quarantined" in x
+            assert x["aof_recovery_source"] == "log-only"
+            assert (await _canon(app2)) == canon
+            assert os.path.exists(snap + ".corrupt")
+        finally:
+            await app2.close()
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------- sharded node
+
+
+def test_sharded_aof_roundtrip(tmp_path):
+    """Per-shard segment files, merged by HLC order at replay: a
+    2-shard node's recovery equals its pre-crash canonical."""
+    async def main():
+        node = Node(node_id=1, alias="sh")
+        work = str(tmp_path / "sh")
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               work_dir=work, serve_shards=2,
+                               aof=True, aof_fsync="always",
+                               aof_dir=os.path.join(work, "aof"), **FAST)
+        await _pipelined(app.advertised_addr, _workload_cmds(80))
+        canon = await _canon(app)
+        lg = node.oplog
+        assert lg.n_segments == 3  # 2 shards + the parent local segment
+        seg_sizes = [os.path.getsize(lg.seg_path(lg.dir, lg.generation, s))
+                     for s in range(2)]
+        assert all(sz > len(MAGIC) for sz in seg_sizes), \
+            "both shard segments must carry mirrored entries"
+        await app.close()
+        node2 = Node(node_id=1, alias="sh")
+        app2 = await start_node(node2, host="127.0.0.1", port=0,
+                                work_dir=work, serve_shards=2,
+                                aof=True, aof_fsync="always",
+                                aof_dir=os.path.join(work, "aof"),
+                                **FAST)
+        try:
+            assert (await _canon(app2)) == canon
+            assert node2.stats.extra["aof_recovery_source"] == "log-only"
+        finally:
+            await app2.close()
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ wipe fences
+
+
+def test_wipe_truncates_log_and_fences_recovery(tmp_path):
+    """A state wipe discards every record; a crash before the
+    post-resync rewrite boots (near) empty with a fence instead of
+    resurrecting pre-wipe state."""
+    async def main():
+        app = await _start(tmp_path, "a")
+        node = app.node
+        await _pipelined(app.advertised_addr, _workload_cmds(40))
+        assert node.oplog.size_bytes() > len(MAGIC)
+        fence_before = node.repl_log.last_uuid
+        node.reset_for_full_resync()
+        lg = node.oplog
+        assert lg.size_bytes() <= len(MAGIC) + 64
+        await app.close()
+        app2 = await _start(tmp_path, "a")
+        try:
+            n2 = app2.node
+            assert n2.ks.n_keys() == 0, "pre-wipe state resurrected"
+            assert n2.repl_log.evicted_up_to >= fence_before
+        finally:
+            await app2.close()
+    asyncio.run(main())
+
+
+# ------------------------------------------------ builder-view equivalence
+
+
+def test_serve_builder_wire_view_equals_from_scratch_encode(tmp_path):
+    """The fast path (serializing the serve flush's builder through the
+    chk-fixing _WireView) must be BYTE-identical to the from-scratch
+    build_wire_batch over the run's repl-log entries — the pin that
+    lets append_local_run skip the re-encode without the log's payload
+    ever drifting from the wire protocol."""
+    from constdb_tpu.replica.coalesce import BatchBuilder
+    from constdb_tpu.server.commands import SERVE_ENCODERS
+
+    async def main():
+        app = await _start(tmp_path, "a")
+        node = app.node
+        captured = []
+        orig = OL.OpLog.append_local_run
+
+        def spy(self, entries, prev_uuid, seg=None, publish=True,
+                builder=None):
+            if builder is not None and len(entries) >= 2:
+                fast = OL._encode_serve_builder(builder, prev_uuid,
+                                                node.node_id)
+                slow = OL._encode_run(entries, prev_uuid, node.node_id)
+                captured.append((fast, slow))
+            return orig(self, entries, prev_uuid, seg=seg,
+                        publish=publish, builder=builder)
+
+        OL.OpLog.append_local_run = spy
+        try:
+            await _pipelined(app.advertised_addr, _workload_cmds(120))
+        finally:
+            OL.OpLog.append_local_run = orig
+            await app.close()
+        assert captured, "no coalesced runs reached the op log"
+        for fast, slow in captured:
+            assert fast is not None and fast == slow
+    asyncio.run(main())
