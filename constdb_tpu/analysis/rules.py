@@ -483,8 +483,10 @@ class ForkCaptureRule(Rule):
 
 class KeyConfinedRule(Rule):
     """KEY-CONFINED: every command registered for coalescing
-    (SERVE_PLANNERS via @serve_plan, COLUMNAR_ENCODERS via @columnar)
-    must be statically first-key-confined.
+    (SERVE_PLANNERS via @serve_plan, COLUMNAR_ENCODERS via @columnar,
+    SERVE_READS via @serve_read — the read planner routes, flushes, and
+    caches by the first argument alone) must be statically
+    first-key-confined.
 
     Three subsystems silently rely on the convention that a data
     command's keyspace effects are confined to the key in its FIRST
@@ -507,7 +509,7 @@ class KeyConfinedRule(Rule):
             "coalescing tables (it stays an exact per-command barrier)")
 
     KEY_RESOLVERS = {"lookup", "query", "get_or_create", "create_key"}
-    COALESCE_DECOS = {"serve_plan", "columnar"}
+    COALESCE_DECOS = {"serve_plan", "columnar", "serve_read"}
 
     def applies(self, ctx: FileContext) -> bool:
         return _scoped(ctx, "server")

@@ -162,6 +162,9 @@ def certify_scenario(seed: int, cell: Optional[Cell] = None,
         ("faults", 0, 1, dict(delay=(0.0005, 0.004), reorder=0.25,
                               dup=0.25)),
         ("ops", ops * 2),
+        # cached reads racing the faulted replication stream: planned +
+        # cached replies must match the per-command reference exactly
+        ("cached_reads", 0),
         ("clear_faults",),
     ]
     if cell.wire and cell.shards == 1:
@@ -212,6 +215,9 @@ def certify_scenario(seed: int, cell: Optional[Cell] = None,
         ("ops", ops // 2),
         ("clock_jump", 2, -20_000),
         ("ops", ops // 2),
+        # the read plane again after crashes + clock jitter (node 1 was
+        # cold-restarted above — its cache refilled from recovered state)
+        ("cached_reads", 1),
     ]
     if cell.aof:
         # durability primitives (round 18): kill -9 mid-firehose and a
@@ -333,6 +339,43 @@ class _Workload:
                 c.parser.feed(data)
         finally:
             await c.close()
+
+    def cached_read_check(self, cluster: ChaosCluster, i: int) -> None:
+        """The read-plane smoke under chaos: one coalesced read chunk
+        (planned batch + versioned reply cache, server/serve.py) vs the
+        per-command reference on the SAME node with no await between
+        the passes — both observe identical state, so any byte
+        difference is a stale cached serve, a FAILURE, not a race.
+        Runs twice so the second pass actually hits entries the first
+        one filled (entries surviving earlier replication intake are
+        exactly what the invalidation laws must have dropped).  Sharded
+        nodes skip (their data lives in the workers; the sharded read
+        differential is pinned in tests/test_read_path.py)."""
+        node = cluster.apps[i].node
+        if node.serve_plane is not None:
+            return
+        from ..resp.codec import encode_into
+        from ..resp.message import Arr, Bulk, NoReply
+        from ..server.serve import ServeCoalescer
+        msgs = [Arr([Bulk(b"get"), Bulk(b"wire%d" % j)])
+                for j in range(8)]
+        msgs += [Arr([Bulk(b"smembers"), Bulk(b"probe:s")]),
+                 Arr([Bulk(b"scnt"), Bulk(b"probe:s")]),
+                 Arr([Bulk(b"sismember"), Bulk(b"probe:s"),
+                      Bulk(b"probe-member")])]
+        coal = ServeCoalescer(node)
+        for _ in range(2):
+            out = bytearray()
+            coal.run_chunk(list(msgs), out)
+            ref = bytearray()
+            for m in msgs:
+                r = node.execute(m)
+                if not isinstance(r, NoReply):
+                    encode_into(ref, r)
+            if bytes(out) != bytes(ref):
+                raise AssertionError(
+                    f"node {i}: cached/planned read replies diverged "
+                    f"from the per-command reference (stale serve)")
 
     async def burst(self, cluster: ChaosCluster, n_ops: int,
                     only: Optional[set] = None) -> None:
@@ -478,6 +521,8 @@ async def _run_scenario_async(sc: Scenario) -> dict:
                     await wl.burst(cluster, step[2], only={step[1]})
                 elif kind == "wire_burst":
                     await wl.pipelined_writes(cluster, step[1], step[2])
+                elif kind == "cached_reads":
+                    wl.cached_read_check(cluster, step[1])
                 elif kind == "corrupt_burst":
                     await _corrupt_burst(sc, cluster, plane, wl,
                                          step[1], step[2], step[3])

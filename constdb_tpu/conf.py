@@ -131,6 +131,11 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "published by the first push loop to drain a run and reused "
            "by every other peer at the same cursor and caps-class; "
            "0 disables (every peer re-encodes, the pre-broadcast path)"),
+    EnvVar("CONSTDB_READ_CACHE_MB", "16",
+           "versioned hot-key reply cache cap (MB): finished RESP reply "
+           "bytes served by the coalescer's read planner while a key's "
+           "envelope version is unchanged, invalidated at every "
+           "mutation intake; 0 disables (every read recomputes)"),
     EnvVar("CONSTDB_SERVE_BATCH", "512",
            "max pipelined client commands the serve path plans into one "
            "columnar merge; 1 = the exact per-command path"),

@@ -12,7 +12,7 @@ import logging
 from ..crdt import semantics as S
 from ..store.keyspace import KeySpace
 from .base import ColumnarBatch, MergeStats
-from .hostbatch import HOST_MICRO_MAX
+from .hostbatch import HOST_MICRO_MAX, HOST_ROW_MIN
 
 log = logging.getLogger(__name__)
 
@@ -32,10 +32,19 @@ class CpuMergeEngine:
         # times cheaper at a few hundred rows.  Bulk snapshot groups keep
         # the per-row reference path: this engine IS the measured baseline
         # and the verification oracle for those.
-        if not all(b.rows_unique_per_slot for b in batches) and \
-                sum(b.n_rows for b in batches) <= HOST_MICRO_MAX:
-            from .hostbatch import merge_host_batches
-            return merge_host_batches(store, batches)
+        total_rows = sum(b.n_rows for b in batches)
+        if total_rows <= HOST_MICRO_MAX and \
+                not all(b.rows_unique_per_slot for b in batches):
+            # ...except TINY runs (a read-heavy pipeline's interleaved
+            # write clusters, an idle stream flush): below ~2 dozen rows
+            # the vectorized pass's numpy fixed costs exceed the whole
+            # per-row loop, and the loop IS the reference the vectorized
+            # path is differential-pinned against — routing by size can
+            # never change bytes, only wall time (measured crossover
+            # ~30 rows on the r18 builder box)
+            if total_rows > HOST_ROW_MIN:
+                from .hostbatch import merge_host_batches
+                return merge_host_batches(store, batches)
         st = MergeStats()
         for b in batches:
             st += self.merge(store, b)

@@ -34,10 +34,15 @@ from .base import ColumnarBatch, MergeStats
 _I64 = np.int64
 
 # row ceiling under which the vectorized host strategy beats both the
-# per-row loop (always, past a handful of rows) and a device scatter
+# per-row loop (past a couple dozen rows) and a device scatter
 # (dispatch fixed costs dominate at micro-batch scale) — shared by
 # TpuMergeEngine.HOST_SCATTER_MAX and CpuMergeEngine.merge_many
 HOST_MICRO_MAX = 1 << 15
+# ...and the row FLOOR under which the per-row reference loop beats the
+# vectorized pass's numpy fixed costs (CpuMergeEngine.merge_many routes
+# tiny runs — a read-heavy pipeline's interleaved write clusters — back
+# onto the loop; byte-identical by the differential pin, r18)
+HOST_ROW_MIN = 24
 
 
 def _group_last(sorted_keys: np.ndarray) -> np.ndarray:

@@ -82,6 +82,7 @@ def _worker_stats(node) -> dict:
     # parent's INFO percentiles cover sharded serving too
     lat = list(st.serve_lat)
     st.serve_lat.clear()
+    rc = node.read_cache
     return {
         "cmds": st.cmds_processed,
         "repl": st.cmds_replicated,
@@ -93,6 +94,15 @@ def _worker_stats(node) -> dict:
         "keys": node.ks.n_keys(),
         "used_bytes": node.governor.used_memory(),
         "oom_shed": st.oom_shed_writes,
+        # the read plane's worker-side gauges (the parent folds the
+        # counters into the node totals and publishes the bytes gauge
+        # per shard — server/serve_shards.py _fold_stats)
+        "reads": st.serve_reads_coalesced,
+        "read_flushes": st.serve_read_flushes,
+        "cache_hits": rc.hits,
+        "cache_misses": rc.misses,
+        "cache_inv": rc.invalidations,
+        "cache_bytes": rc.bytes,
         "lat": lat,
     }
 
@@ -249,6 +259,8 @@ def _serve_worker_main(conn, shard: int, n_shards: int, engine_spec: str,
                 node.ks = node._make_keyspace()
                 wire_ks()
                 node.repl_log = _TapLog()
+                # cached replies describe the wiped shard state
+                node.read_cache.clear()
                 if coal is not None:
                     coal._reset_caches()
                 conn.send(("ok", None))

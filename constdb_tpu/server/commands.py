@@ -92,6 +92,77 @@ def register(name: str, flags: int, families: tuple = ALL_FAMILIES):
     return deco
 
 
+# --------------------------------------------------------------------
+# read plane classification (server/serve.py read planner + the
+# dispatch-time narrow flush below).
+# --------------------------------------------------------------------
+
+class ReadSpec:
+    """How the serve coalescer's read planner executes one key-scoped
+    read command as part of a batched read run (server/serve.py
+    _run_read_batch): `kind` selects the vectorized gather + reply
+    shape, `enc` the required key encoding (None = get's own dispatch),
+    `families` the CRDT planes the read observes (its narrow
+    flush-before-read set), `arity` the exact frame length the planner
+    accepts (anything else falls back to the per-command path, which
+    raises the exact arity/type error)."""
+
+    __slots__ = ("kind", "enc", "families", "arity")
+
+    def __init__(self, kind: str, enc, families: tuple, arity: int):
+        self.kind = kind
+        self.enc = enc
+        self.families = families
+        self.arity = arity
+
+
+SERVE_READS: dict[bytes, ReadSpec] = {}
+
+# Which CRDT planes each READONLY command observes — the dispatch-time
+# narrow read barrier: execute() flushes ONLY these families for a
+# listed read (ensure_flushed_for), so a device-resident engine whose
+# listed planes are clean serves the read with ZERO flush downloads
+# (the TENSOR.GET device-first pattern from round 13, generalized to
+# the scalar families).  Reads not listed here (desc, INFO-adjacent
+# probes) keep the blanket flush.  The tensor reads observe only the
+# env plane on host — their payload truth stays in the resident device
+# pools (Node.tensor_read); see the note at the old TENSOR_DEVICE_READS
+# site in the dispatch body.
+READ_FLUSH_FAMILIES: dict[bytes, tuple] = {
+    b"get": ("env", "reg", "cnt"),
+    b"smembers": ("env", "el"),
+    b"scnt": ("env", "el"),
+    b"sismember": ("env", "el"),
+    b"hget": ("env", "el"),
+    b"hgetall": ("env", "el"),
+    b"lrange": ("env", "el"),
+    b"llen": ("env", "el"),
+    b"mvget": ("env", "el"),
+    b"ttl": ("env",),
+    b"tensor.get": ("env",),
+    b"tensor.stat": ("env",),
+}
+
+
+def serve_read(name: str, kind: str, enc=None, arity: int = 2):
+    """Register the command `name` with the serve-path READ planner
+    (stacked ABOVE @register so the command exists when this runs).
+    Planned reads are served from batched gathers + the versioned reply
+    cache instead of acting as per-command barriers; the family set the
+    plan flushes comes from READ_FLUSH_FAMILIES (one source for the
+    lone-read and batched-read narrow barriers), and the KEY-CONFINED
+    lint rule statically checks the decorated handler like it does the
+    write planners' (constdb_tpu/analysis/rules.py) — the read planner
+    routes and caches by the FIRST argument alone."""
+    def deco(fn):
+        cmd = COMMANDS[name.encode()]
+        assert cmd.flags & CMD_READONLY, name
+        SERVE_READS[cmd.name] = ReadSpec(
+            kind, enc, READ_FLUSH_FAMILIES[cmd.name], arity)
+        return fn
+    return deco
+
+
 class ArgIter:
     """Arity-checked argument cursor (parity: reference NextArg,
     src/cmd.rs:348-397)."""
@@ -187,14 +258,17 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
         node.stats.oom_shed_writes += 1
         from .overload import OOM_ERR
         return Err(OOM_ERR)
-    if name in TENSOR_DEVICE_READS:
-        # tensor reads are served DEVICE-FIRST (Node.tensor_read): they
-        # touch only the env plane (query/alive — flushed narrowly
-        # here) and host-authoritative slot stamps; the payload truth
-        # stays in the resident pools, so the blanket flush would force
-        # the very dirty-row round-trip the steady tensor path exists
-        # to avoid
-        node.ensure_flushed_for(("env",))
+    fams = READ_FLUSH_FAMILIES.get(name)
+    if fams is not None:
+        # narrow read barrier: a listed read observes only `fams`, so a
+        # resident engine flushes nothing when those planes are clean.
+        # The tensor reads additionally serve DEVICE-FIRST
+        # (Node.tensor_read): they touch only the env plane on host and
+        # the host-authoritative slot stamps — the payload truth stays
+        # in the resident pools, so the blanket flush would force the
+        # very dirty-row round-trip the steady tensor path exists to
+        # avoid.
+        node.ensure_flushed_for(fams)
     else:
         node.ensure_flushed()  # device merge results become readable
     if uuid is None:
@@ -204,12 +278,60 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
     try:
         reply = cmd.handler(node, ctx, args)
     except CstError as e:
+        if cmd.is_write:
+            _invalidate_read_cache(node, cmd, items[1:])
         return Err(e.resp_error())
     if cmd.is_write:
         node.ks.touch(*cmd.families)
+        # invalidate-before-visible: the reply cache drops this key's
+        # entries before any later read can observe the write
+        # (server/read_cache.py; every data command is first-key-
+        # confined, the KEY-CONFINED convention — element writes
+        # member-scoped on this success path)
+        _invalidate_read_cache(node, cmd, items[1:], scoped=True)
         if not (cmd.flags & CMD_NO_REPLICATE):
             node.replicate_cmd(uuid, name, items[1:])
     return reply
+
+
+# element writes whose touched members are exactly their args —
+# member-scoped reply-cache invalidation (sismember/hget entries for
+# OTHER members survive; read_cache.invalidate_key_members).  The value
+# is the arg stride (hset interleaves field/value pairs).
+_MEMBER_WRITE_STRIDE = {b"sadd": 1, b"srem": 1, b"hdel": 1, b"hset": 2}
+
+
+def _invalidate_read_cache(node: "Node", cmd: Command, args: list,
+                           scoped: bool = False) -> None:
+    """Reply-cache intake hook for the per-command write paths (client
+    dispatch + per-frame replication apply).  Membership commands
+    (empty `families`) touch no keyspace state; CTRL takes subcommands,
+    not keys, so it clears outright rather than mis-scope; everything
+    else is first-key-confined — and element writes additionally
+    member-scoped when `scoped` (the SUCCESS path only: an errored
+    handler gets the conservative whole-key drop).  Invalidating on the
+    ERROR path too is deliberate — a handler that raised mid-mutation
+    must not leave a stale cached reply behind."""
+    rc = node.read_cache
+    if not len(rc):
+        return
+    if cmd.flags & CMD_CTRL or not cmd.families:
+        if cmd.flags & CMD_CTRL:
+            rc.clear()
+        return
+    if args:
+        try:
+            key = as_bytes(args[0])
+            stride = _MEMBER_WRITE_STRIDE.get(cmd.name) if scoped else None
+            if stride is not None:
+                rc.invalidate_key_members(
+                    key, [as_bytes(a) for a in args[1::stride]])
+            else:
+                rc.invalidate_key(key)
+            return
+        except CstError:
+            pass
+    rc.clear()
 
 
 def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
@@ -227,6 +349,13 @@ def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
     node.ensure_flushed()
     node.hlc.observe(uuid)
     ctx = ExecCtx(uuid, origin_nodeid, True, None)
+    if cmd.is_write:
+        # replication intake invalidates BEFORE the op lands: a cached
+        # hot-key reply must never outlive a peer's write to that key
+        # (the per-frame twin of merge_batches' batched invalidation).
+        # Member-scoping is safe pre-land: the op can only touch the
+        # members it names, landed or not.
+        _invalidate_read_cache(node, cmd, args, scoped=True)
     reply = cmd.handler(node, ctx, ArgIter(args, name))
     if cmd.is_write:
         node.ks.touch(*cmd.families)
@@ -237,6 +366,7 @@ def apply_replicated(node: "Node", name: bytes, args: list, origin_nodeid: int,
 # generic commands (reference src/cmd.rs:141-346)
 # ====================================================================
 
+@serve_read("get", "get")
 @register("get", CMD_READONLY)
 def get_command(node, ctx, args):
     key = args.next_bytes()
@@ -523,6 +653,7 @@ def srem_command(node, ctx, args):
     return Int(cnt)
 
 
+@serve_read("smembers", "members", enc=S.ENC_SET)
 @register("smembers", CMD_READONLY)
 def smembers_command(node, ctx, args):
     key = args.next_bytes()
@@ -533,6 +664,46 @@ def smembers_command(node, ctx, args):
     if ks.enc_of(kid) != S.ENC_SET:
         raise _invalid_type()
     return Arr([Bulk(m) for m, _v, _t in ks.elem_live(kid)])
+
+
+@serve_read("scnt", "card", enc=S.ENC_SET)
+@register("scnt", CMD_READONLY)
+def scnt_command(node, ctx, args):
+    """SCNT key — live member count (the reference's set-cardinality
+    probe; Redis SCARD).  Mirrors SMEMBERS' visibility exactly: the
+    key-level tombstone is NOT consulted — a dead key's count is simply
+    the count of its live members (normally 0, but add-wins members
+    newer than the delete stay visible)."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return Int(0)
+    if ks.enc_of(kid) != S.ENC_SET:
+        raise _invalid_type()
+    return Int(sum(1 for _ in ks.elem_live(kid)))
+
+
+@serve_read("sismember", "ismember", enc=S.ENC_SET, arity=3)
+@register("sismember", CMD_READONLY)
+def sismember_command(node, ctx, args):
+    """SISMEMBER key member — 1 iff the member is visible (same
+    element-liveness rule as SMEMBERS, one combo probe instead of a
+    full scan)."""
+    key = args.next_bytes()
+    member = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return Int(0)
+    if ks.enc_of(kid) != S.ENC_SET:
+        raise _invalid_type()
+    row = ks.el_row(kid, member)
+    if row < 0:
+        return Int(0)
+    el = ks.el
+    return Int(1 if S.elem_alive(int(el.add_t[row]), int(el.del_t[row]))
+               else 0)
 
 
 @register("spop", CMD_WRITE | CMD_NO_REPLICATE, families=("env", "el"))
@@ -601,6 +772,7 @@ def hset_command(node, ctx, args):
     return Int(cnt)
 
 
+@serve_read("hget", "elemget", enc=S.ENC_DICT, arity=3)
 @register("hget", CMD_READONLY)
 def hget_command(node, ctx, args):
     key = args.next_bytes()
@@ -615,6 +787,7 @@ def hget_command(node, ctx, args):
     return Bulk(v) if v is not None else NIL
 
 
+@serve_read("hgetall", "pairs", enc=S.ENC_DICT)
 @register("hgetall", CMD_READONLY)
 def hgetall_command(node, ctx, args):
     key = args.next_bytes()
@@ -870,6 +1043,7 @@ def lremat_command(node, ctx, args):
     return NO_REPLY
 
 
+@serve_read("lrange", "lrange", enc=S.ENC_LIST, arity=4)
 @register("lrange", CMD_READONLY)
 def lrange_command(node, ctx, args):
     """LRANGE key start stop — redis-style inclusive range with negative
@@ -893,6 +1067,7 @@ def lrange_command(node, ctx, args):
                 for v in vals[start:stop + 1]])
 
 
+@serve_read("llen", "llen", enc=S.ENC_LIST)
 @register("llen", CMD_READONLY)
 def llen_command(node, ctx, args):
     key = args.next_bytes()
@@ -1167,12 +1342,13 @@ KEY_SCOPED_BARRIERS = frozenset(
     (b"delset", b"deldict", b"delmv", b"dellist", b"expireat", b"mvwrite"))
 STATE_FREE_BARRIERS = frozenset((b"meet", b"forget"))
 
-# Tensor reads skip execute()'s blanket flush (see the dispatch body):
+# Tensor reads skip execute()'s blanket flush via READ_FLUSH_FAMILIES
+# (defined with the read-plane tables near the top of this module):
 # everything they read is env (narrow-flushed) or host-authoritative
 # tensor stamps, and TENSOR.GET reduces from the resident device pools
 # (Node.tensor_read) — the family's whole point is that reads do not
-# force payload round-trips.
-TENSOR_DEVICE_READS = frozenset((b"tensor.get", b"tensor.stat"))
+# force payload round-trips.  The scalar read families narrow the same
+# way now (round 18).
 
 
 def columnar(name: str):
@@ -1489,8 +1665,9 @@ SERVE_ENCODERS[b"hdel"] = _senc_elem_rems(S.ENC_DICT)
 # else non-plannable flushes first (writes also push the repl_log,
 # whose uuids must stay ordered with the pending run's).
 SERVE_KEY_SCOPED_READS = frozenset(
-    (b"get", b"smembers", b"hget", b"hgetall", b"lrange", b"llen",
-     b"ttl", b"desc", b"mvget", b"tensor.get", b"tensor.stat"))
+    (b"get", b"smembers", b"scnt", b"sismember", b"hget", b"hgetall",
+     b"lrange", b"llen", b"ttl", b"desc", b"mvget", b"tensor.get",
+     b"tensor.stat"))
 
 _INT0 = Int(0)
 

@@ -150,6 +150,22 @@ def _section_stats(node, out):
     out.append(("serve_msgs_coalesced", st.serve_msgs_coalesced))
     out.append(("serve_flushes", st.serve_flushes))
     out.append(("serve_barriers", st.serve_barriers))
+    # the coalesced read plane (server/serve.py read planner +
+    # server/read_cache.py).  Counters are node totals — a sharded node
+    # folds worker deltas into them per ack (server/serve_shards.py) —
+    # while the bytes gauge sums the parent cache with the per-shard
+    # worker gauges (a shard worker's cache lives in its process)
+    out.append(("serve_reads_coalesced", st.serve_reads_coalesced))
+    out.append(("serve_read_flushes", st.serve_read_flushes))
+    rc = node.read_cache
+    x = st.extra
+    rc_bytes = rc.used_bytes() + sum(
+        v for k, v in x.items()
+        if k.startswith("serve_shard") and k.endswith("_cache_bytes"))
+    out.append(("read_cache_hits", rc.hits))
+    out.append(("read_cache_misses", rc.misses))
+    out.append(("read_cache_bytes", rc_bytes))
+    out.append(("read_cache_invalidations", rc.invalidations))
     # overload governance (server/overload.py): client writes shed at
     # the maxmemory soft watermark, hard-watermark reclaim sweeps,
     # slow-reader disconnects at the outbuf cap, and push loops paused

@@ -83,6 +83,16 @@ class NodeStats:
     serve_msgs_coalesced: int = 0
     serve_flushes: int = 0
     serve_barriers: int = 0
+    # the coalesced READ plane (round 18, server/serve.py read planner):
+    # key-scoped reads served from planned read batches (batched key
+    # resolution + vectorized family gathers + the versioned reply
+    # cache) instead of acting as per-command barriers, and pending-run
+    # lands forced by a read batch needing read-your-writes.  The reply
+    # cache's own hit/miss/byte/invalidation gauges live on
+    # node.read_cache (server/read_cache.py); sharded nodes fold worker
+    # deltas into the parent's cache counters (server/serve_shards.py).
+    serve_reads_coalesced: int = 0
+    serve_read_flushes: int = 0
     serve_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
     # overload governance (server/overload.py + server/io.py +
     # replica/link.py): client data writes shed at the maxmemory soft
@@ -209,6 +219,17 @@ class Node:
         from ..replica.encode_cache import RunEncodeCache
         self.wire_cache = RunEncodeCache(
             max(0, env_int("CONSTDB_ENCODE_CACHE_MB", 16)) << 20)
+        # versioned hot-key reply cache (server/read_cache.py): finished
+        # RESP reply bytes served by the coalescer's read planner while
+        # a key's state is provably unchanged.  Invalidated at every
+        # mutation intake (commands.execute/apply_replicated per-op,
+        # merge_batch/merge_batches for every batched path) and a
+        # registered used_memory source (server/overload.py).  A shard
+        # worker's Node owns its own cache — each worker invalidates
+        # exactly its shard.
+        from .read_cache import ReadReplyCache
+        self.read_cache = ReadReplyCache(
+            max(0, env_int("CONSTDB_READ_CACHE_MB", 16)) << 20)
         # bumped by reset_for_full_resync; replica links stamp it at
         # connection install and refuse stale-epoch REPLACK beacons (a
         # beacon from a pre-wipe stream would re-advance a zeroed pull
@@ -290,6 +311,7 @@ class Node:
         between calls; it flushes to the host lazily before the next read
         (`ensure_flushed`)."""
         import time
+        self._invalidate_reads((batch,))
         t0 = time.perf_counter()
         st = self.engine.merge(self.ks, batch)
         self.stats.merge_secs += time.perf_counter() - t0
@@ -297,6 +319,21 @@ class Node:
         self.stats.merge_rows += batch.n_rows
         self._dump_stale()
         return st
+
+    def _invalidate_reads(self, batches) -> None:
+        """Reply-cache invalidation for every BATCHED mutation intake —
+        snapshot/delta ingest, coalesced replication apply, columnar
+        wire batches, serve-coalescer runs, oplog replay all ride
+        merge_batch/merge_batches, so hooking here (BEFORE the merge
+        lands) is what makes invalidate-before-visible complete
+        (server/read_cache.py)."""
+        rc = self.read_cache
+        if not len(rc):
+            return
+        for b in batches:
+            rc.invalidate_keys(b.keys)
+            if b.del_keys:
+                rc.invalidate_keys(b.del_keys)
 
     def _dump_stale(self) -> None:
         """Bulk-merged state bypasses the repl_log, so a cached full-sync
@@ -327,6 +364,7 @@ class Node:
                 self.merge_batch(b)
             return
         import time
+        self._invalidate_reads(batches)
         t0 = time.perf_counter()
         self.engine.merge_many(self.ks, batches)
         self.stats.merge_secs += time.perf_counter() - t0
@@ -396,6 +434,9 @@ class Node:
         engine = self.engine
         if hasattr(engine, "discard_resident"):
             engine.discard_resident()
+        # every cached reply describes wiped state (and its stamps hold
+        # kids of the discarded keyspace object)
+        self.read_cache.clear()
         cap = self.repl_log.cap
         fence = max(self.repl_log.last_uuid, self.hlc.current)
         self.ks = self._make_keyspace()

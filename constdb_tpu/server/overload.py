@@ -130,11 +130,13 @@ class OverloadGovernor:
         # (drained into the parent's segments per ack — the parent's
         # MergedReplLog accounts those bytes)
         wire_cache = getattr(node, "wire_cache", None)
+        read_cache = getattr(node, "read_cache", None)
         total = node.ks.used_bytes() \
             + (getattr(node.repl_log, "total_bytes", 0) or 0) \
             + (getattr(eng, "_pool_bytes", 0) or 0) \
             + (getattr(eng, "_tns_bytes", 0) or 0) \
-            + (wire_cache.used_bytes() if wire_cache is not None else 0)
+            + (wire_cache.used_bytes() if wire_cache is not None else 0) \
+            + (read_cache.used_bytes() if read_cache is not None else 0)
         for fn in self.sources:
             total += fn()
         return total
@@ -222,6 +224,10 @@ class OverloadGovernor:
             # the encode-once cache is exactly a rebuildable warm cache:
             # dropping it costs re-encodes, never correctness
             wire_cache.clear()
+        read_cache = getattr(node, "read_cache", None)
+        if read_cache is not None:
+            # likewise the reply cache: dropping it costs re-reads only
+            read_cache.clear()
         if self.reclaim_gc:
             # gc() re-flushes (a no-op now) and compacts when dead rows
             # dominate; collection is bounded by the cluster horizon

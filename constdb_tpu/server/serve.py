@@ -20,7 +20,30 @@ Ordering discipline (docs/INVARIANTS.md "Client-serving coalescing"):
     per-command path would have replied, because the whole chunk runs
     synchronously on the single-writer loop (nothing can interleave) and
     every command that could OBSERVE pending rows is a barrier.
-  * reads, non-plannable writes, and admin commands are ordered
+  * runs of plannable key-scoped READS (commands.SERVE_READS —
+    get/scnt/sismember/smembers/hget/hgetall/lrange/llen) become ONE
+    planned read batch instead of N barriers: keys resolve via one
+    batched native index call, the device flush narrows to exactly the
+    families the run observes (READ_FLUSH_FAMILIES → ensure_flushed_for
+    — a clean resident plane serves the batch with zero downloads),
+    values gather vectorized per family (store/keyspace.py
+    register_get_batch / counter_sum_batch / elem_live_rows_batch /
+    elem_probe_batch), and finished reply bytes are served from —
+    and fill — the versioned hot-key reply cache (server/read_cache.py,
+    CONSTDB_READ_CACHE_MB).  A run stays open across interleaved
+    commands that provably commute with it — KEY-CONFINED data commands
+    whose first-arg key the run does not read (their replies buffer and
+    splice back in exact request order; their HLC ticks and state
+    effects happen at their exact positions, as do the reads' own
+    ticks, minted at append time) — so a 90:10 pipeline plans
+    chunk-sized read batches instead of write-fragmented slivers.
+    Read-your-writes is structural: any command touching a run key
+    closes the run first, a read batch lands the pending write run
+    first iff one of its keys has pending rows (serve_read_flushes),
+    and anything unusual (expiry-armed key, type conflict, odd arity)
+    demotes to the exact per-command path at its exact position in the
+    run.
+  * other reads, non-plannable writes, and admin commands are ordered
     BARRIERS: the pending run flushes (lands + logs) first, then the
     command executes on the exact per-command path.  Read-your-writes
     within a pipeline is therefore free, and the reply socket write
@@ -66,16 +89,34 @@ import time
 
 import numpy as np
 
+from ..errors import CstError
 from ..resp.codec import encode_into
-from ..resp.message import Arr, Bulk, NoReply
+from ..resp.message import (Arr, Bulk, Int, NIL, NoReply, as_bytes,
+                            as_int)
 from ..replica.coalesce import BatchBuilder
 from ..crdt import semantics as S
 from ..store.keyspace import KeySpace
 from .commands import (CMD_CTRL, CMD_READONLY, COMMANDS, SERVE_ENCODERS,
-                       SERVE_KEY_SCOPED_READS, SERVE_PLANNERS)
+                       SERVE_KEY_SCOPED_READS, SERVE_PLANNERS,
+                       SERVE_READS)
 from .events import EVENT_REPLICATED
 
 _I64 = np.int64
+
+
+def _enc1(msg) -> bytes:
+    b = bytearray()
+    encode_into(b, msg)
+    return bytes(b)
+
+
+# pre-encoded constant replies the read planner emits without building
+# message objects (absent keys / empty ranges)
+_NIL_BYTES = _enc1(NIL)
+_INT0_BYTES = _enc1(Int(0))
+_EMPTY_ARR_BYTES = _enc1(Arr([]))
+# the reply cache's stamp-verify reads host env columns only
+_ENV_FAMS = ("env",)
 
 # pre-probe extraction tables (_preprobe): which argument positions of a
 # plannable command name state the planners will ask for
@@ -173,42 +214,86 @@ class ServeCoalescer:
             # they fall through to _exec, where execute() returns the
             # exact -OOM error without applying, logging, or
             # replicating anything.  Exempt planners (srem/hdel free
-            # memory) keep riding the run.
-            plan = [None if fn is not None and self._oom_gated(m) else fn
+            # memory) keep riding the run; reads (tuple plans) are
+            # never shed.
+            plan = [None if callable(fn) and self._oom_gated(m) else fn
                     for fn, m in zip(plan, msgs)]
         n = len(msgs)
-        n_plannable = sum(f is not None for f in plan)
+        n_plannable = sum(callable(f) for f in plan)
         if n_plannable >= _PREPROBE_MIN:
             self._preprobe(msgs, plan)
         max_run = self.max_run
+        tick = self.node.hlc.tick
+        read_run: list = []
+        run_keys: set = set()   # keys the open read run observes
+        deferred: list = []     # (msg_index, reply_bytes) executed while
+        #                         the run stayed open (disjoint keys)
         for i, msg in enumerate(msgs):
+            fn = plan[i]
+            if type(fn) is tuple:
+                # runs of plannable key-scoped reads become ONE planned
+                # read batch (batched key resolution + vectorized family
+                # gathers + the versioned reply cache) instead of N
+                # per-command barriers.  The run's HLC tick is minted
+                # HERE — at the read's exact stream position — so the
+                # uuid stream is the per-command path's even though the
+                # gathers run later.
+                pre = uuids[i] if uuids is not None else tick(False)
+                read_run.append((i, msg) + fn + (pre,))
+                run_keys.add(fn[2])
+                continue
+            if read_run:
+                # a read run stays open across interleaved commands that
+                # provably commute with every read in it: a registered
+                # data command confined to a first-arg key OUTSIDE the
+                # run's key set (KEY-CONFINED — the same convention the
+                # planners and the reply cache ride).  Anything else —
+                # a write/read of a run key, CTRL, membership, unknown —
+                # closes the run first, so each read still gathers the
+                # state of its exact stream position.
+                key = self._confined_key(msg)
+                if key is None or key in run_keys:
+                    self._run_read_batch(read_run, out, spans, deferred)
+                    read_run = []
+                    run_keys = set()
+                    deferred = []
             if uuids is not None:
                 self._cur_uuid = uuids[i]
-            fn = plan[i]
+            sink = out
+            if read_run:
+                # reply bytes buffer until the run closes (replies are
+                # emitted strictly in request order); state effects
+                # happen NOW, at this command's exact position
+                sink = bytearray()
             isolated = False
+            handled = False
             # a plannable command opens a run only when it has company
             # (an open run, or a plannable successor) — an isolated
             # write between barriers is cheaper per-command than as a
             # one-row micro-merge
             if fn is not None:
                 if self._pending or \
-                        (i + 1 < n and plan[i + 1] is not None):
+                        (i + 1 < n and callable(plan[i + 1])):
                     reply = fn(self, msg.items)
                     if reply is not None:
-                        encode_into(out, reply)
-                        if spans is not None:
-                            spans.append(len(out))
-                        if self._pending >= max_run:
-                            self.flush()
-                        continue
+                        encode_into(sink, reply)
+                        handled = True
                     # else: demoted — a real barrier (exact op error)
                 else:
                     isolated = True  # per-command by CHOICE, not a barrier
-            if self._pending and not self._scoped_read_commutes(msg):
+            if not handled:
+                if self._pending and not self._scoped_read_commutes(msg):
+                    self.flush()
+                self._exec(msg, sink, count_barrier=not isolated)
+            if sink is out:
+                if spans is not None:
+                    spans.append(len(out))
+            else:
+                deferred.append((i, bytes(sink)))
+            if handled and self._pending >= max_run:
                 self.flush()
-            self._exec(msg, out, count_barrier=not isolated)
-            if spans is not None:
-                spans.append(len(out))
+        if read_run:
+            self._run_read_batch(read_run, out, spans, deferred)
         self._cur_uuid = None
         if self._pending:
             self.flush()
@@ -224,17 +309,51 @@ class ServeCoalescer:
 
     @staticmethod
     def _planner_of(msg):
+        """One classification pass per message: a SERVE_PLANNERS
+        callable (plannable write), a read spec TUPLE `(spec, name,
+        key, extra, parsed)` for an exact-arity key-scoped read the
+        batch executor can serve (commands.SERVE_READS), or None for
+        everything else — which falls back to the scoped-read / barrier
+        machinery, raising the exact arity/coercion error on the
+        per-command path."""
         if type(msg) is not Arr or not msg.items:
             return None
-        head = msg.items[0]
+        items = msg.items
+        head = items[0]
         if type(head) is not Bulk:
             return None
         name = head.val
         fn = SERVE_PLANNERS.get(name)
-        if fn is None and name not in COMMANDS:
+        if fn is not None:
+            return fn
+        spec = SERVE_READS.get(name)
+        if spec is None:
+            if name in COMMANDS:
+                return None
             # mirror the dispatch table's lazy lowercase fallback
-            fn = SERVE_PLANNERS.get(name.lower())
-        return fn
+            name = name.lower()
+            fn = SERVE_PLANNERS.get(name)
+            if fn is not None:
+                return fn
+            spec = SERVE_READS.get(name)
+            if spec is None:
+                return None
+        if len(items) != spec.arity or type(items[1]) is not Bulk:
+            return None
+        kind = spec.kind
+        if kind in ("elemget", "ismember"):
+            try:
+                extra = as_bytes(items[2])
+            except CstError:
+                return None
+            return (spec, name, items[1].val, extra, extra)
+        if kind == "lrange":
+            try:
+                rng = (as_int(items[2]), as_int(items[3]))
+            except CstError:
+                return None
+            return (spec, name, items[1].val, b"%d:%d" % rng, rng)
+        return (spec, name, items[1].val, b"", None)
 
     def _preprobe(self, msgs: list, plan: list) -> None:
         """Seed the run caches for a whole chunk with BATCHED index
@@ -258,8 +377,9 @@ class ServeCoalescer:
         cnt_keys: list = []
         el_cmds: list = []   # (key, want_enc, member item step, items)
         for i, fn in enumerate(plan):
-            if fn is None:
-                continue
+            if not callable(fn):
+                continue  # None, or a read-spec tuple (reads resolve
+                #           through their own batched path)
             items = msgs[i].items
             if len(items) < 2:
                 continue
@@ -380,6 +500,337 @@ class ServeCoalescer:
             return False
         key = msg.items[1]
         return type(key) is Bulk and key.val not in self._pending_keys
+
+    # ------------------------------------------------------ read planning
+
+    def _confined_key(self, msg):
+        """The first-arg key a registered DATA command's effects are
+        confined to (the KEY-CONFINED convention the planners, the reply
+        cache, and the shard router already rely on), or None for
+        anything whose effects cannot be scoped to one key — CTRL
+        (subcommands, not keys), membership (cluster state), unknown
+        commands, non-Bulk keys.  None tells run_chunk a deferred read
+        run cannot stay open across this command."""
+        if type(msg) is not Arr:
+            return None
+        items = msg.items
+        if len(items) < 2 or type(items[0]) is not Bulk or \
+                type(items[1]) is not Bulk:
+            return None
+        name = items[0].val
+        cmd = COMMANDS.get(name)
+        if cmd is None:
+            cmd = COMMANDS.get(name.lower())
+            if cmd is None:
+                return None
+        if cmd.flags & CMD_CTRL:
+            return None
+        if not cmd.families and not (cmd.flags & CMD_READONLY):
+            return None  # membership: meet/forget touch cluster state
+        return items[1].val
+
+    def _run_read_batch(self, specs: list, out: bytearray, spans,
+                        extras=None) -> None:
+        """Serve one planned read run as a batch — replies
+        byte-identical to the per-command path, emitted strictly in
+        request order (see the module docstring's read plane section).
+        `specs`: `(msg_index, msg, spec, name, key, extra, parsed,
+        uuid)` tuples from run_chunk (`uuid` pre-minted at the read's
+        stream position).  `extras`: reply bytes of commands executed
+        while the run stayed open — `(msg_index, payload)`, spliced
+        back at their exact positions."""
+        node = self.node
+        st = node.stats
+        # read-your-writes: the run must land first iff a read observes
+        # a key with pending rows; reads of un-pending keys commute
+        # with the whole pending run (the batched twin of
+        # SERVE_KEY_SCOPED_READS)
+        if self._pending:
+            pend = self._pending_keys
+            if any(sp[4] in pend for sp in specs):
+                self.flush()
+                st.serve_read_flushes += 1
+        ks = self.ks
+        rc = node.read_cache
+        use_cache = rc.enabled
+        n = len(specs)
+        if use_cache and len(rc):
+            # probe BEFORE any key resolution: a hit needs nothing but
+            # its stamp verify (the entry carries its kid), so hot-key
+            # batches skip the resolution/envelope machinery entirely.
+            # env must be host-fresh for the verify; probing is pure,
+            # so running it before the ticks cannot affect uuid parity.
+            node.ensure_flushed_for(_ENV_FAMS)
+            hits = rc.get_batch([(sp[3], sp[4], sp[5]) for sp in specs],
+                                ks)
+        else:
+            if use_cache:
+                rc.misses += n
+            hits = [None] * n
+        miss = [j for j in range(n) if hits[j] is None]
+        if not miss:
+            # the hot steady state: every reply spliced from the cache
+            # (ticks were minted at append time), stats batched
+            st.cmds_processed += n
+            st.serve_reads_coalesced += n
+            if extras:
+                self._emit_merged(specs, hits, extras, out, spans)
+                return
+            for payload in hits:
+                out += payload
+                if spans is not None:
+                    spans.append(len(out))
+            return
+        resolved: dict = {}
+        env: dict = {}
+        if miss:
+            # narrow device flush: only the families the MISSES observe
+            # (a clean resident plane serves the batch with zero flush
+            # downloads)
+            fams: set = set()
+            for j in miss:
+                fams.update(specs[j][2].families)
+            node.ensure_flushed_for(tuple(fams))
+            keys_cache = self._keys
+            # batched key resolution: one native index call for every
+            # missing key not already probed this chunk.  Entries
+            # created by the pending run (kid == -1) re-resolve — a
+            # flush above (or earlier in the chunk) may have landed
+            # them.
+            fresh: list = []
+            seen: set = set()
+            for j in miss:
+                key = specs[j][4]
+                ent = keys_cache.get(key)
+                if (ent is None or ent[0] < 0) and key not in seen:
+                    seen.add(key)
+                    fresh.append(key)
+            if fresh:
+                kids = ks.key_index.lookup_batch(fresh).tolist()
+                enc_col = ks.keys.enc
+                for key, kid in zip(fresh, kids):
+                    if kid >= 0:
+                        keys_cache[key] = (kid, int(enc_col[kid]))
+            # one envelope gather over the misses (alive / expiry-
+            # demote decisions) — scalar below the vectorization floor
+            for j in miss:
+                resolved[j] = keys_cache.get(specs[j][4], (-1, -1))
+            keys_t = ks.keys
+            if not keys_t.n:  # empty keyspace: every read is absent
+                for j in miss:
+                    env[j] = (0, 0, 0)
+            elif len(miss) < 16:
+                ct_c, dt_c, exp_c = keys_t.ct, keys_t.dt, keys_t.expire
+                for j in miss:
+                    kid = resolved[j][0]
+                    env[j] = (int(ct_c[kid]), int(dt_c[kid]),
+                              int(exp_c[kid])) if kid >= 0 else (0, 0, 0)
+            else:
+                kid_arr = np.fromiter((resolved[j][0] for j in miss),
+                                      dtype=_I64, count=len(miss))
+                safe = np.maximum(kid_arr, 0)
+                ct_l = keys_t.ct[safe].tolist()
+                dt_l = keys_t.dt[safe].tolist()
+                exp_l = keys_t.expire[safe].tolist()
+                for x, j in enumerate(miss):
+                    env[j] = (ct_l[x], dt_l[x], exp_l[x])
+        # the ordered walk: demotions, hit emits, and miss bucketing
+        # happen in request order (ticks were already minted at append
+        # time, so the HLC stream is exactly the per-command path's)
+        slots: list = [None] * n
+        cacheable: list = [False] * n
+        miss_scan: list = []   # el-family full scans (members/pairs/...)
+        miss_probe: list = []  # el-family combo probes (hget/sismember)
+        miss_cnt: list = []    # counter totals (one cnt_sum gather)
+        miss_reg: list = []    # register blobs
+        planned = 0  # stats batched after the walk (the walk is hot)
+        for j, sp in enumerate(specs):
+            payload = hits[j]
+            if payload is not None:
+                planned += 1
+                slots[j] = payload
+                continue
+            i, msg, spec, name, key, extra, parsed, pre = sp
+            kid, enc = resolved[j]
+            ct_j, dt_j, exp_j = env[j]
+            alive = kid >= 0 and ct_j >= dt_j
+            kind = spec.kind
+            if kid >= 0 and exp_j:
+                demote = True  # expiry-armed: time-dependent visibility
+            elif kind == "get":
+                demote = alive and enc not in (S.ENC_BYTES, S.ENC_COUNTER)
+            elif kind in ("lrange", "llen"):
+                demote = alive and enc != spec.enc
+            else:
+                demote = kid >= 0 and enc != spec.enc
+            if demote:
+                # the exact per-command path raises the exact op error
+                # (InvalidType) / applies the exact lazy expiry; only
+                # ever its OWN key's state, so the batched gathers
+                # below stay coherent (expiry-armed keys never gather).
+                # The pre-minted uuid keeps tick parity: execute() skips
+                # its own tick and sees the exact per-command uuid.
+                self._cur_uuid = pre
+                buf = bytearray()
+                self._exec(msg, buf)
+                self._cur_uuid = None
+                slots[j] = bytes(buf)
+                continue
+            # planned: the reply comes from the batched gathers (the
+            # read's tick already happened at its stream position)
+            planned += 1
+            const = None
+            if kind == "get":
+                if not alive:
+                    const = _NIL_BYTES
+                elif enc == S.ENC_COUNTER:
+                    slots[j] = ("cnt", len(miss_cnt))
+                    miss_cnt.append(kid)
+                else:
+                    slots[j] = ("reg", len(miss_reg))
+                    miss_reg.append(kid)
+            elif kind in ("elemget", "ismember"):
+                if kid < 0:
+                    const = _NIL_BYTES if kind == "elemget" \
+                        else _INT0_BYTES
+                else:
+                    slots[j] = ("probe", len(miss_probe))
+                    miss_probe.append((j, kid, extra))
+            else:  # members / pairs / card / lrange / llen scans
+                if kid < 0:
+                    const = {"members": _NIL_BYTES,
+                             "pairs": _NIL_BYTES,
+                             "card": _INT0_BYTES,
+                             "lrange": _EMPTY_ARR_BYTES,
+                             "llen": _INT0_BYTES}[kind]
+                elif kind in ("lrange", "llen") and not alive:
+                    const = _EMPTY_ARR_BYTES if kind == "lrange" \
+                        else _INT0_BYTES
+                else:
+                    slots[j] = ("scan", len(miss_scan))
+                    miss_scan.append((j, kid))
+            if const is not None:
+                # fixed reply (absent or dead key): cacheable like any
+                # other — absence/deadness is part of the stamp
+                slots[j] = const
+                if use_cache:
+                    rc.put(name, key, extra, kid, ks, const,
+                           env=(ct_j, dt_j))
+            elif use_cache:
+                cacheable[j] = True
+        st.cmds_processed += planned
+        st.serve_reads_coalesced += planned
+        # ---- vectorized family gathers for the misses
+        scan_rows: list = []
+        if miss_scan:
+            scan_rows = ks.elem_live_rows_batch([m[1] for m in miss_scan])
+        probe_rows = probe_alive = None
+        if miss_probe:
+            probe_rows, probe_alive = ks.elem_probe_batch(
+                np.fromiter((m[1] for m in miss_probe), dtype=_I64,
+                            count=len(miss_probe)),
+                [m[2] for m in miss_probe])
+        cnt_vals: list = []
+        if miss_cnt:
+            cnt_vals = ks.counter_sum_batch(
+                np.fromiter(miss_cnt, dtype=_I64, count=len(miss_cnt)))
+        reg_vals: list = []
+        if miss_reg:
+            reg_vals = ks.register_get_batch(miss_reg)
+        # ---- stitch: encode miss replies, emit everything in order
+        # (splicing deferred non-read replies back at their exact
+        # positions), fill the cache from the just-encoded bytes
+        el_member, el_val = ks.el_member, ks.el_val
+        ei, ne = 0, len(extras) if extras else 0
+        for j, sp in enumerate(specs):
+            while ei < ne and extras[ei][0] < sp[0]:
+                out += extras[ei][1]
+                if spans is not None:
+                    spans.append(len(out))
+                ei += 1
+            slot = slots[j]
+            if type(slot) is tuple:
+                kind, ref = slot
+                spec = sp[2]
+                if kind == "cnt":
+                    reply = Int(cnt_vals[ref])
+                elif kind == "reg":
+                    v = reg_vals[ref]
+                    reply = Bulk(v if v is not None else b"")
+                elif kind == "probe":
+                    row = int(probe_rows[ref])
+                    ok = row >= 0 and bool(probe_alive[ref])
+                    if spec.kind == "ismember":
+                        reply = Int(1 if ok else 0)
+                    else:
+                        v = el_val[row] if ok else None
+                        reply = Bulk(v) if v is not None else NIL
+                else:  # scan
+                    rows = scan_rows[ref].tolist()
+                    k2 = spec.kind
+                    if k2 == "members":
+                        reply = Arr([Bulk(el_member[r]) for r in rows])
+                    elif k2 == "card":
+                        reply = Int(len(rows))
+                    elif k2 == "llen":
+                        reply = Int(len(rows))
+                    elif k2 == "pairs":
+                        reply = Arr([Arr([Bulk(el_member[r]),
+                                          Bulk(el_val[r]
+                                               if el_val[r] is not None
+                                               else b"")])
+                                     for r in rows])
+                    else:  # lrange — the handler's sort + slice, exactly
+                        live = sorted((el_member[r], el_val[r])
+                                      for r in rows)
+                        start, stop = sp[6]
+                        nv = len(live)
+                        if start < 0:
+                            start += nv
+                        if stop < 0:
+                            stop += nv
+                        start = max(0, start)
+                        if stop < start:
+                            reply = Arr([])
+                        else:
+                            reply = Arr([Bulk(v if v is not None else b"")
+                                         for _m, v in
+                                         live[start:stop + 1]])
+                pos = len(out)
+                encode_into(out, reply)
+                if cacheable[j]:
+                    e = env[j]
+                    rc.put(sp[3], sp[4], sp[5], resolved[j][0], ks,
+                           bytes(out[pos:]), env=(e[0], e[1]))
+            else:
+                out += slot
+            if spans is not None:
+                spans.append(len(out))
+        while ei < ne:
+            out += extras[ei][1]
+            if spans is not None:
+                spans.append(len(out))
+            ei += 1
+
+    def _emit_merged(self, specs: list, hits: list, extras: list,
+                     out: bytearray, spans) -> None:
+        """All-hit emission with deferred replies spliced back in
+        request order (the fast-path twin of the stitch loop's merge)."""
+        ei, ne = 0, len(extras)
+        for sp, payload in zip(specs, hits):
+            while ei < ne and extras[ei][0] < sp[0]:
+                out += extras[ei][1]
+                if spans is not None:
+                    spans.append(len(out))
+                ei += 1
+            out += payload
+            if spans is not None:
+                spans.append(len(out))
+        while ei < ne:
+            out += extras[ei][1]
+            if spans is not None:
+                spans.append(len(out))
+            ei += 1
 
     def _exec(self, msg, out: bytearray, count_barrier: bool = True,
               invalidate: bool = True) -> None:

@@ -55,7 +55,11 @@ log = logging.getLogger(__name__)
 
 _STAT_GAUGES = (("msgs", "msgs"), ("flushes", "flushes"),
                 ("barriers", "barriers"), ("keys", "keys"),
-                ("used_bytes", "used_bytes"))
+                ("used_bytes", "used_bytes"),
+                ("reads", "reads"), ("read_flushes", "read_flushes"),
+                ("cache_hits", "cache_hits"),
+                ("cache_misses", "cache_misses"),
+                ("cache_bytes", "cache_bytes"))
 
 
 class _Sub:
@@ -306,6 +310,17 @@ class ServeShardPlane:
         st.serve_msgs_coalesced += stats["msgs"] - last.get("msgs", 0)
         st.serve_flushes += stats["flushes"] - last.get("flushes", 0)
         st.serve_barriers += stats["barriers"] - last.get("barriers", 0)
+        # read-plane worker deltas fold into the node totals: the stat
+        # counters directly, the cache counters into the parent's cache
+        # object (unused for serving in sharded mode, so its own counts
+        # stay zero and the fold IS the node total)
+        st.serve_reads_coalesced += stats["reads"] - last.get("reads", 0)
+        st.serve_read_flushes += \
+            stats["read_flushes"] - last.get("read_flushes", 0)
+        rc = node.read_cache
+        rc.hits += stats["cache_hits"] - last.get("cache_hits", 0)
+        rc.misses += stats["cache_misses"] - last.get("cache_misses", 0)
+        rc.invalidations += stats["cache_inv"] - last.get("cache_inv", 0)
         st.repl_apply_barriers += \
             stats["apply_barriers"] - last.get("apply_barriers", 0)
         st.oom_shed_writes += stats["oom_shed"] - last.get("oom_shed", 0)

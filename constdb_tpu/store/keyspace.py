@@ -814,6 +814,98 @@ class KeySpace:
                    int(self.el.add_node[row]), int(self.el.del_t[row]),
                    self.el_val[row])
 
+    # ------------------------------------------------- batched read gathers
+    # The serve coalescer's read planner (server/serve.py) resolves a
+    # whole pipelined read run against the columns in a handful of
+    # vectorized passes instead of per-command (and per-member) hash
+    # probes + scalar reads.  Each gather is the exact batch twin of the
+    # single-op read above it — same row order, same liveness rule — so
+    # planned replies are byte-identical to the per-command path's.
+
+    def register_get_batch(self, kids) -> list:
+        """Register blobs for a batch of kids (`register_get` twin)."""
+        reg = self.reg_val
+        return [reg[kid] for kid in kids]
+
+    def counter_sum_batch(self, kid_arr: np.ndarray) -> list[int]:
+        """Visible counter totals for a batch of kids in one gather off
+        the incrementally-maintained sum column (`counter_sum` twin —
+        the slot/bincount machinery keeps `cnt_sum` exact through every
+        merge path)."""
+        if len(kid_arr) < 8:  # below the fancy-index floor
+            col = self.keys.cnt_sum
+            return [int(col[kid]) for kid in kid_arr]
+        return self.keys.cnt_sum[kid_arr].tolist()
+
+    def elem_live_rows_batch(self, kids) -> list[np.ndarray]:
+        """Live element rows per kid, in row (append) order — the batch
+        twin of iterating `elem_live`: one concatenated mask over
+        `add_t >= del_t` plus the compaction-staleness kid check
+        replaces per-row scalar reads."""
+        self._sync_el_lists()
+        by_kid = self.el_rows_by_kid
+        per = [by_kid.get(kid, ()) for kid in kids]
+        counts = [len(p) for p in per]
+        total = sum(counts)
+        if not total:
+            return [np.empty(0, dtype=_I64) for _ in kids]
+        if total < 64:
+            # below the vectorization floor the array setup costs more
+            # than the scalar walk it replaces (a fragmented read run
+            # gathers a couple of small sets per batch)
+            el_kid, add_t, del_t = self.el.kid, self.el.add_t, self.el.del_t
+            return [np.fromiter(
+                (r for r in p
+                 if el_kid[r] == kid and add_t[r] >= del_t[r]),
+                dtype=_I64) for kid, p in zip(kids, per)]
+        rows = np.empty(total, dtype=_I64)
+        pos = 0
+        for p, c in zip(per, counts):
+            if c:
+                rows[pos:pos + c] = p
+                pos += c
+        el = self.el
+        owner = np.repeat(np.asarray(kids, dtype=_I64),
+                          np.asarray(counts, dtype=_I64))
+        live = (el.kid[rows] == owner) & (el.add_t[rows] >= el.del_t[rows])
+        out = []
+        pos = 0
+        for c in counts:
+            sl = rows[pos:pos + c]
+            out.append(sl[live[pos:pos + c]])
+            pos += c
+        return out
+
+    def elem_probe_batch(self, kid_arr: np.ndarray,
+                         members: list) -> tuple[np.ndarray, np.ndarray]:
+        """(row, alive) per (kid, member) pair — the batch twin of
+        `el_row` + `elem_alive` (HGET / SISMEMBER probes): one member
+        interner batch + one combo-index batch replaces two hash probes
+        per command.  Rows are -1 for unknown members/combos."""
+        n = len(members)
+        if n < 8:
+            # scalar twin below the vectorization floor (same liveness
+            # rule, no array setup)
+            rows = np.full(n, -1, dtype=_I64)
+            alive = np.zeros(n, dtype=bool)
+            el = self.el
+            for x in range(n):
+                row = self.el_row(int(kid_arr[x]), members[x])
+                if row >= 0:
+                    rows[x] = row
+                    alive[x] = el.add_t[row] >= el.del_t[row]
+            return rows, alive
+        mids = self.member_index.lookup_batch(members)
+        combos = (kid_arr << self.MEMBER_BITS) | mids
+        rows = self.el_index.lookup_batch(combos)
+        rows[mids < 0] = -1
+        alive = np.zeros(len(rows), dtype=bool)
+        hit = rows >= 0
+        if hit.any():
+            hr = rows[hit]
+            alive[hit] = self.el.add_t[hr] >= self.el.del_t[hr]
+        return rows, alive
+
     def elem_merge(self, kid: int, member: bytes, add_t: int, add_node: int,
                    del_t: int, val: Optional[bytes]) -> None:
         """State-merge of one foreign element (CPU merge engine)."""

@@ -11,13 +11,17 @@
 // scans from `pos` and returns (messages, new_pos, fallback):
 //   * messages — list of fully-constructed message objects (instances
 //     built via tp_alloc + slot set, skipping __init__ bytecode).
-//     Top-level coverage: flat `*N` command arrays of bulks/ints, plus
-//     the reply types `+simple`, `-err`, `:int`, `$bulk`, `$-1` (nil) —
-//     i.e. both directions of the protocol;
+//     Coverage: the full value grammar recursively — `*N` arrays
+//     (including `*0` → Arr([]) and `*-1` → nil, nested to a small C
+//     depth cap), `+simple`, `-err`, `:int`, `$bulk`, `$-1` (nil) —
+//     i.e. both directions of the protocol, commands AND replies
+//     (r18: reply arrays used to defer on `*0`/nesting, which made
+//     every pipelined read client pay the pure-parser price for empty
+//     and hash-pair replies);
 //   * new_pos  — first unconsumed byte (a partial trailing message is
 //     left unconsumed);
 //   * fallback — true when the next message needs the general parser:
-//     nested array, `*0`/`*-1`, unknown type byte, or ANY shape this fast
+//     over-deep nesting, unknown type byte, or ANY shape this fast
 //     path cannot parse cleanly (overlong integers, malformed framing,
 //     oversized bulks...).  The pure-Python parser is the semantics
 //     reference — it either accepts what C was too strict for (e.g. a
@@ -108,6 +112,132 @@ inline int int_line(const char* b, Py_ssize_t len, Py_ssize_t p,
     return 1;
 }
 
+// the C recursion cap for nested reply arrays: well under the pure
+// parser's max_depth=32, so anything deeper defers (the pure parser
+// then builds it or raises "nesting too deep" — identical either way)
+constexpr int kMaxCDepth = 8;
+
+struct ParseCtx {
+    const char* b;
+    Py_ssize_t len;
+    PyObject *arr_t, *bulk_t, *int_t, *simple_t, *err_t, *nil_obj;
+    long long bulk_cap;
+};
+
+// Parse ONE value of the RESP grammar starting at *pos.
+// Returns: 1 ok (*out set, *pos advanced), 0 need-more, -1 defer to the
+// pure parser, -2 CPython error (exception set).  *pos is only advanced
+// on success; `fullsync` (top-level arrays only) reports a frame whose
+// first element is the bulk "fullsync" — raw snapshot bytes follow it on
+// the stream, so the caller must stop the batch scan there.
+inline int parse_any(const ParseCtx& c, Py_ssize_t* pos, int depth,
+                     PyObject** out, bool* fullsync) {
+    if (*pos >= c.len) return 0;
+    const char* b = c.b;
+    const Py_ssize_t len = c.len;
+    Names& nm = names();
+    const char t = b[*pos];
+    if (t == '+' || t == '-') {
+        // simple / error line.  The pure parser's _line scans for the
+        // CRLF PAIR, so a bare CR inside the line is part of the payload
+        // there — defer rather than diverge.
+        const char* cr = static_cast<const char*>(
+            memchr(b + *pos, '\r', static_cast<size_t>(len - *pos)));
+        if (!cr || cr - b + 1 >= len) {
+            if (len - *pos > kMaxLine) return -1;  // pure parser raises
+            return 0;
+        }
+        Py_ssize_t e = cr - b;
+        if (b[e + 1] != '\n') return -1;
+        PyObject* obj = make1(
+            t == '+' ? c.simple_t : c.err_t, nm.val,
+            PyBytes_FromStringAndSize(b + *pos + 1, e - *pos - 1));
+        if (!obj) return -2;
+        *out = obj;
+        *pos = e + 2;
+        return 1;
+    }
+    if (t == ':') {
+        long long v;
+        Py_ssize_t q;
+        int st = int_line(b, len, *pos + 1, &v, &q);
+        if (st <= 0) return st;
+        PyObject* obj = make1(c.int_t, nm.val, PyLong_FromLongLong(v));
+        if (!obj) return -2;
+        *out = obj;
+        *pos = q;
+        return 1;
+    }
+    if (t == '$') {
+        long long ln;
+        Py_ssize_t q;
+        int st = int_line(b, len, *pos + 1, &ln, &q);
+        if (st <= 0) return st;
+        if (ln < 0) {
+            if (ln != -1) return -1;  // pure parser raises
+            Py_INCREF(c.nil_obj);
+            *out = c.nil_obj;
+            *pos = q;
+            return 1;
+        }
+        if (ln > c.bulk_cap) return -1;  // pure parser raises "too large"
+        if (q + ln + 2 > len) return 0;  // need more
+        if (b[q + ln] != '\r' || b[q + ln + 1] != '\n')
+            return -1;  // pure parser raises "missing CRLF"
+        PyObject* obj = make1(c.bulk_t, nm.val,
+                              PyBytes_FromStringAndSize(b + q, ln));
+        if (!obj) return -2;
+        *out = obj;
+        *pos = q + ln + 2;
+        return 1;
+    }
+    if (t != '*') return -1;  // unknown type byte: pure parser raises
+    if (depth >= kMaxCDepth) return -1;  // pure parser handles/raises
+    long long cnt;
+    Py_ssize_t p;
+    int st = int_line(b, len, *pos + 1, &cnt, &p);
+    if (st <= 0) return st;
+    if (cnt < 0) {
+        if (cnt != -1) return -1;  // pure parser raises
+        Py_INCREF(c.nil_obj);
+        *out = c.nil_obj;
+        *pos = p;
+        return 1;
+    }
+    if (cnt > kMaxArr) return -1;  // pure parser raises "too large"
+    PyObject* items = PyList_New(cnt);
+    if (!items) return -2;
+    for (long long i = 0; i < cnt; i++) {
+        PyObject* obj = nullptr;
+        int st2 = parse_any(c, &p, depth + 1, &obj, nullptr);
+        if (st2 != 1) {
+            Py_DECREF(items);  // safe: unfilled tail slots are NULL
+            return st2;
+        }
+        PyList_SET_ITEM(items, i, obj);
+        // a FULLSYNC frame is followed by RAW (non-RESP) snapshot bytes
+        // on the same stream; scanning past it would consume them as
+        // frames (replica/link.py drains them via take_raw)
+        if (i == 0 && fullsync != nullptr && Py_TYPE(obj) ==
+                reinterpret_cast<PyTypeObject*>(c.bulk_t)) {
+            PyObject* v = PyObject_GetAttr(obj, nm.val);
+            if (!v) {
+                Py_DECREF(items);
+                return -2;
+            }
+            if (PyBytes_Check(v) && PyBytes_GET_SIZE(v) == 8 &&
+                strncasecmp(PyBytes_AS_STRING(v), "fullsync", 8) == 0)
+                *fullsync = true;
+            Py_DECREF(v);
+        }
+    }
+    PyObject* arr = make1(c.arr_t, nm.items, items);
+    if (!arr) return -2;
+    *out = arr;
+    *pos = p;
+    return 1;
+}
+
 }  // namespace resp
 
 static PyObject* py_resp_parse(PyObject*, PyObject* args) {
@@ -127,9 +257,9 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
     const long long bulk_cap =
         (max_bulk > 0 && max_bulk < resp::kMaxBulk) ? max_bulk
                                                     : resp::kMaxBulk;
-    const char* b = static_cast<const char*>(view.buf);
-    const Py_ssize_t len = view.len;
-    resp::Names& nm = resp::names();
+    resp::ParseCtx ctx{static_cast<const char*>(view.buf), view.len,
+                       arr_t, bulk_t, int_t, simple_t, err_t, nil_obj,
+                       bulk_cap};
 
     PyObject* out = PyList_New(0);
     int fallback = 0;
@@ -138,195 +268,22 @@ static PyObject* py_resp_parse(PyObject*, PyObject* args) {
         return nullptr;
     }
 
-    while (PyList_GET_SIZE(out) < max_msgs && pos < len) {
-        char top = b[pos];
-        if (top == '+' || top == '-') {
-            // simple / error line reply.  The pure parser's _line scans
-            // for the CRLF PAIR, so a bare CR inside the line is part of
-            // the payload there — defer rather than diverge.
-            const char* cr = static_cast<const char*>(memchr(
-                b + pos, '\r', static_cast<size_t>(len - pos)));
-            if (!cr || cr - b + 1 >= len) {
-                if (len - pos > resp::kMaxLine) {
-                    fallback = 1;  // pure parser raises "line too long"
-                    break;
-                }
-                break;  // need more
-            }
-            Py_ssize_t e = cr - b;
-            if (b[e + 1] != '\n') {
-                fallback = 1;
-                break;
-            }
-            PyObject* obj = resp::make1(
-                top == '+' ? simple_t : err_t, nm.val,
-                PyBytes_FromStringAndSize(b + pos + 1, e - pos - 1));
-            if (!obj) goto fail;
-            int rc = PyList_Append(out, obj);
-            Py_DECREF(obj);
-            if (rc != 0) goto fail;
-            pos = e + 2;
-            continue;
-        }
-        if (top == ':') {
-            long long v;
-            Py_ssize_t q;
-            int st = resp::int_line(b, len, pos + 1, &v, &q);
-            if (st < 0) {
-                fallback = 1;
-                break;
-            }
-            if (st == 0) break;
-            PyObject* obj = resp::make1(int_t, nm.val,
-                                        PyLong_FromLongLong(v));
-            if (!obj) goto fail;
-            int rc = PyList_Append(out, obj);
-            Py_DECREF(obj);
-            if (rc != 0) goto fail;
-            pos = q;
-            continue;
-        }
-        if (top == '$') {
-            long long ln;
-            Py_ssize_t q;
-            int st = resp::int_line(b, len, pos + 1, &ln, &q);
-            if (st < 0) {
-                fallback = 1;
-                break;
-            }
-            if (st == 0) break;
-            PyObject* obj;
-            if (ln < 0) {
-                if (ln != -1) {
-                    fallback = 1;  // pure parser raises
-                    break;
-                }
-                Py_INCREF(nil_obj);
-                obj = nil_obj;
-            } else {
-                if (ln > bulk_cap) {
-                    fallback = 1;  // pure parser raises "too large"
-                    break;
-                }
-                if (q + ln + 2 > len) break;  // need more
-                if (b[q + ln] != '\r' || b[q + ln + 1] != '\n') {
-                    fallback = 1;  // pure parser raises "missing CRLF"
-                    break;
-                }
-                obj = resp::make1(bulk_t, nm.val,
-                                  PyBytes_FromStringAndSize(b + q, ln));
-                if (!obj) goto fail;
-                q += ln + 2;
-            }
-            int rc = PyList_Append(out, obj);
-            Py_DECREF(obj);
-            if (rc != 0) goto fail;
-            pos = q;
-            continue;
-        }
-        if (top != '*') {
-            fallback = 1;
+    while (PyList_GET_SIZE(out) < max_msgs && pos < ctx.len) {
+        PyObject* obj = nullptr;
+        bool is_fullsync = false;
+        Py_ssize_t p = pos;
+        int st = resp::parse_any(ctx, &p, 0, &obj, &is_fullsync);
+        if (st == 0) break;  // partial trailing message: need more bytes
+        if (st == -1) {
+            fallback = 1;  // defer this message to the pure parser
             break;
         }
-        long long cnt;
-        Py_ssize_t p;
-        int st = resp::int_line(b, len, pos + 1, &cnt, &p);
-        if (st <= 0) {
-            if (st < 0) fallback = 1;
-            break;  // need more bytes, or defer to the pure parser
-        }
-        if (cnt <= 0 || cnt > resp::kMaxArr) {
-            fallback = 1;  // *0 / *-1 / oversized: general parser
-            break;
-        }
-        {
-            PyObject* items = PyList_New(cnt);
-            if (!items) goto fail;
-            bool partial = false, fb = false, is_fullsync = false;
-            for (long long i = 0; i < cnt; i++) {
-                if (p >= len) {
-                    partial = true;
-                    break;
-                }
-                char c = b[p];
-                if (c == '$') {
-                    long long ln;
-                    Py_ssize_t q;
-                    st = resp::int_line(b, len, p + 1, &ln, &q);
-                    if (st < 0) {
-                        fb = true;
-                        break;
-                    }
-                    if (st == 0) {
-                        partial = true;
-                        break;
-                    }
-                    if (ln < 0 || ln > bulk_cap) {
-                        fb = true;  // $-1 / oversized: general path
-                        break;
-                    }
-                    if (q + ln + 2 > len) {
-                        partial = true;
-                        break;
-                    }
-                    if (b[q + ln] != '\r' || b[q + ln + 1] != '\n') {
-                        fb = true;  // pure parser raises "missing CRLF"
-                        break;
-                    }
-                    PyObject* obj = resp::make1(
-                        bulk_t, nm.val,
-                        PyBytes_FromStringAndSize(b + q, ln));
-                    if (!obj) {
-                        Py_DECREF(items);
-                        goto fail;
-                    }
-                    PyList_SET_ITEM(items, i, obj);
-                    // a FULLSYNC frame is followed by RAW (non-RESP)
-                    // snapshot bytes on the same stream; scanning past it
-                    // would consume them as frames (replica/link.py drains
-                    // them via take_raw) — stop the batch scan here
-                    if (i == 0 && ln == 8 &&
-                        strncasecmp(b + q, "fullsync", 8) == 0)
-                        is_fullsync = true;
-                    p = q + ln + 2;
-                } else if (c == ':') {
-                    long long v;
-                    Py_ssize_t q;
-                    st = resp::int_line(b, len, p + 1, &v, &q);
-                    if (st < 0) {
-                        fb = true;
-                        break;
-                    }
-                    if (st == 0) {
-                        partial = true;
-                        break;
-                    }
-                    PyObject* obj = resp::make1(int_t, nm.val,
-                                                PyLong_FromLongLong(v));
-                    if (!obj) {
-                        Py_DECREF(items);
-                        goto fail;
-                    }
-                    PyList_SET_ITEM(items, i, obj);
-                    p = q;
-                } else {
-                    fb = true;  // nested array / inline type: general path
-                    break;
-                }
-            }
-            if (partial || fb) {
-                Py_DECREF(items);  // safe: unfilled tail slots are NULL
-                if (fb) fallback = 1;
-                break;
-            }
-            PyObject* arr = resp::make1(arr_t, nm.items, items);
-            if (!arr) goto fail;
-            int rc = PyList_Append(out, arr);
-            Py_DECREF(arr);
-            if (rc != 0) goto fail;
-            pos = p;
-            if (is_fullsync) break;  // raw snapshot bytes follow
-        }
+        if (st == -2) goto fail;
+        int rc = PyList_Append(out, obj);
+        Py_DECREF(obj);
+        if (rc != 0) goto fail;
+        pos = p;
+        if (is_fullsync) break;  // raw snapshot bytes follow
     }
 
     PyBuffer_Release(&view);

@@ -49,6 +49,39 @@ print("serve-shards smoke verified:",
 EOF
 
 echo
+echo "== read-path smoke (bench --mode serve --read-pct 90) =="
+# tiny oracle-verified run of the coalesced read plane over real
+# sockets: a mixed 90:10 pipelined workload on the coalesced+cache,
+# cache-off, and per-command legs — every reply stream and the
+# timestamp-stripped export must match the per-command reference
+# byte-for-byte (a stale cached serve is an oracle MISMATCH, not a
+# slowdown), the read planner must actually engage, and the cache must
+# serve real hits (the differential suite proper runs inside tier-1 —
+# tests/test_read_path.py)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_SERVE_OPS=6000 CONSTDB_BENCH_SERVE_CONNS=2 \
+CONSTDB_BENCH_SERVE_REPS=1 \
+    timeout -k 10 300 python bench.py --mode serve --read-pct 90 \
+    > /tmp/_ci_read.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_read.json"))
+assert out["verified"], "read-path smoke failed oracle verification"
+leg = out["curve"][0]
+assert leg["cache"]["replies_ok"] and leg["nocache"]["replies_ok"], \
+    "stale replies on a coalesced read leg"
+assert leg["cache"]["serve_reads_coalesced"] > 0, \
+    "read planner never engaged"
+assert leg["cache"]["read_cache_hits"] > 0, "reply cache never hit"
+assert leg["nocache"]["read_cache_hits"] == 0, \
+    "disabled cache served hits"
+print("read-path smoke verified:",
+      f"{leg['cache']['rps']:,.0f} req/s cached /",
+      f"{leg['percmd']['rps']:,.0f} per-command =",
+      f"{leg['speedup_vs_percmd']}x, hit rate {leg['cache_hit_rate']},",
+      f"{leg['cache']['serve_reads_coalesced']} planned reads")
+EOF
+
+echo
 echo "== resync smoke (bench --mode resync) =="
 # tiny oracle-verified run of the digest-negotiated delta resync vs the
 # full-snapshot leg through the REAL push loop: both pullers must

@@ -221,8 +221,15 @@ def test_reply_order_and_read_your_writes(tmp_path):
             assert [m.val for m in r[7].items] == [b"a"]
             assert r[8] == Int(1)
             assert r[9].items == []
-            # both reads acted as barriers over a pending run
-            assert node.stats.serve_barriers >= 4
+            # every read was served by the planned read path (round 18:
+            # reads are no longer barriers).  The first get/smembers
+            # each observed a pending run and forced a read-your-writes
+            # land; the second of each followed an ISOLATED write
+            # (executed per-command by choice), so nothing was pending
+            # and no flush was needed — still byte-exact
+            assert node.stats.serve_reads_coalesced == 4
+            assert node.stats.serve_read_flushes == 2
+            assert node.stats.serve_barriers == 0
         finally:
             await c.close()
             await app.close()

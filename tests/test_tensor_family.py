@@ -556,8 +556,13 @@ def test_tensor_get_serves_from_device_without_flush():
     CpuMergeEngine().merge_many(ref, [make_batch(rows, cfg, 32)])
     want = ref.tensor_read(ref.lookup(b"t0001"))
     assert got.val == want.tobytes()
-    # a non-tensor command still takes the blanket flush barrier
+    # a family-listed scalar read flushes NARROWLY (round 18:
+    # READ_FLUSH_FAMILIES) — GET observes env/reg/cnt only, so the
+    # resident tensor rows stay dirty on device
     node.execute(cmd(b"get", b"t0001"))
+    assert eng.needs_flush and eng.flush_rows_downloaded == 0
+    # an unlisted read (desc) still takes the blanket flush barrier
+    node.execute(cmd(b"desc", b"t0001"))
     assert not eng.needs_flush
     eng.close()
 
