@@ -1711,6 +1711,8 @@ def _serve_bench_server(pipe, serve_batch: int, engine_kind: str,
             "read_cache_bytes": node.read_cache.bytes,
             "read_cache_invalidations": node.read_cache.invalidations,
             "cmds_processed": st.cmds_processed,
+            "native_intake_chunks": st.native_intake_chunks,
+            "native_intake_msgs": st.native_intake_msgs,
             "oom_shed_writes": st.oom_shed_writes,
             "oom_hard_reclaims": st.oom_hard_reclaims,
             "used_memory": node.governor.used_memory(),
@@ -2292,6 +2294,298 @@ def serve_aof_main(args) -> None:
             sys.exit(1)
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# --mode intake: the native intake plane (BENCH_r19).  Serve legs with the
+# C intake stage ON vs OFF (CONSTDB_NATIVE_INTAKE) plus the full-fallback
+# CONSTDB_NO_NATIVE=1 leg, interleaved best-of-N, reply-stream + stripped-
+# export oracle across ALL legs; wire legs time the REPLBATCH blob codec
+# hot loops (native/wire.cpp) against the pure pack/unpack with encoded-
+# byte identity as the oracle.
+# ---------------------------------------------------------------------------
+
+
+def _intake_wire_legs(reps: int = 3) -> dict:
+    """In-process REPLBATCH codec legs: group-encode + decode a real
+    repl-log entry stream with the native blob pack/unpack pinned OFF
+    (pure Python) and ON, byte-identical encoded payloads required.
+    Decode verifies via a column digest of every decoded batch."""
+    import hashlib
+
+    import constdb_tpu.replica.wire as W
+    from constdb_tpu.server.node import Node
+
+    n_frames = int(os.environ.get("CONSTDB_BENCH_INTAKE_FRAMES", 60_000))
+    run_len = int(os.environ.get("CONSTDB_BENCH_WIRE_BATCH", 512))
+
+    # a real encodable entry stream: plannable writes only, driven
+    # through a live node so the entries are genuine LogEntry rows
+    from constdb_tpu.resp.message import Arr, Bulk
+    rng = np.random.default_rng(19)
+    node = Node(node_id=1, alias="bench")
+    for i in range(n_frames):
+        k = b"w%d" % int(rng.integers(0, 4096))
+        r = rng.random()
+        if r < 0.40:
+            body = (b"set", k, b"v%d" % i)
+        elif r < 0.60:
+            body = (b"incr", k + b":c")
+        elif r < 0.80:
+            body = (b"sadd", b"s" + k, b"m%d" % int(rng.integers(0, 64)))
+        else:
+            body = (b"hset", b"h" + k, b"f%d" % int(rng.integers(0, 16)),
+                    b"v%d" % i)
+        node.execute(Arr([Bulk(b) for b in body]))
+    entries = list(node.repl_log._entries)
+    runs = [entries[i:i + run_len]
+            for i in range(0, len(entries), run_len)]
+
+    def batch_digest(wb) -> bytes:
+        h = hashlib.sha256()
+        b = wb.batch
+        for key in b.keys:
+            h.update(key)
+        for col in (b.key_enc, b.key_ct, b.key_mt, b.key_dt,
+                    b.cnt_ki, b.cnt_val, b.cnt_uuid,
+                    b.el_ki, b.el_add_t):
+            h.update(np.ascontiguousarray(col).tobytes())
+        for m in b.el_member:
+            h.update(m or b"\0")
+        return h.digest()
+
+    def one_leg(native: bool):
+        # pin the codec tier for this leg: [None] forces the pure
+        # pack/unpack, a cleared cache re-resolves the extension
+        W._WIRE_NATIVE_CACHE[:] = []
+        if not native:
+            W._WIRE_NATIVE_CACHE.append(None)
+        enc_t = dec_t = 0.0
+        payloads = []
+        t0 = time.perf_counter()
+        for run in runs:
+            payloads.append(W.build_wire_batch(run, 1))
+        enc_t = time.perf_counter() - t0
+        assert all(p is not None for p in payloads), \
+            "encodable run demoted during the wire bench"
+        sink = Node(node_id=2, alias="sink")
+        digests = []
+        t0 = time.perf_counter()
+        for run, payload in zip(runs, payloads):
+            wb = W.decode_wire_batch(payload, sink.ks, 1,
+                                     run[0].prev_uuid)
+            digests.append(wb)
+        dec_t = time.perf_counter() - t0
+        digests = [batch_digest(wb) for wb in digests]
+        return enc_t, dec_t, payloads, digests
+
+    best = {True: None, False: None}
+    oracle_ok = True
+    for _rep in range(reps):
+        for native in (True, False):
+            enc_t, dec_t, payloads, digests = one_leg(native)
+            cur = best[native]
+            if cur is None or enc_t + dec_t < cur[0] + cur[1]:
+                best[native] = (enc_t, dec_t, payloads, digests)
+    W._WIRE_NATIVE_CACHE[:] = []  # leave the product tiering untouched
+    n_enc, n_dec, n_pl, n_dg = best[True]
+    p_enc, p_dec, p_pl, p_dg = best[False]
+    oracle_ok = n_pl == p_pl and n_dg == p_dg
+    frames = len(entries)
+    return {
+        "frames": frames,
+        "runs": len(runs),
+        "wire_batch": run_len,
+        "payload_bytes": sum(len(p) for p in n_pl),
+        "native": {"encode_s": round(n_enc, 4),
+                   "decode_s": round(n_dec, 4),
+                   "encode_frames_per_sec": round(frames / n_enc, 1),
+                   "decode_frames_per_sec": round(frames / n_dec, 1)},
+        "pure": {"encode_s": round(p_enc, 4),
+                 "decode_s": round(p_dec, 4),
+                 "encode_frames_per_sec": round(frames / p_enc, 1),
+                 "decode_frames_per_sec": round(frames / p_dec, 1)},
+        "encode_speedup": round(p_enc / n_enc, 2),
+        "decode_speedup": round(p_dec / n_dec, 2),
+        "verified": oracle_ok,
+    }
+
+
+def _intake_stage_legs(per_conn: list, reps: int = 3) -> dict:
+    """The intake STAGE in isolation: split + classify + flatten a
+    pipelined byte stream into ready-to-plan commands, C scanner
+    (intake_scan via native_drain) vs the pure feed/drain-to-Msg loop.
+    No planners, no merges — this measures exactly the Python the
+    tentpole evicts; the end-to-end serve legs show what remains after
+    the (shared) merge machinery floor."""
+    from constdb_tpu.resp.codec import make_parser
+
+    chunks = [data for conn in per_conn for data, _n in conn]
+    total = sum(n for conn in per_conn for _data, n in conn)
+
+    def native_leg() -> float:
+        parser = make_parser()
+        got = 0
+        t0 = time.perf_counter()
+        for data in chunks:
+            parser.feed(data)
+            while (nat := parser.native_drain()) is not None:
+                got += len(nat[0])
+            got += len(parser.drain())  # boundary remainders
+        wall = time.perf_counter() - t0
+        assert got == total, (got, total)
+        return wall
+
+    def pure_leg() -> float:
+        parser = make_parser()
+        got = 0
+        t0 = time.perf_counter()
+        for data in chunks:
+            parser.feed(data)
+            got += len(parser.drain())
+        wall = time.perf_counter() - t0
+        assert got == total, (got, total)
+        return wall
+
+    n_wall = min(native_leg() for _ in range(reps))
+    p_wall = min(pure_leg() for _ in range(reps))
+    return {
+        "msgs": total,
+        "native_msgs_per_sec": round(total / n_wall, 1),
+        "pure_msgs_per_sec": round(total / p_wall, 1),
+        "speedup": round(p_wall / n_wall, 2),
+    }
+
+
+def intake_main(args) -> None:
+    """`bench.py --mode intake`: the native intake plane end to end
+    (BENCH_r19).  Serve legs over real sockets — C intake stage vs the
+    pure-Python drain path vs the CONSTDB_NO_NATIVE=1 full fallback —
+    interleaved best-of-N on the same deterministic workload, reply
+    byte streams + visible-value exports compared across every leg;
+    the native leg must show `native_intake_chunks > 0`, the others
+    exactly 0.  Emits ONE JSON line."""
+    n_ops = int(os.environ.get("CONSTDB_BENCH_SERVE_OPS", 200_000))
+    n_conns = int(os.environ.get("CONSTDB_BENCH_SERVE_CONNS", 4))
+    pipeline = int(os.environ.get("CONSTDB_BENCH_SERVE_PIPELINE", 64))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_SERVE_KEYS", 2000))
+    serve_batch = int(os.environ.get("CONSTDB_BENCH_SERVE_BATCH", 512))
+    engine_kind = os.environ.get("CONSTDB_BENCH_SERVE_ENGINE", "cpu")
+    reps = int(os.environ.get("CONSTDB_BENCH_SERVE_REPS", 2))
+
+    ensure_native()
+    from constdb_tpu.utils import native_tables as NT
+    ext = NT.load_ext()
+    if ext is None or not hasattr(ext, "intake_scan"):
+        print("[bench] native extension with intake_scan unavailable — "
+              "cannot run the intake legs", file=sys.stderr)
+        sys.exit(1)
+
+    per_ops = n_ops // n_conns
+    per_conn = [serve_workload(ci, per_ops, n_keys, pipeline)
+                for ci in range(n_conns)]
+    total = per_ops * n_conns
+    print(f"[bench] intake workload: {total} ops over {n_conns} conns x "
+          f"{pipeline}-deep pipelines", file=sys.stderr)
+
+    # leg -> env deltas for the FORKED server (fork inherits os.environ)
+    legs = {
+        "native": {"CONSTDB_NATIVE_INTAKE": "1"},
+        "pure": {"CONSTDB_NATIVE_INTAKE": "0"},
+        "nonative": {"CONSTDB_NO_NATIVE": "1"},
+    }
+    best: dict = {name: None for name in legs}
+    for rep in range(reps):
+        for name, env in legs.items():
+            saved = {k: os.environ.get(k) for k in
+                     ("CONSTDB_NATIVE_INTAKE", "CONSTDB_NO_NATIVE")}
+            try:
+                for k, v in env.items():
+                    os.environ[k] = v
+                leg = _serve_leg(serve_batch, engine_kind, per_conn)
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            print(f"[bench] rep {rep + 1} {name}: {leg[0]:.3f}s = "
+                  f"{total / leg[0]:,.0f} req/s "
+                  f"({leg[4]['native_intake_chunks']} native chunks)",
+                  file=sys.stderr)
+            if best[name] is None or leg[0] < best[name][0]:
+                best[name] = leg
+
+    ref_hashes = best["native"][2]
+    ref_canon = strip_canonical_times(best["native"][3])
+    verified = True
+    legs_out = {}
+    for name, (wall, rtts, hashes, canon, stats) in best.items():
+        lat = np.asarray(rtts) * 1000.0
+        replies_ok = hashes == ref_hashes
+        export_ok = strip_canonical_times(canon) == ref_canon
+        engaged_ok = stats["native_intake_chunks"] > 0 \
+            if name == "native" else stats["native_intake_chunks"] == 0
+        verified = verified and replies_ok and export_ok and engaged_ok
+        legs_out[name] = {
+            "rps": round(total / wall, 1),
+            "wall_s": round(wall, 3),
+            "reply_p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "reply_p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "native_intake_chunks": stats["native_intake_chunks"],
+            "native_intake_msgs": stats["native_intake_msgs"],
+            "serve_msgs_coalesced": stats["serve_msgs_coalesced"],
+            "replies_ok": replies_ok,
+            "export_ok": export_ok,
+        }
+        print(f"[bench] {name}: {legs_out[name]['rps']:,.1f} req/s, "
+              f"replies {'OK' if replies_ok else 'MISMATCH'}, export "
+              f"{'OK' if export_ok else 'MISMATCH'}, intake gauge "
+              f"{'OK' if engaged_ok else 'WRONG'}", file=sys.stderr)
+
+    stage = _intake_stage_legs(per_conn)
+    print(f"[bench] intake stage alone: {stage['speedup']}x vs pure "
+          f"({stage['native_msgs_per_sec']:,.0f} msgs/s)",
+          file=sys.stderr)
+
+    wire = _intake_wire_legs()
+    verified = verified and wire["verified"]
+    print(f"[bench] wire codec: encode {wire['encode_speedup']}x / "
+          f"decode {wire['decode_speedup']}x vs pure "
+          f"({'OK' if wire['verified'] else 'MISMATCH'})",
+          file=sys.stderr)
+
+    native_rps = legs_out["native"]["rps"]
+    pure_rps = legs_out["pure"]["rps"]
+    out = {
+        "metric": "native_intake_serve_requests_per_sec",
+        "value": native_rps,
+        "unit": "requests/sec",
+        "mode": "intake",
+        "ops": total,
+        "conns": n_conns,
+        "pipeline": pipeline,
+        "serve_batch": serve_batch,
+        "legs": legs_out,
+        "vs_pure_intake": round(native_rps / pure_rps, 2),
+        "vs_no_native": round(native_rps / legs_out["nonative"]["rps"],
+                              2),
+        "stage": stage,
+        "wire": wire,
+        "host_note": "burstable 1-core box: client and server share the "
+                     "core, so the serve ratio understates the server-"
+                     "side intake win; the merge machinery (shared by "
+                     "both legs) is the serving floor here — `stage` "
+                     "isolates the evicted intake Python and `wire` the "
+                     "REPLBATCH codec; the ROADMAP 3-5x serve target "
+                     "applies on a >=4-core box",
+        "engine": engine_kind,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
 
 
 async def _overload_drive(port: int, per_conn: list, tallies: list,
@@ -3050,7 +3344,7 @@ def main() -> None:
                     "1 = single-keyspace path)")
     ap.add_argument("--mode",
                     choices=["snapshot", "stream", "serve", "resync",
-                             "tensor"],
+                             "tensor", "intake"],
                     default="snapshot",
                     help="snapshot = bulk catch-up merge (default); "
                     "stream = steady-state replication apply through the "
@@ -3059,7 +3353,10 @@ def main() -> None:
                     "coalescer; resync = digest-negotiated delta resync "
                     "vs full snapshot at configurable divergence; "
                     "tensor = resident device tensor-register merges + "
-                    "reads vs the host reference at micro-batch size")
+                    "reads vs the host reference at micro-batch size; "
+                    "intake = the native intake plane — C intake stage "
+                    "vs pure-Python serve legs + the REPLBATCH codec "
+                    "legs (BENCH_r19)")
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
@@ -3120,6 +3417,9 @@ def main() -> None:
             serve_read_main(args)
         else:
             serve_main(args)
+        return
+    if args.mode == "intake":
+        intake_main(args)
         return
     if args.mode == "resync":
         resync_main(args)

@@ -598,6 +598,116 @@ class KeyConfinedRule(Rule):
             "confinement is not statically derivable")
 
 
+class NativeContractRule(Rule):
+    """NATIVE-CONTRACT: the C intake stage's command table and the Python
+    serve registries never drift apart.
+
+    native/intake.cpp classifies client commands by a frozen opcode
+    table; server/serve.py dispatches those opcodes straight into the
+    planners.  A command registered for coalescing (@serve_plan /
+    @serve_read) that the C table does not know silently loses its fast
+    path (OTHER opcode, per-command execution inside a planned run —
+    correct but quietly slow, the exact drift this PR's table froze);
+    worse, a table entry with no runtime planner would mean the C side
+    claims a command serve.py cannot plan.  Both directions are checked
+    against the marker block intake.cpp carries for this purpose
+    (NATIVE-INTAKE-TABLE-BEGIN/END): every decorated command name must
+    appear in the table's `native`/`native-reads` rows or be listed
+    `python-only` with a reason; every `native`/`native-reads` entry
+    must exist in the runtime SERVE_PLANNERS/COLUMNAR_ENCODERS/
+    SERVE_READS registries."""
+
+    name = "NATIVE-CONTRACT"
+    hint = ("add the command to the native/intake.cpp marker table "
+            "(native:/native-reads: if the C scanner classifies it, "
+            "python-only: with the opcode left to the pure path "
+            "otherwise) and keep the C classify() switch in step — or "
+            "drop the stale table entry")
+
+    DECOS = {"serve_plan", "serve_read"}
+
+    def __init__(self) -> None:
+        self._table: tuple | None = None
+        self._registry: set | None = None
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.basename == "commands.py" and _scoped(ctx, "server")
+
+    def table(self) -> tuple:
+        """(found, native, native_reads, python_only) from the marker
+        block in native/intake.cpp — resolved from the real source tree
+        (the table is repo state, like conf.ENV_REGISTRY for
+        ENV-REGISTRY), so corpus mirrors are checked against the same
+        contract the live tree is."""
+        if self._table is None:
+            import os
+            import re
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            path = os.path.join(root, "native", "intake.cpp")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                src = ""
+            m = re.search(r"NATIVE-INTAKE-TABLE-BEGIN(.*?)"
+                          r"NATIVE-INTAKE-TABLE-END", src, re.S)
+            sets: dict[str, set] = {"native": set(), "native-reads": set(),
+                                    "python-only": set()}
+            if m:
+                for line in m.group(1).splitlines():
+                    line = line.strip().lstrip("/").strip()
+                    for label, dst in sets.items():
+                        if line.startswith(label + ":"):
+                            dst.update(line[len(label) + 1:].split())
+            self._table = (m is not None, sets["native"],
+                           sets["native-reads"], sets["python-only"])
+        return self._table
+
+    def registry(self) -> set:
+        """Runtime command names (str) across the three coalescing
+        registries, imported lazily like ENV-REGISTRY's conf read."""
+        if self._registry is None:
+            from ..server import commands as C
+            self._registry = {k.decode() for k in C.SERVE_PLANNERS} | \
+                {k.decode() for k in C.COLUMNAR_ENCODERS} | \
+                {k.decode() for k in C.SERVE_READS}
+        return self._registry
+
+    def check(self, ctx: FileContext):
+        found, native, reads, pyonly = self.table()
+        if not found:
+            yield self.finding(
+                ctx, ctx.tree, "", "intake-table-missing",
+                "native/intake.cpp has no NATIVE-INTAKE-TABLE marker "
+                "block — the C intake contract cannot be checked")
+            return
+        covered = native | reads | pyonly
+        # direction 1: every command THIS file registers for coalescing
+        # is accounted for in the C table
+        for qual, fn, _a, _c in ctx.functions:
+            for deco in getattr(fn, "decorator_list", ()):
+                got = KeyConfinedRule._deco_str_arg(deco, self.DECOS)
+                if got and got not in covered:
+                    yield self.finding(
+                        ctx, deco, qual, got,
+                        f"command {got!r} is registered for coalescing "
+                        "but absent from the native/intake.cpp table — "
+                        "the C scanner demotes it to OTHER silently "
+                        "(declare it native/native-reads with a C "
+                        "classify() arm, or python-only with a reason)")
+        # direction 2: every command the C table claims to classify has
+        # a runtime planner/encoder/read-spec behind its opcode
+        for entry in sorted(native | reads):
+            if entry not in self.registry():
+                yield self.finding(
+                    ctx, ctx.tree, "", f"{entry}:stale",
+                    f"native/intake.cpp table lists {entry!r} but no "
+                    "runtime planner/encoder/read-spec is registered "
+                    "under that name — the C scanner would emit an "
+                    "opcode serve.py cannot plan")
+
+
 ALL_RULES: list[Rule] = [
     AsyncBlockRule(),
     StagePureRule(),
@@ -607,4 +717,5 @@ ALL_RULES: list[Rule] = [
     BareExceptRule(),
     ForkCaptureRule(),
     KeyConfinedRule(),
+    NativeContractRule(),
 ]

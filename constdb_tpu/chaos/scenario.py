@@ -592,6 +592,14 @@ async def _run_scenario_async(sc: Scenario) -> dict:
             stats["plane"] = dict(plane.stats)
             stats["reconnects"] = sum(
                 a.node.stats.repl_reconnects for a in cluster.apps)
+            # whole-run gauges the smoke cells assert on: demotions
+            # (banked across cold restarts) and the native intake
+            # counters — a cell that claims to exercise the C intake
+            # stage must show it actually owned client chunks
+            stats["wire_demotions"] = \
+                cluster.stat_total("repl_wire_demotions")
+            stats["native_intake_chunks"] = \
+                cluster.stat_total("native_intake_chunks")
             return stats
         except AssertionError:
             raise

@@ -139,6 +139,11 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
     EnvVar("CONSTDB_SERVE_BATCH", "512",
            "max pipelined client commands the serve path plans into one "
            "columnar merge; 1 = the exact per-command path"),
+    EnvVar("CONSTDB_NATIVE_INTAKE", "1",
+           "native intake stage: one C call splits a coalescing "
+           "connection's pipelined chunk and classifies the plannable "
+           "commands into opcodes + pre-flattened payloads; 0 = the "
+           "pure drain()+run_chunk path (byte-identical output)"),
     EnvVar("CONSTDB_SERVE_LAT_SAMPLE", "32",
            "sample every Nth coalesced client command into the INFO "
            "reply-latency ring (serve_lat_p50/p99_ms); 0 = off"),

@@ -103,9 +103,34 @@ def _pack_ints(out: bytearray, arr: np.ndarray) -> None:
     raise _PatternError("int column out of i64 range")
 
 
+_WIRE_NATIVE_CACHE: list = []
+
+
+def _native_wire():
+    """(pack, unpack) blob-column entry points from the C extension, or
+    None.  Gated separately from the other native tiers: a prebuilt
+    cst_ext.so from before native/wire.cpp existed must degrade to the
+    pure packers, not AttributeError mid-stream."""
+    if not _WIRE_NATIVE_CACHE:
+        from ..utils.native_tables import load_ext
+        mod = load_ext()
+        pack = getattr(mod, "wire_pack_blobs", None)
+        unpack = getattr(mod, "wire_unpack_blobs", None)
+        _WIRE_NATIVE_CACHE.append((pack, unpack) if pack and unpack
+                                  else None)
+    return _WIRE_NATIVE_CACHE[0]
+
+
 def _pack_blobs(out: bytearray, items) -> None:
     """Length-prefixed byte blobs; None entries use the width's max value
-    as a sentinel (so a length can never alias it — widths widen first)."""
+    as a sentinel (so a length can never alias it — widths widen first).
+    C fast path when the extension is built (native/wire.cpp) — it
+    DECLINES any shape off the happy path (non-list, non-bytes rows,
+    over-wide blobs), so the pure packer below keeps the reference
+    behavior, including the _PatternError demotes, byte for byte."""
+    nat = _native_wire()
+    if nat is not None and nat[0](out, items):
+        return
     n = len(items)
     lens = np.fromiter((len(b) if b is not None else -1 for b in items),
                        dtype=_I64, count=n)
@@ -148,6 +173,15 @@ class _Reader:
         return np.frombuffer(self.take(n * w), dtype=f"<i{w}").astype(_I64)
 
     def blobs(self, n: int) -> list:
+        # C fast path (native/wire.cpp): one call slices the whole
+        # column.  A decline (bad width, truncation) falls through to
+        # the pure reader, which raises the reference WireFormatError.
+        nat = _native_wire()
+        if nat is not None:
+            res = nat[1](self.buf, self.pos, n)
+            if res is not None:
+                self.pos = res[1]
+                return res[0]
         w = self.u8()
         if w not in (1, 2, 4):
             raise WireFormatError("bad blob length width")

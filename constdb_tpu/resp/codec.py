@@ -188,6 +188,19 @@ class RespParser:
             self._qpos = 0
             raise
 
+    def native_drain(self):
+        """One C pass over the buffered pipeline: split AND classify.
+
+        Returns `(ops, payloads)` — parallel lists where ops[i] is a
+        serve-plane opcode (server/serve.py _OP_*; 0 = OTHER with a full
+        Msg payload) — or None when the native intake stage is
+        unavailable or produced nothing.  The scan stops early at any
+        frame it will not own (partial, malformed, SYNC upgrade,
+        oversized); those bytes stay buffered for drain()/next_msg(),
+        which re-parses them with the reference error behavior.  Base
+        class: the stage needs the C scanner, so always None."""
+        return None
+
     def pushback(self, msgs: list) -> None:
         """Re-queue already-drained messages at the FRONT of the delivery
         order (they re-emerge from next_msg()/drain() before anything
@@ -352,6 +365,25 @@ class NativeRespParser(RespParser):
 
     __slots__ = ()
 
+    def native_drain(self):
+        """The native intake stage (native/intake.cpp intake_scan): one C
+        call consumes every leading well-formed flat command frame and
+        returns opcodes + pre-flattened payloads for the plannable set.
+        Declines (None) when the extension predates intake_scan, when
+        pushed-back messages are queued (they must re-emerge first, in
+        order), or when the scan consumed nothing."""
+        scan = _intake()
+        if scan is None or self._qpos < len(self._q):
+            return None
+        ops, payloads, new_pos = scan(
+            self._buf, self._pos, Arr, Bulk, Int, Simple, Err, NIL,
+            self.max_bulk)
+        if not ops:
+            return None
+        self._pos = new_pos
+        self._compact()
+        return ops, payloads
+
     def _parse_one(self) -> Optional[Msg]:
         ext = _ext()
         if ext is None:
@@ -392,6 +424,7 @@ class NativeRespParser(RespParser):
 
 _EXT_CACHE: list = []
 _ENC_CACHE: list = []
+_INTAKE_CACHE: list = []
 
 
 def _ext():
@@ -411,6 +444,16 @@ def _enc():
         from ..utils.native_tables import load_ext
         _ENC_CACHE.append(getattr(load_ext(), "resp_encode", None))
     return _ENC_CACHE[0]
+
+
+def _intake():
+    """The native intake entry point, or None.  Gated separately from
+    resp_parse (same reasoning as _enc: a prebuilt cst_ext.so from before
+    the intake stage existed must degrade, not AttributeError)."""
+    if not _INTAKE_CACHE:
+        from ..utils.native_tables import load_ext
+        _INTAKE_CACHE.append(getattr(load_ext(), "intake_scan", None))
+    return _INTAKE_CACHE[0]
 
 
 def make_parser() -> RespParser:

@@ -157,6 +157,12 @@ def _section_stats(node, out):
     # worker gauges (a shard worker's cache lives in its process)
     out.append(("serve_reads_coalesced", st.serve_reads_coalesced))
     out.append(("serve_read_flushes", st.serve_read_flushes))
+    # native intake stage (native/intake.cpp + server/io.py): chunks the
+    # C scanner split+classified, and the frames it emitted as opcodes.
+    # Both stay zero with CONSTDB_NATIVE_INTAKE=0 / CONSTDB_NO_NATIVE=1
+    # — the oracle for "the native leg actually engaged" (scripts/ci.sh)
+    out.append(("native_intake_chunks", st.native_intake_chunks))
+    out.append(("native_intake_msgs", st.native_intake_msgs))
     rc = node.read_cache
     x = st.extra
     rc_bytes = rc.used_bytes() + sum(

@@ -184,6 +184,13 @@ class ServerApp:
         # path, byte for byte.
         self.serve_shards = env_int("CONSTDB_SERVE_SHARDS", 1) \
             if serve_shards is None else serve_shards
+        # native intake stage (native/intake.cpp intake_scan): one C call
+        # splits a coalescing connection's pipelined chunk AND classifies
+        # the plannable commands into opcodes + pre-flattened payloads —
+        # the per-command Python dispatch evaporates from the hot loop.
+        # CONSTDB_NATIVE_INTAKE=0 pins the pure drain()+run_chunk path
+        # (byte-identical; the stage is an accelerator, not a semantic).
+        self.native_intake = env_int("CONSTDB_NATIVE_INTAKE", 1) > 0
         # digest-driven delta resync (replica/link.py _send_delta, wire
         # frames digest/digestack/deltasync): enabled by default — a
         # peer without CAP_DELTA_SYNC still gets the exact full-sync
@@ -478,6 +485,19 @@ class ServerApp:
                         if not isinstance(reply, NoReply):
                             encode_into(out, reply)
                 else:
+                    if coal is not None and self.native_intake:
+                        # native intake stage: the C scanner owns every
+                        # leading well-formed flat frame (split +
+                        # classify in one call); whatever it stops at —
+                        # partial frame, SYNC upgrade, malformed bytes,
+                        # nested array — stays buffered for the pure
+                        # drain() below, which keeps the reference
+                        # behavior for those frames byte for byte
+                        while (nat := parser.native_drain()) is not None:
+                            stats = self.node.stats
+                            stats.native_intake_chunks += 1
+                            stats.native_intake_msgs += len(nat[0])
+                            coal.run_native_chunk(nat[0], nat[1], out)
                     msgs = parser.drain()
                     for i, msg in enumerate(msgs):
                         if self._is_sync(msg):

@@ -93,6 +93,12 @@ class NodeStats:
     # deltas into the parent's cache counters (server/serve_shards.py).
     serve_reads_coalesced: int = 0
     serve_read_flushes: int = 0
+    # native intake stage (native/intake.cpp + server/io.py): pipelined
+    # chunks split+classified by the C scanner in one call, and the
+    # command frames it emitted as opcodes (CONSTDB_NATIVE_INTAKE=0 or a
+    # missing extension pins both to zero — the pure path served)
+    native_intake_chunks: int = 0
+    native_intake_msgs: int = 0
     serve_lat: deque = field(default_factory=lambda: deque(maxlen=2048))
     # overload governance (server/overload.py + server/io.py +
     # replica/link.py): client data writes shed at the maxmemory soft

@@ -91,7 +91,7 @@ import numpy as np
 
 from ..errors import CstError
 from ..resp.codec import encode_into
-from ..resp.message import (Arr, Bulk, Int, NIL, NoReply, as_bytes,
+from ..resp.message import (Arr, Bulk, Int, NIL, NoReply, OK, as_bytes,
                             as_int)
 from ..replica.coalesce import BatchBuilder
 from ..crdt import semantics as S
@@ -133,6 +133,65 @@ _PREPROBE_MIN = 16
 # conflict: the command re-executes per-command and raises the exact
 # op-path error (planners compare with `is`)
 CONFLICT = object()
+
+# ---------------------------------------------------------- native intake
+# Opcode numbering emitted by native/intake.cpp intake_scan — part of the
+# extension ABI (the NATIVE-INTAKE-TABLE marker block there names the
+# commands; analysis/rules.py NATIVE-CONTRACT pins it against the
+# SERVE_PLANNERS / SERVE_READS registries).  run_native_chunk consumes
+# these without ever constructing message objects for the plannable set.
+_OP_SET, _OP_INCR1, _OP_INCR, _OP_DECR1, _OP_DECR = 1, 2, 3, 4, 5
+_OP_SADD, _OP_SREM, _OP_HSET, _OP_HDEL = 6, 7, 8, 9
+_OP_GET, _OP_SCNT, _OP_SISMEMBER, _OP_SMEMBERS = 10, 11, 12, 13
+_OP_HGET, _OP_HGETALL, _OP_LLEN = 14, 15, 16
+_FIRST_READ_OP = _OP_GET
+
+_OP_NAME = {_OP_SET: b"set", _OP_INCR1: b"incr", _OP_INCR: b"incr",
+            _OP_DECR1: b"decr", _OP_DECR: b"decr", _OP_SADD: b"sadd",
+            _OP_SREM: b"srem", _OP_HSET: b"hset", _OP_HDEL: b"hdel",
+            _OP_GET: b"get", _OP_SCNT: b"scnt",
+            _OP_SISMEMBER: b"sismember", _OP_SMEMBERS: b"smembers",
+            _OP_HGET: b"hget", _OP_HGETALL: b"hgetall", _OP_LLEN: b"llen"}
+# shared command-head Bulks for demote-time message materialization
+# (handlers only ever read them)
+_OP_HEAD = {op: Bulk(nm) for op, nm in _OP_NAME.items()}
+# CMD_DENYOOM members of the native write set (the maxmemory shed gate;
+# srem/hdel free memory and keep riding the run, like the pure path)
+_OOM_OPS = frozenset((_OP_SET, _OP_INCR1, _OP_INCR, _OP_DECR1, _OP_DECR,
+                      _OP_SADD, _OP_HSET))
+# read opcode -> (SERVE_READS spec, canonical lowercase name): the same
+# (spec, name) pair _planner_of resolves per message
+_NOP_READ = {op: (SERVE_READS[_OP_NAME[op]], _OP_NAME[op])
+             for op in range(_FIRST_READ_OP, _OP_LLEN + 1)}
+# element-family write opcodes that share one planner body
+_NOP_ELEM = {_OP_SADD: (b"sadd", S.ENC_SET, True),
+             _OP_SREM: (b"srem", S.ENC_SET, False),
+             _OP_HDEL: (b"hdel", S.ENC_DICT, False)}
+# pre-encoded planned replies (reply bytes are emitted directly — the
+# pure planners' OK/_INT0/Int(n) objects encode to exactly these)
+_OK_BYTES = _enc1(OK)
+_INT_BYTES = [b":%d\r\n" % i for i in range(1024)]
+
+
+def _nat_msg(op: int, pl):
+    """Materialize the full message for a natively-scanned command —
+    only ever on the cold paths (lone command, demotion, OOM shed,
+    barrier) where the pure path would hold a parsed message."""
+    if op == 0:
+        return pl
+    if op < _FIRST_READ_OP:
+        return Arr([_OP_HEAD[op]] + pl[0])
+    return Arr([_OP_HEAD[op]] + [Bulk(x) for x in pl])
+
+
+def _materialize_msg(m):
+    """A read-run slot holds either a parsed message (pure intake) or a
+    native `(op, raws)` marker — the message is built only if the read
+    demotes to the per-command path."""
+    if type(m) is not tuple:
+        return m
+    op, raw = m
+    return Arr([_OP_HEAD[op]] + [Bulk(x) for x in raw])
 
 
 class ServeCoalescer:
@@ -298,6 +357,208 @@ class ServeCoalescer:
         if self._pending:
             self.flush()
 
+    def run_native_chunk(self, ops: bytes, payloads: list,
+                         out: bytearray) -> None:
+        """Plan and execute one natively-scanned chunk (`ops`/`payloads`
+        from native/intake.cpp intake_scan, via resp/codec.py
+        native_drain).  Control flow mirrors run_chunk exactly; native
+        opcodes skip message construction, classification, and planner
+        dispatch, but share every stateful primitive (tick /
+        resolve_key / count_elem_flips / add / flush / _exec), so
+        replies, uuid streams, planes, and repl_log entries stay
+        byte-identical to the pure path (tests/test_resp_fuzz.py pins
+        the differential).  Never used on the sharded plane — io.py
+        builds a coalescer only when no plane is active — so there are
+        no pre-minted uuids or reply spans here."""
+        self._reset_caches()
+        n = len(ops)
+        if n == 1:
+            # lone command: the exact per-command path, zero overhead
+            self._exec(_nat_msg(ops[0], payloads[0]), out,
+                       count_barrier=False, invalidate=False)
+            return
+        # plan[i]: a native opcode int, or _planner_of's result for an
+        # OP_OTHER message (callable / read-spec tuple / None)
+        plan = [op if op else self._planner_of(payloads[i])
+                for i, op in enumerate(ops)]
+        gov = self.node.governor
+        if gov.maxmemory and gov.shed_writes(weight=n):
+            plan = [None if (type(fn) is int and fn in _OOM_OPS) or
+                    (callable(fn) and self._oom_gated(pl)) else fn
+                    for fn, pl in zip(plan, payloads)]
+        n_plannable = sum(1 for fn in plan if callable(fn) or
+                          (type(fn) is int and fn < _FIRST_READ_OP))
+        if n_plannable >= _PREPROBE_MIN:
+            reg_keys: list = []
+            cnt_keys: list = []
+            el_cmds: list = []
+            for fn, pl in zip(plan, payloads):
+                if type(fn) is int:
+                    if fn >= _FIRST_READ_OP:
+                        continue
+                    raw = pl[1]
+                    if fn == _OP_SET:
+                        reg_keys.append(raw[0])
+                    elif fn <= _OP_DECR:
+                        cnt_keys.append(raw[0])
+                    elif fn == _OP_HSET:
+                        el_cmds.append((raw[0], S.ENC_DICT, None,
+                                        raw[1::2]))
+                    else:  # sadd / srem / hdel
+                        ent = _NOP_ELEM[fn]
+                        el_cmds.append((raw[0], ent[1], None, raw[1:]))
+                elif callable(fn):
+                    self._pp_classify(pl.items, reg_keys, cnt_keys,
+                                      el_cmds)
+            self._preprobe_core(reg_keys, cnt_keys, el_cmds)
+        max_run = self.max_run
+        tick = self.node.hlc.tick
+        read_run: list = []
+        run_keys: set = set()
+        deferred: list = []
+        for i in range(n):
+            fn = plan[i]
+            pl = payloads[i]
+            if type(fn) is int and fn >= _FIRST_READ_OP:
+                # native plannable read: the (spec, name, key, extra,
+                # parsed) tuple comes from constant tables; a message is
+                # built only if the batch executor demotes it
+                spec_name = _NOP_READ[fn]
+                if len(pl) > 1:  # sismember / hget carry a member arg
+                    extra = parsed = pl[1]
+                else:
+                    extra, parsed = b"", None
+                pre = tick(False)
+                read_run.append((i, (fn, pl), spec_name[0], spec_name[1],
+                                 pl[0], extra, parsed, pre))
+                run_keys.add(pl[0])
+                continue
+            if type(fn) is tuple:
+                pre = tick(False)
+                read_run.append((i, pl) + fn + (pre,))
+                run_keys.add(fn[2])
+                continue
+            op = ops[i]
+            if read_run:
+                # same commutes-with-the-run gate as run_chunk: a native
+                # write opcode IS a registered key-confined data command,
+                # so its confined key is its first payload byte-string
+                key = pl[1][0] if op else self._confined_key(pl)
+                if key is None or key in run_keys:
+                    self._run_read_batch(read_run, out, None, deferred)
+                    read_run = []
+                    run_keys = set()
+                    deferred = []
+            sink = out
+            if read_run:
+                sink = bytearray()
+            isolated = False
+            handled = False
+            if fn is not None:
+                nxt = plan[i + 1] if i + 1 < n else None
+                if self._pending or callable(nxt) or \
+                        (type(nxt) is int and nxt < _FIRST_READ_OP):
+                    if type(fn) is int:
+                        handled = self._nplan_native(fn, pl, sink)
+                    else:
+                        reply = fn(self, pl.items)
+                        if reply is not None:
+                            encode_into(sink, reply)
+                            handled = True
+                else:
+                    isolated = True
+            if not handled:
+                msg = _nat_msg(op, pl)
+                if self._pending and not self._scoped_read_commutes(msg):
+                    self.flush()
+                self._exec(msg, sink, count_barrier=not isolated)
+            if sink is not out:
+                deferred.append((i, bytes(sink)))
+            if handled and self._pending >= max_run:
+                self.flush()
+        if read_run:
+            self._run_read_batch(read_run, out, None, deferred)
+        self._cur_uuid = None
+        if self._pending:
+            self.flush()
+
+    def _nplan_native(self, op: int, pl: tuple, sink: bytearray) -> bool:
+        """Plan one native write opcode from its raw payload — each
+        branch is the exact planner body (commands.py _plan_set /
+        _plan_counter_step / _plan_elem_update / _plan_hset) minus the
+        message objects, emitting pre-encoded reply bytes.  Returns
+        False to demote: the caller re-executes per-command, identical
+        to a pure planner returning None."""
+        bulks, raw = pl
+        key = raw[0]
+        if op == _OP_SET:
+            kid = self.resolve_key(key, S.ENC_BYTES)
+            if kid is CONFLICT:
+                return False
+            uuid = self.tick()
+            st = self.regs.get(key)
+            if st is None:
+                st = (int(self.ks.keys.rv_t[kid]),
+                      int(self.ks.keys.rv_node[kid])) if kid >= 0 \
+                    else (0, 0)
+            won = not S.lww_wins(st[0], st[1], uuid, self.nodeid)
+            if won:
+                self.regs[key] = (uuid, self.nodeid)
+            self.add(b"set", (key, uuid, raw[1]), bulks)
+            sink += _OK_BYTES if won else _INT0_BYTES
+            return True
+        if op <= _OP_DECR:  # the incr/decr family
+            if op == _OP_INCR1:
+                delta = 1
+            elif op == _OP_DECR1:
+                delta = -1
+            else:
+                try:
+                    delta = as_int(bulks[1])
+                except CstError:
+                    return False  # non-integer delta: exact op error
+                if op == _OP_DECR:
+                    delta = -delta
+            kid = self.resolve_key(key, S.ENC_COUNTER)
+            if kid is CONFLICT:
+                return False
+            uuid = self.tick()
+            st = self.cnts.get(key)
+            if st is None:
+                ks = self.ks
+                st = [ks.counter_sum(kid),
+                      ks.counter_slot_total(kid, self.nodeid)] \
+                    if kid >= 0 else [0, 0]
+                self.cnts[key] = st
+            st[0] += delta
+            st[1] += delta
+            self.node.undo.record(uuid, key, delta)
+            self.add(b"cntset", (key, uuid, st[1]),
+                     [bulks[0], Int(st[1])])
+            v = st[0]
+            sink += _INT_BYTES[v] if 0 <= v < 1024 else b":%d\r\n" % v
+            return True
+        if op == _OP_HSET:
+            fields = list(raw[1::2])
+            kid = self.resolve_key(key, S.ENC_DICT)
+            if kid is CONFLICT:
+                return False
+            uuid = self.tick()
+            cnt = self.count_elem_flips(key, kid, fields, True)
+            self.add(b"hset", (key, uuid, fields, list(raw[2::2])), bulks)
+            sink += _INT_BYTES[cnt] if cnt < 1024 else b":%d\r\n" % cnt
+            return True
+        name, enc, add = _NOP_ELEM[op]  # sadd / srem / hdel
+        members = list(raw[1:])
+        kid = self.resolve_key(key, enc)
+        if kid is CONFLICT:
+            return False
+        uuid = self.tick()
+        cnt = self.count_elem_flips(key, kid, members, add)
+        self.add(name, (key, uuid, members), bulks)
+        sink += _INT_BYTES[cnt] if cnt < 1024 else b":%d\r\n" % cnt
+        return True
+
     @staticmethod
     def _oom_gated(msg) -> bool:
         """Is this (already known-plannable) command a data-growing
@@ -367,12 +628,6 @@ class ServeCoalescer:
         behavior is byte-identical with or without this pass.  Commands
         whose arguments do not parse are simply not seeded — their
         planner demotes them as usual."""
-        node = self.node
-        # narrow barrier: the probes below read the key/reg/cnt/el
-        # planes only — resident TENSOR payload pools stay put (their
-        # stamps are host-authoritative and nothing here reads payloads)
-        node.ensure_flushed_for(("env", "reg", "cnt", "el"))
-        ks = self.ks
         reg_keys: list = []
         cnt_keys: list = []
         el_cmds: list = []   # (key, want_enc, member item step, items)
@@ -380,27 +635,50 @@ class ServeCoalescer:
             if not callable(fn):
                 continue  # None, or a read-spec tuple (reads resolve
                 #           through their own batched path)
-            items = msgs[i].items
-            if len(items) < 2:
-                continue
-            k = items[1]
-            if type(k) is not Bulk:
-                continue
-            nm = items[0].val
-            if nm not in _PP_ANY:
-                nm = nm.lower()
-            if nm in _PP_REG:
-                reg_keys.append(k.val)
-            elif nm in _PP_CNT:
-                cnt_keys.append(k.val)
-            else:
-                ent = _PP_EL.get(nm)
-                if ent is None:
-                    continue
-                # member extraction is deferred until the key batch shows
-                # the key exists with the right encoding — new keys (and
-                # demotion-bound conflicts) never pay it
-                el_cmds.append((k.val, ent[0], ent[1], items))
+            self._pp_classify(msgs[i].items, reg_keys, cnt_keys, el_cmds)
+        self._preprobe_core(reg_keys, cnt_keys, el_cmds)
+
+    @staticmethod
+    def _pp_classify(items: list, reg_keys: list, cnt_keys: list,
+                     el_cmds: list) -> None:
+        """Sort one plannable command's probe-able arguments into the
+        pre-probe buckets (the message-based extraction half of
+        _preprobe; run_native_chunk feeds _preprobe_core directly from
+        raw payloads instead)."""
+        if len(items) < 2:
+            return
+        k = items[1]
+        if type(k) is not Bulk:
+            return
+        nm = items[0].val
+        if nm not in _PP_ANY:
+            nm = nm.lower()
+        if nm in _PP_REG:
+            reg_keys.append(k.val)
+        elif nm in _PP_CNT:
+            cnt_keys.append(k.val)
+        else:
+            ent = _PP_EL.get(nm)
+            if ent is None:
+                return
+            # member extraction is deferred until the key batch shows
+            # the key exists with the right encoding — new keys (and
+            # demotion-bound conflicts) never pay it
+            el_cmds.append((k.val, ent[0], ent[1], items))
+
+    def _preprobe_core(self, reg_keys: list, cnt_keys: list,
+                       el_cmds: list) -> None:
+        """The batched index probes behind _preprobe.  `el_cmds` rows
+        are `(key, want_enc, step, seq)`: step > 0 slices member items
+        out of a message item list (`seq[2::step]`, Bulk-gated); step
+        None means `seq` already holds raw member byte-strings (the
+        native intake path pre-slices its payloads)."""
+        node = self.node
+        # narrow barrier: the probes below read the key/reg/cnt/el
+        # planes only — resident TENSOR payload pools stay put (their
+        # stamps are host-authoritative and nothing here reads payloads)
+        node.ensure_flushed_for(("env", "reg", "cnt", "el"))
+        ks = self.ks
         all_keys = reg_keys + cnt_keys + [e[0] for e in el_cmds]
         if not all_keys:
             return
@@ -443,7 +721,7 @@ class ServeCoalescer:
             flat_kids: list = []
             flat_members: list = []
             seed: list = []  # per-key member dict aligned w/ flat_members
-            for key, want, step, items in el_cmds:
+            for key, want, step, seq in el_cmds:
                 kid = kids[pos]
                 pos += 1
                 if kid < 0:
@@ -455,7 +733,13 @@ class ServeCoalescer:
                 d = els.get(key)
                 if d is None:
                     d = els[key] = {}
-                for m in items[2::step]:
+                if step is None:  # native payload: members are raw bytes
+                    for mv in seq:
+                        flat_kids.append(kid)
+                        flat_members.append(mv)
+                        seed.append(d)
+                    continue
+                for m in seq[2::step]:
                     if type(m) is Bulk:
                         flat_kids.append(kid)
                         flat_members.append(m.val)
@@ -672,7 +956,7 @@ class ServeCoalescer:
                 # its own tick and sees the exact per-command uuid.
                 self._cur_uuid = pre
                 buf = bytearray()
-                self._exec(msg, buf)
+                self._exec(_materialize_msg(msg), buf)
                 self._cur_uuid = None
                 slots[j] = bytes(buf)
                 continue

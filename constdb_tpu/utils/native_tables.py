@@ -44,9 +44,45 @@ def nonnull_mask(items: list):
                        count=len(items))
 
 
+_ABI_STAMP_CACHE: list = []
+
+
+def expected_abi_stamp() -> Optional[str]:
+    """sha256 over the sorted native/*.cpp sources — the same hash the
+    Makefile compiles into cst_ext.so as CST_ABI_STAMP (native/Makefile
+    $(STAMP) rule: `cat $(sort $(wildcard *.cpp)) | sha256sum`).  The
+    extension and serve.py share frozen row layouts (opcode numbering,
+    payload shapes); a .so built from different sources could emit rows
+    the Python side misreads, so load_ext compares this against the
+    module's own abi_stamp() and refuses a mismatch.  None when the
+    source tree is absent (artifact-only deployments have nothing to
+    compare against — the shipped .so is trusted as-is)."""
+    if not _ABI_STAMP_CACHE:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = os.path.join(os.path.dirname(here), "native")
+        try:
+            names = sorted(n for n in os.listdir(src) if n.endswith(".cpp"))
+        except OSError:
+            names = []
+        if not names:
+            _ABI_STAMP_CACHE.append(None)
+        else:
+            import hashlib
+            h = hashlib.sha256()
+            for n in names:
+                with open(os.path.join(src, n), "rb") as f:
+                    h.update(f.read())
+            _ABI_STAMP_CACHE.append(h.hexdigest())
+    return _ABI_STAMP_CACHE[0]
+
+
 def load_ext():
     """The CPython extension module, or None.  CONSTDB_NO_NATIVE=1 forces
-    the pure-Python tiers (A/B floor measurement — opbench.py)."""
+    the pure-Python tiers (A/B floor measurement — opbench.py).  A .so
+    whose compiled-in ABI stamp does not match the native/*.cpp sources
+    on disk is refused LOUDLY (stale build: its row layouts may disagree
+    with what serve.py expects) — rebuild with `make -C native`, or let
+    bench.py's ensure_native (CONSTDB_AUTO_NATIVE, default on) do it."""
     global _ext
     from ..conf import env_str
     if env_str("CONSTDB_NO_NATIVE"):
@@ -63,10 +99,21 @@ def load_ext():
                 spec = importlib.util.spec_from_file_location("cst_ext", cand)
                 mod = importlib.util.module_from_spec(spec)
                 spec.loader.exec_module(mod)
-                _ext = mod
-                return mod
             except (ImportError, OSError):
                 continue
+            want = expected_abi_stamp()
+            got = getattr(mod, "abi_stamp", lambda: "")()
+            if want is not None and got != want:
+                import logging
+                logging.getLogger("constdb.native").warning(
+                    "stale cst_ext.so at %s (abi stamp %s != sources %s): "
+                    "refusing to load it — rebuild with `make -C native` "
+                    "(bench.py ensure_native rebuilds automatically unless "
+                    "CONSTDB_AUTO_NATIVE=0)",
+                    cand, (got or "<unstamped>")[:12], want[:12])
+                continue
+            _ext = mod
+            return mod
     _ext = False
     return None
 
@@ -78,6 +125,7 @@ def reload_tiers() -> bool:
     global _ext, _lib
     _ext = None
     _lib = None
+    _ABI_STAMP_CACHE.clear()
     return load_ext() is not None
 
 

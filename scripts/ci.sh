@@ -31,6 +31,62 @@ if total > MAX_BASELINED:
 EOF
 
 echo
+echo "== native intake smoke (make -C native + bench --mode intake) =="
+# the C intake plane end to end: rebuild the extension from source (the
+# ABI stamp in the .so refuses stale builds loudly), then a tiny
+# oracle-verified run of the three serve legs over real sockets — C
+# intake stage / pure-Python drain (CONSTDB_NATIVE_INTAKE=0) / full
+# fallback (CONSTDB_NO_NATIVE=1) — plus the REPLBATCH codec legs
+# (native pack/unpack vs pure, encoded bytes byte-identical).  Reply
+# streams and stripped exports must match across ALL legs and the
+# native leg must PROVE it engaged (INFO gauge native_intake_chunks);
+# the differential suites proper run inside tier-1
+# (tests/test_native_intake.py / tests/test_resp_fuzz.py).
+make -s -C native || exit $?
+JAX_PLATFORMS=cpu CONSTDB_BENCH_SERVE_OPS=6000 CONSTDB_BENCH_SERVE_CONNS=2 \
+CONSTDB_BENCH_SERVE_REPS=1 CONSTDB_BENCH_INTAKE_FRAMES=6000 \
+    timeout -k 10 300 python bench.py --mode intake \
+    > /tmp/_ci_intake.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_intake.json"))
+assert out["verified"], "intake smoke failed oracle verification"
+legs = out["legs"]
+assert legs["native"]["native_intake_chunks"] > 0, \
+    "native intake never engaged"
+assert legs["pure"]["native_intake_chunks"] == 0, \
+    "pinned pure leg ran the native stage"
+assert legs["nonative"]["native_intake_chunks"] == 0, \
+    "CONSTDB_NO_NATIVE leg ran the native stage"
+for name, leg in legs.items():
+    assert leg["replies_ok"] and leg["export_ok"], \
+        f"intake leg {name} diverged from the native reference"
+assert out["wire"]["verified"], "wire codec legs mismatched"
+print("intake smoke verified:",
+      f"{legs['native']['rps']:,.0f} req/s native /",
+      f"{legs['pure']['rps']:,.0f} pure /",
+      f"{legs['nonative']['rps']:,.0f} no-native,",
+      f"{legs['native']['native_intake_chunks']} native chunks,",
+      f"wire {out['wire']['encode_speedup']}x enc "
+      f"{out['wire']['decode_speedup']}x dec")
+EOF
+# the stream smoke's fallback leg: the same wire protocol run with NO
+# native tier anywhere (CONSTDB_NO_NATIVE=1) must still pass its full
+# oracle — pure pack/unpack is the reference the native codec is pinned
+# against, so a fallback regression fails here, not in production
+JAX_PLATFORMS=cpu CONSTDB_NO_NATIVE=1 CONSTDB_BENCH_FRAMES=3000 \
+CONSTDB_BENCH_WIRE_REPS=1 \
+    timeout -k 10 300 python bench.py --mode stream --wire \
+    > /tmp/_ci_wire_nonative.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_wire_nonative.json"))
+assert out["verified"], "CONSTDB_NO_NATIVE wire smoke failed its oracle"
+print("no-native wire smoke verified:",
+      f"batch leg {out['legs'][0]['fps']} fps, pure codec end to end")
+EOF
+
+echo
 echo "== serve-shards smoke (bench --mode serve --serve-shards 2) =="
 # tiny oracle-verified run of the shard-per-core serving plane over
 # real sockets: reply streams + visible-value export of every shard

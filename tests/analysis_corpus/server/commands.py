@@ -1,0 +1,54 @@
+"""Seeded NATIVE-CONTRACT violation: a command registered for
+coalescing that the native/intake.cpp table does not cover.  `zadd` is
+decorated @serve_plan but appears in none of the marker table's rows
+(native / native-reads / python-only), so the C scanner would demote it
+to OTHER silently — exactly one finding, on the decorator.  The handler
+itself is first-key-confined, so KEY-CONFINED stays quiet; `sadd`
+mirrors a real covered command and may not fire anything."""
+
+
+def register(name, flags=0, families=()):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def serve_plan(name):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def serve_read(name, kind, enc=None, arity=2):
+    def deco(fn):
+        return fn
+    return deco
+
+
+@register("zadd")
+def zadd_command(node, ctx, args):
+    key = args.next_bytes()
+    score = args.next_int()
+    member = args.next_bytes()
+    kid, _created = node.ks.get_or_create(key, 2, ctx.uuid)
+    node.ks.elem_add(kid, member, score, ctx.uuid, ctx.nodeid)
+    return kid
+
+
+@serve_plan("zadd")
+def _plan_zadd(coal, items):
+    return None
+
+
+@register("sadd")
+def sadd_command(node, ctx, args):
+    key = args.next_bytes()
+    member = args.next_bytes()
+    kid, _created = node.ks.get_or_create(key, 2, ctx.uuid)
+    node.ks.elem_add(kid, member, None, ctx.uuid, ctx.nodeid)
+    return kid
+
+
+@serve_plan("sadd")
+def _plan_sadd(coal, items):
+    return None

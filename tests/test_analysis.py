@@ -81,6 +81,11 @@ def test_corpus_expectations(corpus_findings):
     kc = by["KEY-CONFINED"]
     assert {f.token for f in kc} == {"badswap", "nokey"}
     assert not any("good" in f.qualname for f in kc)
+    # NATIVE-CONTRACT: the uncovered @serve_plan command only — the
+    # covered twin (sadd) and the table's own entries stay silent
+    nc = by["NATIVE-CONTRACT"]
+    assert [f.token for f in nc] == ["zadd"]
+    assert nc[0].qualname == "_plan_zadd"
 
 
 def test_findings_have_location_and_hint(corpus_findings):

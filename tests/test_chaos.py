@@ -44,6 +44,29 @@ def test_certify_default_cell(tmp_path):
     assert stats["reconnects"] >= 1
 
 
+def test_certify_native_intake_cell(tmp_path, monkeypatch):
+    """The everything-on cell with the native intake stage pinned ON
+    (server/io.py + native/intake.cpp): the C scanner owns the chaos
+    workload's pipelined client chunks while the full acceptance
+    schedule runs, and the loud-accounting law holds through it —
+    every wire corruption that reached a live parser demoted, none
+    were swallowed (`1 <= repl_wire_demotions <= corruptions
+    injected`, the slack being fate-shared one-shots that died with
+    their connection before delivery)."""
+    from constdb_tpu.utils import native_tables as NT
+    ext = NT.load_ext()
+    if ext is None or not hasattr(ext, "intake_scan"):
+        pytest.skip("native extension with intake_scan not built")
+    monkeypatch.setenv("CONSTDB_NATIVE_INTAKE", "1")
+    stats = run_scenario(certify_scenario(13, Cell()))
+    corruptions = stats["plane"].get("wire_corruptions", 0)
+    assert corruptions >= 1
+    assert 1 <= stats["wire_demotions"] <= corruptions
+    # the native stage really carried traffic — clients write through
+    # coalescing connections, so the gauge must have moved
+    assert stats["native_intake_chunks"] > 0
+
+
 def test_certify_legacy_cell(tmp_path):
     """Everything-off cell: per-frame wire, full snapshots only — the
     pure pre-capability paths under the same chaos schedule."""

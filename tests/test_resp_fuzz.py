@@ -192,3 +192,109 @@ def test_parsers_agree_on_random_trees():
     a.feed(wire)
     b.feed(wire)
     assert a.drain() == b.drain() == msgs
+
+
+# ------------------------------------------------- native intake stage
+
+def _intake_available() -> bool:
+    p = NativeRespParser()
+    p.feed(b"*2\r\n$4\r\nincr\r\n$1\r\nk\r\n")
+    return p.native_drain() is not None
+
+
+def rand_command(rng: random.Random) -> Arr:
+    """A random client-shaped command: plannable names (good and broken
+    arity), barriers, uppercase demotes, binary keys/values."""
+    names = (b"set", b"incr", b"decr", b"sadd", b"srem", b"hset", b"hdel",
+             b"get", b"scnt", b"sismember", b"smembers", b"hget",
+             b"hgetall", b"llen", b"del", b"SET", b"INCR", b"mvget",
+             b"zmystery")
+    nm = rng.choice(names)
+    n_args = rng.randrange(0, 5)
+    items = [Bulk(nm)] + [Bulk(bytes(rng.randrange(256)
+                                     for _ in range(rng.randrange(0, 12))))
+                          for _ in range(n_args)]
+    if rng.random() < 0.1:  # replication-shaped int item: non-flat
+        items.append(Int(rng.randrange(-100, 100)))
+    return Arr(items)
+
+
+@pytest.mark.skipif("not _intake_available()",
+                    reason="native intake stage not built")
+def test_native_intake_differential_random_chunks():
+    """The intake differential: for random pipelined chunks fed at
+    random byte boundaries, native_drain's opcode/payload plane
+    reconstructs the EXACT message sequence the pure parser yields —
+    plannable runs, demote cases, and partial frames included."""
+    from constdb_tpu.server.serve import _nat_msg
+    rng = random.Random(2024)
+    msgs = [rand_command(rng) for _ in range(500)]
+    wire = b"".join(encode_msg(m) for m in msgs)
+    parser = NativeRespParser()
+    got = []
+    pos = 0
+    while pos < len(wire) or len(got) < len(msgs):
+        step = rng.randrange(1, 80)
+        parser.feed(wire[pos:pos + step])
+        pos += step
+        while (nat := parser.native_drain()) is not None:
+            got.extend(_nat_msg(op, pl) for op, pl in zip(*nat))
+        got.extend(parser.drain())
+    assert got == msgs
+
+
+@pytest.mark.skipif("not _intake_available()",
+                    reason="native intake stage not built")
+def test_native_intake_truncation_cursor():
+    """Every-prefix truncation: the scanner's cursor only ever lands on
+    message boundaries, and feeding the remainder recovers the exact
+    sequence (no byte is consumed twice or skipped)."""
+    from constdb_tpu.server.serve import _nat_msg
+    msgs = [Arr([Bulk(b"set"), Bulk(b"k"), Bulk(b"v" * 9)]),
+            Arr([Bulk(b"incr"), Bulk(b"c")]),
+            Arr([Bulk(b"del"), Bulk(b"k")]),
+            Arr([Bulk(b"hget"), Bulk(b"h"), Bulk(b"f")])]
+    wire = b"".join(encode_msg(m) for m in msgs)
+    for cut in range(len(wire) + 1):
+        parser = NativeRespParser()
+        parser.feed(wire[:cut])
+        got = []
+        while (nat := parser.native_drain()) is not None:
+            got.extend(_nat_msg(op, pl) for op, pl in zip(*nat))
+        got.extend(parser.drain())
+        assert msgs[:len(got)] == got, cut
+        parser.feed(wire[cut:])
+        while (nat := parser.native_drain()) is not None:
+            got.extend(_nat_msg(op, pl) for op, pl in zip(*nat))
+        got.extend(parser.drain())
+        assert got == msgs, cut
+
+
+@pytest.mark.skipif("not _intake_available()",
+                    reason="native intake stage not built")
+@pytest.mark.parametrize("bad", (
+    b"!bogus\r\n",
+    b"$-2\r\n",
+    b"*1\r\n$3\r\nabcXY",
+    b"*2\r\n$4\r\nincr\r\nnope\r\n",
+))
+def test_native_intake_malformed_salvage(bad):
+    """A malformed frame behind a clean plannable run: the scanner
+    consumes (and the coalescer would execute) the clean prefix, then
+    drain() raises exactly as the pure path does, with nothing left to
+    salvage twice — the cursor parks at the bad frame."""
+    good = [Arr([Bulk(b"set"), Bulk(b"k"), Bulk(b"v")]),
+            Arr([Bulk(b"incr"), Bulk(b"c")])]
+    parser = NativeRespParser()
+    parser.feed(b"".join(encode_msg(m) for m in good) + bad)
+    nat = parser.native_drain()
+    assert nat is not None and len(nat[0]) == 2
+    with pytest.raises(InvalidRequestMsg):
+        parser.drain()
+    assert parser.take_queued() == []
+    # pure parser on the same full buffer: same clean prefix, same raise
+    pure = RespParser()
+    pure.feed(b"".join(encode_msg(m) for m in good) + bad)
+    with pytest.raises(InvalidRequestMsg):
+        pure.drain()
+    assert pure.take_queued() == good
