@@ -2297,6 +2297,399 @@ def serve_aof_main(args) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --mode recover: fast restart (BENCH_r20).  Recovery s/GB legs over the
+# SAME always-fsync log — serial per-record reference vs bulk merge rounds
+# vs concurrent per-shard segment replay vs a checkpointed tail — with the
+# never-crashed leg's visible values as the oracle and byte-identity
+# (canonical + full-state digest) required between serial and bulk.
+# ---------------------------------------------------------------------------
+
+
+def _recover_leg(aof_dir: str, bulk: bool, reps: int):
+    """Timed in-process boot replays of one log dir (the real
+    persist/oplog.py recover path); returns the best-of-reps
+    (wall, node, info) with GC drained for the visible-value oracle.
+
+    The timed region runs with the pre-existing heap FROZEN out of the
+    cyclic collector: a real boot replays into a near-empty process,
+    but by the time this leg runs the bench process retains every
+    earlier leg's oracle state, and collector passes triggered inside
+    the replay would scan that unrelated heap — inflating whichever
+    leg happens to allocate more and drowning the s/GB signal."""
+    import gc
+
+    from constdb_tpu.persist import oplog as OL
+    from constdb_tpu.server.node import Node as _Node
+
+    best = None
+    for _ in range(reps):
+        node = _Node(node_id=1, alias="recover")
+        gc.collect()
+        gc.freeze()
+        try:
+            t0 = time.perf_counter()
+            info = OL.recover(node, aof_dir, bulk=bulk)
+            wall = time.perf_counter() - t0
+        finally:
+            gc.unfreeze()
+        if best is None or wall < best[0]:
+            best = (wall, node, info)
+    wall, node, info = best
+    _gc_drain(node)
+    return wall, node, info
+
+
+def _recover_pair(aof_dir: str, reps: int):
+    """Serial and bulk legs with INTERLEAVED reps (serial, bulk, serial,
+    bulk, ...): burstable builder hosts throttle over a run, so timing
+    all serial reps before all bulk reps hands whichever leg goes first
+    the faster CPU state and skews the ratio.  Returns the two
+    best-of-reps (wall, node, info) triples."""
+    s = b = None
+    for _ in range(reps):
+        sw = _recover_leg(aof_dir, False, 1)
+        bw = _recover_leg(aof_dir, True, 1)
+        if s is None or sw[0] < s[0]:
+            s = sw
+        if b is None or bw[0] < b[0]:
+            b = bw
+    return s, b
+
+
+def _alive_values(canon: dict) -> dict:
+    """The GC-invariant recovery oracle projection (see serve_aof_main):
+    visible values of live keys only."""
+    return {k: v for k, v in strip_canonical_times(canon).items() if v[1]}
+
+
+def _gc_drain(node) -> None:
+    for _ in range(64):
+        node.gc()
+        if not node.ks.garbage:
+            break
+
+
+def _frame_log_build(aof_dir: str, n_ops: int, n_keys: int):
+    """Drive the exact single-loop command path with an armed op log:
+    every write mirrors per-frame (Node.replicate_cmd ->
+    OpLog.append_local), the REC_FRAME-heavy log shape that
+    interactive shallow-pipeline traffic produces — the log where the
+    serial replay reference is genuinely one apply per record.
+    Returns the live node (GC-drained) as the never-crashed
+    reference."""
+    import random
+
+    from constdb_tpu.persist import oplog as OL
+    from constdb_tpu.resp.message import Arr, Bulk
+    from constdb_tpu.server.node import Node as _Node
+
+    rng = random.Random(1307)
+    node = _Node(node_id=1, alias="framelog")
+    lg = OL.OpLog(aof_dir, fsync_policy="no", node=node)
+    node.oplog = lg
+    for i in range(n_ops):
+        r = rng.random()
+        k = b"%05d" % rng.randrange(n_keys)
+        if r < 0.25:
+            body = (b"set", b"r" + k, b"v%08d" % i)
+        elif r < 0.50:
+            body = (b"incr", b"c" + k, b"%d" % rng.randrange(1, 100))
+        elif r < 0.75:
+            body = (b"sadd", b"s" + k,
+                    *(b"m%03d" % rng.randrange(256) for _ in range(8)))
+        elif r < 0.97:
+            fv = []
+            for f in range(10):
+                fv += [b"f%02d" % rng.randrange(32), b"v%07d%d" % (i, f)]
+            body = (b"hset", b"h" + k, *fv)
+        elif r < 0.995:
+            body = (b"srem", b"s" + k, b"m%03d" % rng.randrange(256))
+        else:
+            body = (b"del", b"r" + k)   # -> delbytes, columnar-encodable
+        node.execute(Arr([Bulk(b) for b in body]))
+    lg.close()
+    node.oplog = None
+    _gc_drain(node)
+    return node
+
+
+def _sharded_restart(aof_dir: str, recover_shards: int):
+    """One in-process sharded restart over an existing per-shard log:
+    boots the 2-shard plane with CONSTDB_RECOVER_SHARDS pinned, reads
+    the recovery gauges, exports the consolidated canonical, closes.
+    Returns (recovery_wall_s, gauges, alive-values projection)."""
+    import asyncio
+
+    from constdb_tpu.server.io import start_node
+    from constdb_tpu.server.node import Node as _Node
+
+    async def main():
+        node = _Node(node_id=1, alias="rec")
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               work_dir=os.path.dirname(aof_dir),
+                               serve_shards=2, aof=True, aof_fsync="no",
+                               aof_dir=aof_dir)
+        try:
+            x = node.stats.extra
+            wall = x["recovery_wall_s"]
+            gauges = {"recovery_mode": x["recovery_mode"],
+                      "recovery_shards": x["recovery_shards"]}
+            canon = await node.serve_plane.canonical()
+        finally:
+            await app.close()
+        return wall, gauges, _alive_values(canon)
+
+    os.environ["CONSTDB_RECOVER_SHARDS"] = str(recover_shards)
+    try:
+        return asyncio.run(main())
+    finally:
+        os.environ.pop("CONSTDB_RECOVER_SHARDS", None)
+
+
+def _checkpoint_cut(src_dir: str, dst_dir: str, tail_ops: int) -> int:
+    """Copy a log dir, run ONE incremental-checkpoint cut on the copy
+    (the rewrite machinery recover_main's checkpointed-tail leg
+    restarts from), then write a small post-cut tail of NEW keys over
+    the socket — the restart must replay exactly that tail, nothing
+    before the cut.  Returns the post-cut tail bytes."""
+    import asyncio
+    import shutil
+
+    from constdb_tpu.chaos.cluster import Client
+    from constdb_tpu.resp.codec import encode_msg
+    from constdb_tpu.resp.message import Arr, Bulk
+    from constdb_tpu.server.io import start_node
+    from constdb_tpu.server.node import Node as _Node
+
+    shutil.copytree(src_dir, dst_dir)
+
+    async def main():
+        node = _Node(node_id=1, alias="ckpt")
+        app = await start_node(node, host="127.0.0.1", port=0,
+                               work_dir=os.path.dirname(dst_dir),
+                               aof=True, aof_fsync="no", aof_dir=dst_dir)
+        try:
+            await node.oplog.rewrite(app)
+            assert node.oplog.checkpoint_uuid > 0
+            c = await Client().connect(app.advertised_addr)
+            try:
+                buf = bytearray()
+                for i in range(tail_ops):
+                    buf += encode_msg(Arr([Bulk(b"SET"),
+                                           Bulk(b"rtail:%d" % i),
+                                           Bulk(b"tv%d" % i)]))
+                c.writer.write(bytes(buf))
+                await c.writer.drain()
+                got = 0
+                while got < tail_ops:
+                    if c.parser.next_msg() is not None:
+                        got += 1
+                        continue
+                    data = await asyncio.wait_for(
+                        c.reader.read(1 << 16), 10.0)
+                    assert data, "EOF mid-tail"
+                    c.parser.feed(data)
+            finally:
+                c.writer.close()
+            return node.oplog.size_bytes() - node.oplog.base_size
+        finally:
+            await app.close()
+
+    return asyncio.run(main())
+
+
+def recover_main(args) -> None:
+    """`bench.py --mode recover`: the fast-restart curve — an
+    always-fsync serve leg produces the log (its visible values are the
+    never-crashed reference), then recovery replays it {serial
+    per-record, bulk merge rounds, bulk + concurrent shard segments,
+    checkpointed tail}, each timed as s/GB.  Serial and bulk must land
+    byte-identical (canonical + full-state digest); every leg's alive
+    values must equal the reference's."""
+    import shutil
+    import tempfile
+
+    from constdb_tpu.store.digest import full_state_digest
+
+    n_ops = int(os.environ.get("CONSTDB_BENCH_RECOVER_OPS", 60_000))
+    n_conns = int(os.environ.get("CONSTDB_BENCH_SERVE_CONNS", 4))
+    pipeline = int(os.environ.get("CONSTDB_BENCH_SERVE_PIPELINE", 64))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_SERVE_KEYS", 2000))
+    serve_batch = int(os.environ.get("CONSTDB_BENCH_SERVE_BATCH", 512))
+    engine_kind = os.environ.get("CONSTDB_BENCH_SERVE_ENGINE", "cpu")
+    reps = int(os.environ.get("CONSTDB_BENCH_RECOVER_REPS", 3))
+
+    ensure_native()
+    per_ops = n_ops // n_conns
+    per_conn = [serve_workload(ci, per_ops, n_keys, pipeline)
+                for ci in range(n_conns)]
+    total = per_ops * n_conns
+    print(f"[bench] recover workload: {total} ops over {n_conns} conns x "
+          f"{pipeline}-deep pipelines", file=sys.stderr)
+
+    root = tempfile.mkdtemp(prefix="constdb-recbench-")
+    try:
+        # -- datasets: one unsharded always-fsync log + one 2-shard log
+        flat_dir = os.path.join(root, "flat")
+        leg = _serve_leg(serve_batch, engine_kind, per_conn,
+                         aof_policy="always", aof_dir=flat_dir)
+        live_vis = _alive_values(leg[3])
+        log_bytes = sum(os.path.getsize(os.path.join(flat_dir, f))
+                        for f in os.listdir(flat_dir)
+                        if f.endswith(".log"))
+        shard_dir = os.path.join(root, "shards")
+        sleg = _serve_leg(serve_batch, engine_kind, per_conn,
+                          serve_shards=2, aof_policy="always",
+                          aof_dir=shard_dir)
+        shard_vis = _alive_values(sleg[3])
+        shard_bytes = sum(os.path.getsize(os.path.join(shard_dir, f))
+                          for f in os.listdir(shard_dir)
+                          if f.endswith(".log"))
+        gb = max(log_bytes / 1e9, 1e-9)
+
+        # -- serial reference vs bulk merge rounds, byte-identity bar
+        (s_wall, s_node, s_info), (b_wall, b_node, b_info) = \
+            _recover_pair(flat_dir, reps)
+        s_canon, b_canon = s_node.canonical(), b_node.canonical()
+        byte_identical = s_canon == b_canon and \
+            full_state_digest(s_node.ks) == full_state_digest(b_node.ks)
+        vis_ok = _alive_values(b_canon) == live_vis and \
+            _alive_values(s_canon) == live_vis
+        speedup = s_wall / b_wall
+        print(f"[bench] batch log serial: {s_wall:.3f}s = "
+              f"{s_wall / gb:,.1f} s/GB; "
+              f"bulk: {b_wall:.3f}s = {b_wall / gb:,.1f} s/GB "
+              f"({b_info.merge_rounds} rounds) -> {speedup:.2f}x; "
+              f"byte-identical {'OK' if byte_identical else 'MISMATCH'}, "
+              f"oracle {'OK' if vis_ok else 'MISMATCH'}", file=sys.stderr)
+
+        # -- frame-record log (interactive shallow-pipeline shape): the
+        # serial reference is genuinely one apply per record here, the
+        # path the tentpole's s/GB bar is measured against.  The live
+        # frame node itself is the never-crashed reference, and serial,
+        # bulk and reference must agree byte-for-byte
+        frame_dir = os.path.join(root, "frames")
+        f_node = _frame_log_build(frame_dir, total, n_keys)
+        f_canon = f_node.canonical()
+        f_digest = full_state_digest(f_node.ks)
+        frame_bytes = sum(os.path.getsize(os.path.join(frame_dir, f))
+                          for f in os.listdir(frame_dir)
+                          if f.endswith(".log"))
+        fgb = max(frame_bytes / 1e9, 1e-9)
+        (fs_wall, fs_node, fs_info), (fb_wall, fb_node, fb_info) = \
+            _recover_pair(frame_dir, reps)
+        frame_identical = \
+            fs_node.canonical() == f_canon and \
+            fb_node.canonical() == f_canon and \
+            full_state_digest(fs_node.ks) == f_digest and \
+            full_state_digest(fb_node.ks) == f_digest
+        frame_speedup = fs_wall / fb_wall
+        print(f"[bench] frame log ({frame_bytes} B): serial per-record: "
+              f"{fs_wall:.3f}s = {fs_wall / fgb:,.1f} s/GB; bulk: "
+              f"{fb_wall:.3f}s = {fb_wall / fgb:,.1f} s/GB "
+              f"({fb_info.merge_rounds} rounds) -> {frame_speedup:.2f}x; "
+              f"byte-identical "
+              f"{'OK' if frame_identical else 'MISMATCH'}",
+              file=sys.stderr)
+
+        # -- shard curve: serial merged stream vs auto per-segment tasks
+        sgb = max(shard_bytes / 1e9, 1e-9)
+        shard_curve = []
+        shards_ok = True
+        for knob in (1, 0):
+            wall, gauges, vis = _sharded_restart(shard_dir, knob)
+            ok = vis == shard_vis
+            shards_ok = shards_ok and ok
+            shard_curve.append({
+                "recover_shards_knob": knob,
+                "recovery_wall_s": wall,
+                "s_per_gb": round(wall / sgb, 2),
+                **gauges,
+                "verified": ok,
+            })
+            print(f"[bench] sharded restart knob={knob}: {wall:.3f}s "
+                  f"({gauges['recovery_mode']}, "
+                  f"{gauges['recovery_shards']} replay tasks); oracle "
+                  f"{'OK' if ok else 'MISMATCH'}", file=sys.stderr)
+
+        # -- checkpointed tail: one cut + a small post-cut tail, then a
+        # timed restart that must replay ONLY the tail
+        ckpt_dir = os.path.join(root, "ckpt")
+        tail_n = max(64, total // 100)
+        tail_bytes = _checkpoint_cut(flat_dir, ckpt_dir, tail_n)
+        c_wall, c_node, c_info = _recover_leg(ckpt_dir, True, reps)
+        full_ops = s_info.frames + s_info.batch_frames
+        ckpt_ops = c_info.frames + c_info.batch_frames
+        c_vis = _alive_values(c_node.canonical())
+        # the tail only ADDS new keys: pre-cut acked state must survive
+        # the cut byte-for-byte, and replay must stop at the tail
+        ckpt_ok = all(c_vis.get(k) == v for k, v in live_vis.items()) \
+            and c_vis.get(b"rtail:0") is not None \
+            and 0 < ckpt_ops < full_ops
+        print(f"[bench] checkpointed tail: {c_wall:.3f}s "
+              f"({ckpt_ops} tail ops from {tail_bytes} tail bytes vs "
+              f"{full_ops} full-log ops); oracle "
+              f"{'OK' if ckpt_ok else 'MISMATCH'}", file=sys.stderr)
+
+        verified = byte_identical and vis_ok and frame_identical \
+            and shards_ok and ckpt_ok
+        out = {
+            "metric": "recovery_bulk_speedup_vs_serial",
+            "value": round(frame_speedup, 2),
+            "unit": "ratio",
+            "mode": "recover",
+            "host_note": "burstable 1-core box: the concurrent shard "
+                         "legs cannot show a parallel wall-clock win "
+                         "(every replay task shares the core, as in "
+                         "BENCH_r19) — the curve still exercises and "
+                         "gauge-records the per-segment concurrency; "
+                         "the serial-vs-bulk ratios are core-count "
+                         "independent (same process, same core).  The "
+                         "headline ratio is the frame-record log (the "
+                         "interactive shallow-pipeline shape, where "
+                         "the serial reference is one apply per "
+                         "record); the REPLBATCH log ratio rides in "
+                         "legs[] — its records are already columnar, "
+                         "so serial replay there is per-record only "
+                         "in engine calls, not in python ops",
+            "ops": total,
+            "log_bytes": log_bytes,
+            "frame_log_bytes": frame_bytes,
+            "legs": [
+                {"leg": "frames-serial", "wall_s": round(fs_wall, 3),
+                 "s_per_gb": round(fs_wall / fgb, 2),
+                 "ops": fs_info.frames + fs_info.batch_frames},
+                {"leg": "frames-bulk", "wall_s": round(fb_wall, 3),
+                 "s_per_gb": round(fb_wall / fgb, 2),
+                 "merge_rounds": fb_info.merge_rounds,
+                 "speedup_vs_serial": round(frame_speedup, 2),
+                 "byte_identical": frame_identical},
+                {"leg": "batch-serial", "wall_s": round(s_wall, 3),
+                 "s_per_gb": round(s_wall / gb, 2),
+                 "ops": s_info.frames + s_info.batch_frames},
+                {"leg": "batch-bulk", "wall_s": round(b_wall, 3),
+                 "s_per_gb": round(b_wall / gb, 2),
+                 "merge_rounds": b_info.merge_rounds,
+                 "speedup_vs_serial": round(speedup, 2),
+                 "byte_identical": byte_identical},
+                {"leg": "checkpointed-tail", "wall_s": round(c_wall, 3),
+                 "tail_bytes": tail_bytes, "tail_ops": ckpt_ops,
+                 "full_log_ops": full_ops},
+            ],
+            "shard_curve": shard_curve,
+            "shard_log_bytes": shard_bytes,
+            "engine": engine_kind,
+            "verified": verified,
+            "host": host_fingerprint(),
+        }
+        print(json.dumps(out))
+        if not verified:
+            sys.exit(1)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # --mode intake: the native intake plane (BENCH_r19).  Serve legs with the
 # C intake stage ON vs OFF (CONSTDB_NATIVE_INTAKE) plus the full-fallback
 # CONSTDB_NO_NATIVE=1 leg, interleaved best-of-N, reply-stream + stripped-
@@ -3344,7 +3737,7 @@ def main() -> None:
                     "1 = single-keyspace path)")
     ap.add_argument("--mode",
                     choices=["snapshot", "stream", "serve", "resync",
-                             "tensor", "intake"],
+                             "tensor", "intake", "recover"],
                     default="snapshot",
                     help="snapshot = bulk catch-up merge (default); "
                     "stream = steady-state replication apply through the "
@@ -3356,7 +3749,9 @@ def main() -> None:
                     "reads vs the host reference at micro-batch size; "
                     "intake = the native intake plane — C intake stage "
                     "vs pure-Python serve legs + the REPLBATCH codec "
-                    "legs (BENCH_r19)")
+                    "legs (BENCH_r19); recover = fast-restart s/GB "
+                    "curve — serial vs bulk merge rounds vs concurrent "
+                    "shard segments vs checkpointed tail (BENCH_r20)")
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
@@ -3420,6 +3815,9 @@ def main() -> None:
         return
     if args.mode == "intake":
         intake_main(args)
+        return
+    if args.mode == "recover":
+        recover_main(args)
         return
     if args.mode == "resync":
         resync_main(args)

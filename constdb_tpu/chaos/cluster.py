@@ -372,6 +372,25 @@ class ChaosCluster:
                     self.retired_stats.get("journal_pruned", 0) + pruned
         return app2
 
+    async def checkpoint_crash(self, i: int, stage: str) -> ServerApp:
+        """Crash INSIDE an incremental checkpoint: arm the op log's
+        injected fault at `stage` ("switch" = new generation opened,
+        "snapshot" = base snapshot written, "meta" = meta committed but
+        old generations not yet deleted), drive a rewrite into it, then
+        kill -9 and cold-restart from whatever interleaving the fault
+        left on disk.  Every stage must replay idempotently: the
+        surviving generations re-merge to the same state (the
+        checkpoint-cut consistency law — the oracle certifies
+        convergence right after)."""
+        app = self.apps[i]
+        lg = app.node.oplog
+        assert lg is not None, "checkpoint_crash targets AOF nodes"
+        lg._ckpt_fault = stage
+        await lg.rewrite(app)  # raises inside; caught + flagged dirty
+        assert lg._ckpt_fault == "", \
+            f"checkpoint fault {stage!r} did not fire"
+        return await self.kill9(i)
+
     async def restart_warm(self, i: int) -> ServerApp:
         """Process hiccup: the Node object (state, undo log, repl_log)
         survives, every connection does not."""

@@ -51,13 +51,18 @@ class Cell:
     #                            off.  AOF cells grow kill9_mid_write +
     #                            torn_write steps — cold restarts that
     #                            recover from the node's OWN log.
+    ckpt: bool = False      # crash-mid-checkpoint steps (round 20):
+    #                         fault-inject each rewrite interleaving
+    #                         (generation switch / snapshot / meta
+    #                         commit), kill -9, certify the replay
 
     @property
     def name(self) -> str:
         return (f"wire{int(self.wire)}-delta{int(self.delta)}"
                 f"-comp{int(self.compress)}"
                 f"-shards{self.shards}-{self.engine}"
-                + (f"-aof-{self.aof}" if self.aof else ""))
+                + (f"-aof-{self.aof}" if self.aof else "")
+                + ("-ckpt" if self.ckpt else ""))
 
     def specs(self, n: int = 3, mixed_idx: Optional[int] = None
               ) -> list[NodeSpec]:
@@ -121,6 +126,9 @@ def matrix_cells() -> list[Cell]:
     cells.append(Cell(wire=False, delta=False, compress=False,
                       aof="always"))
     cells.append(Cell(wire=False, shards=2, aof="always"))
+    # crash-mid-checkpoint (round 20): the incremental-checkpoint cut
+    # must be idempotent at every fault interleaving
+    cells.append(Cell(aof="always", ckpt=True))
     return cells
 
 
@@ -132,7 +140,7 @@ def smoke_cells() -> list[Cell]:
     plane."""
     return [Cell(), Cell(wire=False, delta=False, compress=False),
             Cell(engine="xla-resident"), Cell(shards=2, wire=False),
-            Cell(aof="always"), Cell(aof="everysec")]
+            Cell(aof="always", ckpt=True), Cell(aof="everysec")]
 
 
 @dataclass
@@ -233,6 +241,14 @@ def certify_scenario(seed: int, cell: Optional[Cell] = None,
             ("torn_write", 1),
             ("ops", ops),
         ]
+        if cell.ckpt:
+            # crash-mid-checkpoint (round 20): each fault interleaving
+            # of the rewrite's commit sequence leaves a different disk
+            # state (new gen open / base written / meta committed with
+            # the old generations still on disk) — all must cold-replay
+            # to the same bytes
+            for stage in ("switch", "snapshot", "meta"):
+                steps += [("ckpt_crash", 0, stage), ("ops", ops // 2)]
     steps += [("certify",)]
     return Scenario(seed=seed, cell=cell, steps=steps,
                     ops_per_burst=ops)
@@ -552,6 +568,13 @@ async def _run_scenario_async(sc: Scenario) -> dict:
                     i = step[1]
                     await _kill9_mid_write(cluster, wl, i,
                                            torn=kind == "torn_write")
+                    wl.clear_undo(i)
+                elif kind == "ckpt_crash":
+                    i = step[1]
+                    await cluster.checkpoint_crash(i, step[2])
+                    # the restarted process lost its in-memory undo
+                    # window (rewrite()'s opening group commit still
+                    # makes every acked op durable before the kill)
                     wl.clear_undo(i)
                 elif kind == "clock_jump":
                     cluster.clock_jump(step[1], step[2])

@@ -245,6 +245,30 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "oplog size floor (MB) below which the rewrite trigger "
            "never fires — tiny logs are cheaper to replay than to "
            "compact"),
+    EnvVar("CONSTDB_RECOVER_BULK", "1",
+           "bulk-merge boot replay (persist/oplog.py): decoded AOF "
+           "records accumulate into merge rounds sized like snapshot "
+           "ingest chunks and land through one engine merge_many call "
+           "per round; 0 pins the per-record reference path (each "
+           "record merges individually — the serial replay the bench "
+           "oracle compares against)"),
+    EnvVar("CONSTDB_RECOVER_SHARDS", "0",
+           "concurrent per-segment AOF replay on a sharded node "
+           "(persist/oplog.py recover_into_plane): per-shard segments "
+           "decode and route to their serve workers concurrently "
+           "(cross-segment records commute — the parallel recovery "
+           "law); 0 = auto (one replay task per segment), 1 = the "
+           "serial merged-stream path, N caps the concurrency"),
+    EnvVar("CONSTDB_CHECKPOINT_SECS", "0",
+           "incremental checkpoint cadence (seconds): past it the cron "
+           "cuts a consistent base snapshot + fresh AOF generation (the "
+           "rewrite machinery, time-triggered), so a restart replays "
+           "only the post-checkpoint tail; 0 disables (growth-triggered "
+           "rewrites via CONSTDB_AOF_REWRITE_PCT still run)"),
+    EnvVar("CONSTDB_CHECKPOINT_MIN_MB", "1",
+           "minimum MB of post-checkpoint log tail before a time-due "
+           "checkpoint actually cuts — an idle node never churns "
+           "snapshots just because the clock advanced"),
 )}
 
 
@@ -345,6 +369,13 @@ class Config:
     #                        the post-rewrite base; 0 = off); -1 = the
     #                        CONSTDB_AOF_REWRITE_PCT env default (100)
     aof_dir: str = ""      # segment directory; "" = <work_dir>/aof
+    restore_to: int = 0    # point-in-time restore: boot replays the AOF
+    #                        only up to this uuid (record-boundary
+    #                        granularity), then re-bases the log on the
+    #                        restored state.  Run it against a COPY of
+    #                        the data dir — the skipped suffix is
+    #                        discarded by the re-basing checkpoint.
+    #                        0 = full recovery (the normal boot).
     # a peer silent for longer than this stops pinning the GC tombstone
     # horizon.  0 (default) = never exclude — the reference's behavior,
     # where one dead peer pins tombstone collection mesh-wide forever
@@ -378,6 +409,11 @@ def load_config(argv: list[str] | None = None) -> Config:
                     default=None, help="enable the durable op log")
     ap.add_argument("--aof-fsync", dest="aof_fsync",
                     choices=["always", "everysec", "no"])
+    ap.add_argument("--restore-to", type=int, dest="restore_to",
+                    metavar="UUID",
+                    help="point-in-time restore: replay the AOF only up "
+                         "to this uuid, then re-base the log (run "
+                         "against a copy of the data dir)")
     ap.add_argument("--log-level", dest="log_level")
     ns = ap.parse_args(argv)
 

@@ -105,6 +105,73 @@ class ColumnarBatch:
                 + len(self.tns_ki))
 
 
+def concat_batches(batches: list) -> ColumnarBatch:
+    """Concatenate op-stream batches plane-wise into ONE wide batch
+    (row-plane `*_ki` indices shifted past the earlier batches' keys).
+
+    Sound for duplicate-safe consumers only: the result repeats key
+    slots and row slots across the inputs, so it must land through the
+    scatter-reduction paths (`rows_unique_per_slot` stays False —
+    resolve_keys interns repeats, the fold_* reductions pick the same
+    associative winners folding once as merging the inputs in order).
+    This is what makes a replay MERGE ROUND genuinely wide: one key
+    resolution and one vectorized pass per plane per round, instead of
+    one per few-hundred-row record (persist/oplog.py _merge_round).
+
+    Row order within each plane preserves input order, so the per-row
+    planes (tensors) replay exactly as the sequential merges would."""
+    if len(batches) == 1:
+        return batches[0]
+    out = ColumnarBatch()
+    offs = np.cumsum([0] + [b.n_keys for b in batches[:-1]])
+
+    def cat(name):
+        return np.concatenate([getattr(b, name) for b in batches])
+
+    def cat_ki(name):
+        return np.concatenate([getattr(b, name) + off
+                               for b, off in zip(batches, offs)])
+
+    def cat_list(name):
+        o = []
+        for b in batches:
+            o.extend(getattr(b, name))
+        return o
+
+    out.keys = cat_list("keys")
+    out.key_enc = cat("key_enc")
+    out.key_ct = cat("key_ct")
+    out.key_mt = cat("key_mt")
+    out.key_dt = cat("key_dt")
+    out.key_expire = cat("key_expire")
+    out.reg_val = cat_list("reg_val")
+    out.reg_t = cat("reg_t")
+    out.reg_node = cat("reg_node")
+    out.cnt_ki = cat_ki("cnt_ki")
+    out.cnt_node = cat("cnt_node")
+    out.cnt_val = cat("cnt_val")
+    out.cnt_uuid = cat("cnt_uuid")
+    out.cnt_base = cat("cnt_base")
+    out.cnt_base_t = cat("cnt_base_t")
+    out.el_ki = cat_ki("el_ki")
+    out.el_member = cat_list("el_member")
+    out.el_val = cat_list("el_val")
+    out.el_add_t = cat("el_add_t")
+    out.el_add_node = cat("el_add_node")
+    out.el_del_t = cat("el_del_t")
+    out.tns_ki = cat_ki("tns_ki")
+    out.tns_node = cat("tns_node")
+    out.tns_uuid = cat("tns_uuid")
+    out.tns_cnt = cat("tns_cnt")
+    out.tns_cfg = cat_list("tns_cfg")
+    out.tns_payload = cat_list("tns_payload")
+    out.del_keys = cat_list("del_keys")
+    out.del_t = cat("del_t")
+    if all(b.el_has_vals is False for b in batches):
+        out.el_has_vals = False
+    return out
+
+
 def has_values(vals: list) -> bool:
     """Single home for the has-element-values predicate (list.count scans
     at C speed; empty bytes count as values, only None is absent — the

@@ -94,7 +94,7 @@ import zlib
 from typing import Optional
 
 from ..errors import CstError
-from ..utils.varint import VarintReader, write_uvarint
+from ..utils.varint import VarintReader, read_uvarint, write_uvarint
 
 log = logging.getLogger(__name__)
 
@@ -119,6 +119,22 @@ _EVERYSEC = 1.0
 # watermark movement — HLC uuids carry wall-ms in their high bits, so
 # this is ~0.5s of clock travel
 _WMARK_HLC_STRIDE = 500 << 22
+# boot-replay bulk-merge rounds (CONSTDB_RECOVER_BULK): decoded records
+# accumulate until this many columnar rows, then land through ONE
+# engine merge_many call.  The budget is pinned AT the host
+# micro-strategy ceiling (engine/hostbatch.py HOST_MICRO_MAX): one row
+# past it and the CPU engine routes the round onto its per-row
+# reference loop — the very path bulk replay exists to avoid — so
+# rounds close BEFORE a push would cross it, never after
+_REPLAY_ROUND_ROWS = 1 << 15
+# op-stream frames buffered per columnar encode in bulk replay: larger
+# than the live coalescer's 512 because boot replay has no latency
+# bound — fewer, wider group-encode runs (serial replay buffers
+# nothing: one apply per record, the reference path)
+_REPLAY_BULK_FRAMES = 1 << 13
+# progress log cadence during a long replay (ops between lines), so a
+# multi-minute restart is observable instead of silent
+_REPLAY_PROGRESS_EVERY = 200_000
 
 
 class OpLogError(CstError):
@@ -131,18 +147,41 @@ def _pack_record(rtype: int, payload: bytes) -> bytes:
             + zlib.crc32(body).to_bytes(4, "little") + body)
 
 
-def scan_segment(path: str):
+def scan_segment(path: str, classes: tuple = (), raw: bool = False):
     """-> (records, valid_bytes, total_bytes).  `records` is the maximal
     valid prefix as (rtype, payload bytes); `valid_bytes` is the offset
     of the first invalid byte (== total when the file is whole).  A
     missing/short/wrong magic header raises OpLogError — that file is
     UNREADABLE, not torn (the boot-quarantine satellite distinguishes
-    the two)."""
+    the two).
+
+    The per-record walk (framing + crc + rtype gate) runs in the native
+    extension when built — one C call per segment instead of ~9us of
+    interpreter dispatch per record.  `classes`: the six RESP message
+    classes (`_frame_ctx()[1:]`); when given AND the native scanner is
+    available, REC_FRAME records whose payload decodes cleanly come
+    back pre-decoded as `(REC_FRAME, origin, uuid, name, args)`
+    5-tuples (no payload bytes object, no second parse pass) — any
+    anomaly degrades that record to the raw `(rtype, payload)` shape
+    so the Python reference decode accepts-or-skips it unchanged.
+    `raw` (bulk replay only): flat all-bulk command frames decode to
+    PLAIN BYTES args instead of Bulk objects — the columnar encoders
+    unwrap every argument anyway, so the wrappers are pure overhead
+    there; the arg coercions (resp/message.py as_bytes/as_int/as_uint)
+    pass bytes through, and _ReplayApplier re-wraps before any
+    reference apply."""
     with open(path, "rb") as f:
         data = f.read()
     n = len(data)
     if n < len(MAGIC) or data[:len(MAGIC)] != MAGIC:
         raise OpLogError(f"bad oplog segment header: {path}")
+    from ..resp.codec import _ext
+    ext = _ext()
+    if ext is not None and hasattr(ext, "aof_scan"):
+        flags = (1,) if (raw and classes) else ()
+        records, pos = ext.aof_scan(data, len(MAGIC), _MAX_RECORD,
+                                    *classes, *flags)
+        return records, pos, n
     records = []
     pos = len(MAGIC)
     while pos + 8 <= n:
@@ -193,7 +232,8 @@ class RecoveryInfo:
     __slots__ = ("source", "frames", "batches", "batch_frames", "wmarks",
                  "skipped", "tail_truncated", "truncated_bytes",
                  "quarantined", "wmark_unsafe", "local_max",
-                 "replayed_max", "fence", "hlc_mark")
+                 "replayed_max", "fence", "hlc_mark", "mode", "shards",
+                 "merge_rounds", "restore_to", "restore_skipped")
 
     def __init__(self) -> None:
         self.source = "empty"
@@ -218,6 +258,14 @@ class RecoveryInfo:
         # any peer can have seen — recovery re-observes it so post-
         # crash mints can never dip below a pre-crash beacon promise
         self.hlc_mark = 0
+        # how the replay ran (INFO Recovery gauges): "serial" is the
+        # per-record reference path, "bulk" the merge-round path,
+        # "bulk+shards<N>" the concurrent per-segment plane replay
+        self.mode = "serial"
+        self.shards = 1            # replay concurrency actually used
+        self.merge_rounds = 0      # bulk merge_many rounds landed
+        self.restore_to = 0        # point-in-time target uuid (0 = full)
+        self.restore_skipped = 0   # ops past the target, not replayed
 
 
 class OpLog:
@@ -229,6 +277,8 @@ class OpLog:
                  fsync_policy: str = "everysec",
                  rewrite_pct: int = 100,
                  rewrite_min_bytes: int = 16 << 20,
+                 checkpoint_secs: float = 0.0,
+                 checkpoint_min_bytes: int = 1 << 20,
                  node=None) -> None:
         if fsync_policy not in FSYNC_POLICIES:
             raise ValueError(f"CONSTDB_AOF_FSYNC must be one of "
@@ -238,6 +288,13 @@ class OpLog:
         self.policy = fsync_policy
         self.rewrite_pct = max(0, rewrite_pct)
         self.rewrite_min_bytes = max(1 << 20, rewrite_min_bytes)
+        # incremental checkpoints (CONSTDB_CHECKPOINT_SECS): the rewrite
+        # machinery time-triggered — a periodic consistent base snapshot
+        # + generation cut so a restart replays only the tail.  0 = off
+        # (growth-triggered rewrites still run).  min_bytes keeps an
+        # idle node from churning snapshots on a clock cadence alone.
+        self.checkpoint_secs = max(0.0, checkpoint_secs)
+        self.checkpoint_min_bytes = max(0, checkpoint_min_bytes)
         self.node = node
         os.makedirs(aof_dir, exist_ok=True)
         meta = _read_meta(self.meta_path(aof_dir))
@@ -303,6 +360,25 @@ class OpLog:
         self._wmark_ok = meta.get("wmark_ok", "1") != "0"
         self._last_wmark_sig = None
         self._rewrite_asap = meta.get("dirty", "0") == "1"
+        # last checkpoint/rewrite cut, persisted in the meta so the
+        # INFO gauges (checkpoint_last_uuid / checkpoint_age_s) and
+        # --restore-to survive a restart
+        try:
+            self.checkpoint_uuid = int(meta.get("ckpt_uuid", 0) or 0)
+            self.checkpoint_ts = float(meta.get("ckpt_ts", 0) or 0.0)
+        except ValueError:
+            self.checkpoint_uuid, self.checkpoint_ts = 0, 0.0
+        # cadence is measured on the monotonic clock from boot (a node
+        # restored from an old checkpoint must not cut immediately just
+        # because the persisted wall ts is stale)
+        self._last_ckpt_mono = time.monotonic()
+        # chaos fault injection: name of the rewrite stage to crash at
+        # ("switch" | "snapshot" | "meta"); "" = no fault.  The chaos
+        # crash-mid-checkpoint cell sets it, drives one rewrite, then
+        # kill -9s the node — certifying every on-disk interleaving of
+        # the generation switch / meta commit / old-gen delete replays
+        # idempotently.
+        self._ckpt_fault = ""
         self._rewriting = False
         self._rewrite_buf_bytes = 0
         self._sync_lock = asyncio.Lock() if _has_loop() else None
@@ -801,7 +877,8 @@ class OpLog:
                 # forever (the barrier is the normal path)
                 if time.monotonic() - self._last_sync >= _EVERYSEC:
                     await self._sync_async()
-        if self._rewrite_asap or self.rewrite_due():
+        if self._rewrite_asap or self.rewrite_due() or \
+                self.checkpoint_due():
             await self.rewrite(app)
 
     # ---------------------------------------------------- out-of-log state
@@ -867,7 +944,9 @@ class OpLog:
                       fence=0,
                       node_id=getattr(self.node, "node_id", 0) or 0,
                       wmark_ok=int(self._wmark_ok),
-                      dirty=int(self._rewrite_asap))
+                      dirty=int(self._rewrite_asap),
+                      ckpt_uuid=self.checkpoint_uuid,
+                      ckpt_ts=f"{self.checkpoint_ts:.3f}")
         fields.update(over)
         return fields
 
@@ -902,6 +981,25 @@ class OpLog:
         if size < self.rewrite_min_bytes:
             return False
         return size > self.base_size * (1.0 + self.rewrite_pct / 100.0)
+
+    def checkpoint_due(self) -> bool:
+        """Time-triggered incremental checkpoint (CONSTDB_CHECKPOINT_*):
+        due once the cadence elapsed AND the post-checkpoint tail has
+        grown past the floor — the rewrite IS the checkpoint (consistent
+        snapshot + generation cut), so a restart replays only the
+        tail."""
+        if not self.checkpoint_secs or self._rewriting or self._closed:
+            return False
+        if time.monotonic() - self._last_ckpt_mono < self.checkpoint_secs:
+            return False
+        return self.size_bytes() - self.base_size >= \
+            self.checkpoint_min_bytes
+
+    def _fault(self, stage: str) -> None:
+        """Chaos fault point inside rewrite() (see _ckpt_fault)."""
+        if self._ckpt_fault == stage:
+            self._ckpt_fault = ""
+            raise RuntimeError(f"injected checkpoint fault: {stage}")
 
     async def rewrite(self, app) -> None:
         """Compact snapshot + tail atomically (module docstring): cut on
@@ -938,6 +1036,7 @@ class OpLog:
                 except OSError:
                     pass
             self._open_generation(gen)
+            self._fault("switch")
             if plane is not None:
                 repl_last = node.repl_log.landed_last_uuid
                 records = node.replicas.records()
@@ -956,13 +1055,20 @@ class OpLog:
                 write_snapshot_file, snap, meta, records, captures,
                 chunk_keys=getattr(app, "snapshot_chunk_keys", 1 << 16),
                 fsync=True)
+            self._fault("snapshot")
             self._wmark_ok = True
             self._rewrite_asap = False
             self._last_wmark_sig = None
             self.base_size = self.size_bytes()
+            # the cut this base represents — a restart from it replays
+            # only records past repl_last (the checkpoint gauges)
+            self.checkpoint_uuid = repl_last
+            self.checkpoint_ts = time.time()
+            self._last_ckpt_mono = time.monotonic()
             _write_meta(self.meta_path(self.dir), self._meta_fields(
                 gen=gen, base_size=self.base_size,
                 snapshot=os.path.basename(snap)))
+            self._fault("meta")
             self._gc_generations(keep_from=gen)
             self.rewrites += 1
             log.info("aof rewrite #%d: base %s at uuid %d, log reset "
@@ -1056,38 +1162,102 @@ def _encode_serve_builder(bb, prev_uuid: int, node_id: int
 class _ReplayApplier:
     """Boot-replay twin of the live coalescing applier: frame records
     buffer per command and group-encode through the SAME
-    COLUMNAR_ENCODERS/BatchBuilder machinery into Node.merge_stream_batch;
-    non-encodable frames apply as apply_replicated barriers.  Erroring
-    ops are logged and SKIPPED (recovery must never crash-loop on one
-    bad op), counted into RecoveryInfo."""
+    COLUMNAR_ENCODERS/BatchBuilder machinery; non-encodable frames apply
+    as apply_replicated barriers.  Erroring ops are logged and SKIPPED
+    (recovery must never crash-loop on one bad op), counted into
+    RecoveryInfo.
 
-    def __init__(self, node, info: RecoveryInfo) -> None:
+    Two landing strategies (CONSTDB_RECOVER_BULK):
+
+      * serial (`bulk=False`): every record applies individually —
+        frames through `Node.apply_replicated`, REPLBATCH records
+        through one `Node.merge_stream_batch` call each.  This is the
+        per-record reference path the bench oracle compares against;
+        no buffering, no coalescing, strict log order.
+      * bulk (`bulk=True`, the default): finalized batches accumulate
+        into MERGE ROUNDS of ~_REPLAY_ROUND_ROWS columnar rows and land
+        through one `Node.merge_batches` call per round (the engine's
+        merge_many group path — the same WIDE strategy snapshot ingest
+        rides).  CRDT merges commute, so batch order within a round is
+        free; the ONE order-sensitive step is `finalize()`'s
+        element-plane key-delete rule, which reads LIVE key dt columns
+        — so a flush carrying checked element rows forces the pending
+        round to land first iff the round holds a dt RAISE for one of
+        the flush's OWN keys (`_round_dt_keys`; disjoint key sets
+        commute).  Non-encodable barriers land buffer + round before
+        applying — except KEY_SCOPED barriers whose key has no
+        pending rows, which commute with everything pending and apply
+        in place (the live coalescer's exact scoping discipline).
+    """
+
+    def __init__(self, node, info: RecoveryInfo,
+                 bulk: bool = False) -> None:
+        # frame() runs once per REC_FRAME record: bind its lookup
+        # tables here instead of importing them per record
+        from ..resp.message import Bulk, as_bytes
+        from ..server.commands import (COLUMNAR_ENCODERS,
+                                       KEY_SCOPED_BARRIERS,
+                                       STATE_FREE_BARRIERS)
+        self._as_bytes = as_bytes
+        self._bulk_cls = Bulk
+        self._encoders = COLUMNAR_ENCODERS
+        self._key_scoped = KEY_SCOPED_BARRIERS
+        self._state_free = STATE_FREE_BARRIERS
         self.node = node
         self.info = info
+        self.bulk = bulk
         self._buf: dict[bytes, list] = {}
         self._frames = 0
+        self._rows_bound = 0    # upper bound on the buffer's batch rows
+        self._round: list = []      # finalized batches pending one merge
+        self._round_rows = 0
+        self._round_dt_keys: set = set()  # keys the round raises dts of
+        self._pending_keys: set = set()   # keys with buffered/round rows
+        self._next_progress = _REPLAY_PROGRESS_EVERY
 
     def frame(self, origin: int, uuid: int, name: bytes,
               args: list) -> None:
-        from ..server.commands import (COLUMNAR_ENCODERS,
-                                       STATE_FREE_BARRIERS)
         info = self.info
-        if name in COLUMNAR_ENCODERS and len(args) >= 1:
-            from ..resp.message import as_bytes
-            try:
-                key = as_bytes(args[0])
-            except CstError:
-                info.skipped += 1
-                return
+        if self.bulk and name in self._encoders and len(args) >= 1:
+            key = args[0]
+            if type(key) is not bytes:   # raw-scanned args skip this
+                try:
+                    key = self._as_bytes(key)
+                except CstError:
+                    info.skipped += 1
+                    return
             recs = self._buf.setdefault(name, [])
             recs.append((key, origin, uuid,
                          (None, None, None, None, None, *args)))
+            self._pending_keys.add(key)
             self._frames += 1
-            if self._frames >= 512:
+            # args over-counts rows (values/pairs ride along), so this
+            # keeps the flushed batch under the round budget — an
+            # over-budget batch would fall off the engines' vectorized
+            # micro path (see _REPLAY_ROUND_ROWS)
+            self._rows_bound += len(args)
+            if self._frames >= _REPLAY_BULK_FRAMES or \
+                    self._rows_bound >= _REPLAY_ROUND_ROWS:
                 self.flush()
         else:
-            if self._frames and name not in STATE_FREE_BARRIERS:
-                self.flush()
+            if self.bulk and name not in self._state_free:
+                # a KEY_SCOPED barrier reads/sweeps exactly its own
+                # key: with no pending rows for it, it commutes with
+                # buffer and round and applies in place (the live
+                # coalescer's scoping — replica/coalesce.py barrier())
+                scoped = name in self._key_scoped and len(args) >= 1
+                if scoped:
+                    try:
+                        scoped = self._as_bytes(args[0]) \
+                            not in self._pending_keys
+                    except CstError:
+                        scoped = False
+                if not scoped:
+                    # any other state-reading barrier must see every
+                    # prior record landed: drain buffer AND round
+                    if self._frames:
+                        self.flush()
+                    self._merge_round()
             self._apply_one(origin, uuid, name, args)
         self._observe(origin, uuid)
 
@@ -1107,13 +1277,31 @@ class _ReplayApplier:
                       "skipping %d ops", e, n)
             self.info.skipped += n
             return
-        node.merge_stream_batch(wb, n)
+        if not self.bulk:
+            node.merge_stream_batch(wb, n)
+        else:
+            # finalize()'s key-delete rule reads LIVE dt columns: land
+            # the pending round first iff it raises a dt of one of
+            # THIS batch's keys (disjoint key sets commute)
+            if self._round_dt_keys and not \
+                    self._round_dt_keys.isdisjoint(wb.batch.keys):
+                self._merge_round()
+            node.ensure_flushed_for(("env",))
+            self._push_round(wb.finalize())
         self.info.batches += 1
         self.info.batch_frames += n
         self._observe(origin, last)
 
     def _apply_one(self, origin: int, uuid: int, name: bytes,
                    args: list) -> None:
+        if args and type(args[0]) is bytes:
+            # raw-scanned frame (scan_segment raw mode: every arg is
+            # plain bytes, all-or-nothing): the reference apply path
+            # takes RESP messages, so re-wrap — barriers and other
+            # non-encodable frames only, the columnar encoders take
+            # the bytes as-is
+            bulk = self._bulk_cls
+            args = [bulk(a) for a in args]
         try:
             self.node.apply_replicated(name, args, origin, uuid)
             self.info.frames += 1
@@ -1129,12 +1317,60 @@ class _ReplayApplier:
         if origin == self.node.node_id and uuid > info.local_max:
             info.local_max = uuid
         self.node.hlc.observe(uuid)
+        done = info.frames + info.batch_frames
+        if done >= self._next_progress:
+            self._next_progress += _REPLAY_PROGRESS_EVERY
+            log.info("aof replay progress: %d ops replayed "
+                     "(%d skipped, %d merge rounds)", done,
+                     info.skipped, info.merge_rounds)
+
+    # ------------------------------------------------- bulk merge rounds
+
+    def _push_round(self, b) -> None:
+        # close the round BEFORE it would cross the row budget: the
+        # budget equals the engines' host micro-strategy ceiling, and
+        # an over-budget round falls off the vectorized path
+        if self._round and \
+                self._round_rows + b.n_rows > _REPLAY_ROUND_ROWS:
+            self._merge_round()
+        self._round.append(b)
+        self._round_rows += b.n_rows
+        self._pending_keys.update(b.keys)
+        if len(b.del_keys):
+            self._round_dt_keys.update(b.del_keys)
+            self._pending_keys.update(b.del_keys)
+        if b.key_dt.any():
+            self._round_dt_keys.update(
+                k for k, dt in zip(b.keys, b.key_dt.tolist()) if dt)
+
+    def _merge_round(self) -> None:
+        rnd, self._round = self._round, []
+        self._round_rows = 0
+        self._round_dt_keys.clear()
+        # the frame buffer is always empty here (every caller flushes
+        # first), so pendency collapses with the round
+        self._pending_keys.clear()
+        if not rnd:
+            return
+        # land the round as ONE wide batch: concatenating first means
+        # one key resolution + one vectorized pass per plane for the
+        # whole round, where per-batch merges would pay the numpy
+        # fixed costs once per few-hundred-row record
+        from ..engine.base import concat_batches
+        self.node.merge_batches([concat_batches(rnd)])
+        self.info.merge_rounds += 1
+
+    def drain(self) -> None:
+        """End-of-stream drain: frame buffer, then the pending round."""
+        self.flush()
+        self._merge_round()
 
     def flush(self) -> None:
         from ..replica.coalesce import BatchBuilder
         from ..server.commands import COLUMNAR_ENCODERS, NotColumnar
         buf, self._buf = self._buf, {}
         frames, self._frames = self._frames, 0
+        self._rows_bound = 0
         if not frames:
             return
         node = self.node
@@ -1152,7 +1388,21 @@ class _ReplayApplier:
                         enc(bb, [r])
                     except enc_errors:
                         failures.append((name, r))
-        node.merge_stream_batch(bb, frames - len(failures))
+        if not self.bulk:
+            node.merge_stream_batch(bb, frames - len(failures))
+        else:
+            # same dt-rule discipline as batch(): checked element rows
+            # may not finalize over a pending dt raise of their OWN
+            # key — disjoint key sets commute and keep the round open
+            rdk = self._round_dt_keys
+            if rdk and any(r[0] in rdk
+                           for recs in buf.values() for r in recs):
+                self._merge_round()
+            node.ensure_flushed_for(("env",))
+            self._push_round(bb.finalize())
+            if failures:
+                # the per-op fallbacks below read live state
+                self._merge_round()
         self.info.frames += frames - len(failures)
         if failures:
             failures.sort(key=lambda f: f[1][2])
@@ -1160,15 +1410,54 @@ class _ReplayApplier:
                 self._apply_one(r[1], r[2], name, list(r[3][5:]))
 
 
-def _decode_frame(payload: bytes):
-    r = VarintReader(payload)
-    origin = r.uvarint()
-    uuid = r.uvarint()
-    from ..resp.codec import RespParser
-    p = RespParser()
-    p.feed(payload[r.pos:])
-    msg = p.next_msg()
-    from ..resp.message import Arr, Bulk
+def _frame_ctx():
+    """Per-stream decode context: the native parser entry plus the RESP
+    message classes, resolved ONCE instead of per record — replay
+    decodes millions of frame records and the per-record import
+    machinery + `_ext()` lookups were a measurable slice of the scan."""
+    from ..resp import codec as C
+    from ..resp.message import NIL, Arr, Bulk, Err, Int, Simple
+    return C._ext(), Arr, Bulk, Int, Simple, Err, NIL
+
+
+def _decode_frame(payload: bytes, parser=None, ctx=None):
+    """Decode one REC_FRAME payload: varint header + exactly one RESP
+    array.  The hot path hands the array straight to the native C
+    parser (one call per record, no parser object, no buffer copy) —
+    replay decodes millions of frame records and the per-record python
+    around RespParser was a top scan cost.  `parser`: a reusable
+    pure-python fallback parser for builds without the extension
+    (_decode_stream rebuilds it after any failure, so a malformed
+    record can never desync the stream that follows it).  `ctx`: a
+    `_frame_ctx()` tuple shared across a stream's records."""
+    if ctx is None:
+        ctx = _frame_ctx()
+    ext, Arr, Bulk, Int, Simple, Err, NIL = ctx
+    origin, pos = read_uvarint(payload, 0)
+    uuid, pos = read_uvarint(payload, pos)
+    if ext is not None:
+        try:
+            msgs, new_pos, fallback = ext.resp_parse(
+                payload, pos, Arr, Bulk, Int, Simple, Err, NIL, 2,
+                512 << 20)
+        except TypeError:   # prebuilt ext predating the max_bulk param
+            msgs, new_pos, fallback = ext.resp_parse(
+                payload, pos, Arr, Bulk, Int, Simple, Err, NIL)
+        if len(msgs) != 1 or new_pos != len(payload) or fallback:
+            raise ValueError("malformed frame record")
+        msg = msgs[0]
+    else:
+        if parser is None:
+            from ..resp import codec as C
+            parser = C.RespParser()
+        parser.feed(payload[pos:])
+        msg = parser.next_msg()
+        # a frame record holds exactly ONE message: anything left
+        # queued or buffered would desync every later frame fed to
+        # this parser (state peek, not a second parse call)
+        if parser._qpos < len(parser._q) or \
+                parser._pos < len(parser._buf):
+            raise ValueError("trailing bytes in frame record")
     if not isinstance(msg, Arr) or not msg.items or \
             not isinstance(msg.items[0], Bulk):
         raise ValueError("malformed frame record")
@@ -1189,10 +1478,12 @@ def _decode_wmark(payload: bytes):
     return landed, hlc_mark, _decode_replicas(payload[r.pos:])
 
 
-def scan_generation(aof_dir: str, gen: int, info: RecoveryInfo) -> list:
+def scan_generation(aof_dir: str, gen: int, info: RecoveryInfo,
+                    classes: tuple = (), raw: bool = False) -> list:
     """All segment record streams of one generation, with torn tails
     repaired (truncated on disk, LOUDLY).  Returns a list of per-segment
-    record lists in segment order."""
+    record lists in segment order.  `classes` (see scan_segment): lets
+    the native scanner pre-decode REC_FRAME records at scan time."""
     streams = []
     s = 0
     while True:
@@ -1200,7 +1491,7 @@ def scan_generation(aof_dir: str, gen: int, info: RecoveryInfo) -> list:
         if not os.path.exists(path):
             break
         try:
-            records, valid, total = scan_segment(path)
+            records, valid, total = scan_segment(path, classes, raw)
         except OpLogError as e:
             # unreadable (bad header — not a torn tail): quarantine the
             # SEGMENT, keep recovering from the others, and void the
@@ -1232,35 +1523,56 @@ def scan_generation(aof_dir: str, gen: int, info: RecoveryInfo) -> list:
     return streams
 
 
-def _merge_streams(streams: list):
-    """K-way merge of per-segment record streams by uuid, preserving
+def _decode_stream(recs: list) -> list:
+    """Decode one segment's raw records into `(sortkey, rtype, data)`
+    items — sortkey is the max uuid seen so far in file order, the
+    k-way merge key.  Records the native scanner already pre-decoded
+    (REC_FRAME 5-tuples, see scan_segment) pass straight through;
+    crc-valid but undecodable records are skipped, loudly."""
+    from ..resp.codec import make_parser
+    seq = []
+    last = 0
+    parser = make_parser()
+    ctx = _frame_ctx()
+    for item in recs:
+        rtype = item[0]
+        try:
+            if rtype == REC_FRAME:
+                if len(item) == 5:   # pre-decoded at scan time
+                    _, origin, uuid, name, args = item
+                else:
+                    origin, uuid, name, args = _decode_frame(
+                        item[1], parser, ctx)
+                last = max(last, uuid)
+                seq.append((last, rtype, (origin, uuid, name, args)))
+            elif rtype == REC_BATCH:
+                origin, base, lastu, n, body = \
+                    _decode_batch_head(item[1])
+                last = max(last, base + 1)
+                seq.append((last, rtype, (origin, base, lastu, n,
+                                          body)))
+                last = max(last, lastu)
+            else:
+                seq.append((last, rtype, item[1]))
+        except (ValueError, IndexError, OverflowError, CstError):
+            log.error("aof replay: undecodable record skipped")
+            parser = make_parser()   # a bad frame may leave stale bytes
+    return seq
+
+
+def _merge_decoded(decoded: list):
+    """K-way merge of decoded per-segment streams by uuid, preserving
     FILE order within a segment (barrier frames read live state, so a
     segment's arrival order is its execution order; cross-segment
-    records touch disjoint key shards and commute).  WMARK records sort
-    with the record before them."""
-    decoded = []
-    for recs in streams:
-        seq = []
-        last = 0
-        for rtype, payload in recs:
-            try:
-                if rtype == REC_FRAME:
-                    origin, uuid, name, args = _decode_frame(payload)
-                    last = max(last, uuid)
-                    seq.append((last, rtype, (origin, uuid, name, args)))
-                elif rtype == REC_BATCH:
-                    origin, base, lastu, n, body = \
-                        _decode_batch_head(payload)
-                    last = max(last, base + 1)
-                    seq.append((last, rtype, (origin, base, lastu, n,
-                                              body)))
-                    last = max(last, lastu)
-                else:
-                    seq.append((last, rtype, payload))
-            except (ValueError, IndexError, OverflowError):
-                # a crc-valid but undecodable record: skip, loudly
-                log.error("aof replay: undecodable record skipped")
-        decoded.append(seq)
+    records touch disjoint key shards and commute — the parallel
+    replay path leans on exactly this).  WMARK records sort with the
+    record before them."""
+    live = [d for d in decoded if d]
+    if len(live) == 1:
+        # single populated segment (every unsharded log): file order IS
+        # the merge order, skip the per-record k-way scan
+        yield from live[0]
+        return
     idx = [0] * len(decoded)
     while True:
         best = -1
@@ -1272,8 +1584,44 @@ def _merge_streams(streams: list):
                     best, best_key = i, key
         if best < 0:
             return
-        yield decoded[best][idx[best]][1:]
+        yield decoded[best][idx[best]]
         idx[best] += 1
+
+
+def _iter_single_stream(recs: list):
+    """(rtype, data) items of ONE populated segment, decoded lazily in
+    file order — the sortkey bookkeeping `_decode_stream` does for the
+    k-way merge is pure overhead when there is nothing to merge with,
+    and every unsharded log is this case."""
+    from ..resp.codec import make_parser
+    parser = make_parser()
+    ctx = _frame_ctx()
+    for item in recs:
+        rtype = item[0]
+        try:
+            if rtype == REC_FRAME:
+                if len(item) == 5:   # pre-decoded at scan time
+                    yield rtype, item[1:]
+                else:
+                    yield rtype, _decode_frame(item[1], parser, ctx)
+            elif rtype == REC_BATCH:
+                yield rtype, _decode_batch_head(item[1])
+            else:
+                yield rtype, item[1]
+        except (ValueError, IndexError, OverflowError, CstError):
+            log.error("aof replay: undecodable record skipped")
+            parser = make_parser()   # a bad frame may leave stale bytes
+
+
+def _merge_streams(streams: list):
+    """Decode + k-way merge (see _decode_stream / _merge_decoded);
+    yields (rtype, data) pairs."""
+    live = [r for r in streams if r]
+    if len(live) == 1:
+        yield from _iter_single_stream(live[0])
+        return
+    for item in _merge_decoded([_decode_stream(r) for r in streams]):
+        yield item[1:]
 
 
 def arm(app, info: RecoveryInfo, n_segments: int = 1) -> OpLog:
@@ -1287,11 +1635,25 @@ def arm(app, info: RecoveryInfo, n_segments: int = 1) -> OpLog:
                fsync_policy=app.aof_fsync,
                rewrite_pct=app.aof_rewrite_pct,
                rewrite_min_bytes=app.aof_rewrite_min_mb << 20,
+               checkpoint_secs=getattr(app, "checkpoint_secs", 0.0),
+               checkpoint_min_bytes=int(
+                   getattr(app, "checkpoint_min_mb", 1)) << 20,
                node=node)
     lg.tail_truncated = info.tail_truncated
     node.oplog = lg
     lg.install_floor()
     node.governor.register_source(lg.used_buffer_bytes)
+    if info.restore_to:
+        # point-in-time restore dropped acked records above the target:
+        # surviving watermarks over-claim, and the tail still holds the
+        # dropped records — void the wmark law for this generation and
+        # force an immediate rewrite to cut a fresh base
+        lg._wmark_ok = False
+        lg._rewrite_asap = True
+        try:
+            _write_meta(lg.meta_path(lg.dir), lg._meta_fields())
+        except OSError:  # pragma: no cover - fs-dependent
+            pass
     if node.node_id:
         # persist the identity so a future recovery can distinguish
         # local-origin records even when no snapshot survives
@@ -1319,6 +1681,12 @@ def arm(app, info: RecoveryInfo, n_segments: int = 1) -> OpLog:
     # every surviving op of THIS node's origin is at or below this —
     # the chaos oracle prunes its journal obligation above it
     x["aof_recovered_fence"] = info.fence
+    x["recovery_mode"] = info.mode
+    x["recovery_shards"] = info.shards
+    x["recovery_merge_rounds"] = info.merge_rounds
+    if info.restore_to:
+        x["recovery_restore_to"] = info.restore_to
+        x["recovery_restore_skipped"] = info.restore_skipped
     if info.quarantined:
         x["aof_segments_quarantined"] = info.quarantined
     if info.skipped:
@@ -1347,6 +1715,9 @@ def rearm(app, n_segments: int = 1) -> OpLog:
                fsync_policy=app.aof_fsync,
                rewrite_pct=app.aof_rewrite_pct,
                rewrite_min_bytes=app.aof_rewrite_min_mb << 20,
+               checkpoint_secs=getattr(app, "checkpoint_secs", 0.0),
+               checkpoint_min_bytes=int(
+                   getattr(app, "checkpoint_min_mb", 1)) << 20,
                node=node)
     node.oplog = lg
     lg.install_floor()
@@ -1354,15 +1725,27 @@ def rearm(app, n_segments: int = 1) -> OpLog:
     return lg
 
 
-async def recover_into_plane(app) -> RecoveryInfo:
+async def recover_into_plane(app, restore_to: int = 0) -> RecoveryInfo:
     """Sharded-node boot recovery: the serve workers ARE the store, so
     the chosen snapshot fans out through plane.ingest_batches and log
     frames route to the worker owning their key (the exact per-key
     apply path ShardApplier uses).  Runs as start()'s boot-restore hook
-    — plane up, listener not yet accepting."""
+    — plane up, listener not yet accepting.
+
+    Fast-restart structure: segment scan + decode runs in a worker
+    thread OVERLAPPED with the snapshot section ingest (the apply side
+    waits for the ingest — a failed ingest resets the workers, so
+    nothing may land before the snapshot settles).  Per-segment streams
+    then replay CONCURRENTLY (CONSTDB_RECOVER_SHARDS; 0 = one task per
+    segment, 1 = the serial merged-stream reference) — legal because
+    segment-crossing records touch disjoint key shards and CRDT merges
+    commute; each task keeps its own buffers so within-segment order is
+    preserved end to end, and barriers fall back to the merged serial
+    path (a generation containing any is replayed serially)."""
     node = app.node
     plane = node.serve_plane
     info = RecoveryInfo()
+    info.restore_to = restore_to
     aof_dir = app.aof_dir
     meta = _read_meta(OpLog.meta_path(aof_dir))
     start_gen = int(meta.get("gen", 0) or 0)
@@ -1370,13 +1753,28 @@ async def recover_into_plane(app) -> RecoveryInfo:
     boot_ok = meta.get("boot_snap_ok", "1") != "0"
     gens = [g for g in OpLog.list_generations(aof_dir) if g >= start_gen]
 
+    from ..conf import env_flag, env_int
+    bulk = env_flag("CONSTDB_RECOVER_BULK", True)
+    shards_knob = env_int("CONSTDB_RECOVER_SHARDS", 0)
+
     from ..server.io import _SNAPSHOT_LOAD_ERRORS, _quarantine_snapshot
     from .snapshot import SectionDemux
+    loop = asyncio.get_running_loop()
+
+    # -- overlap: scan + torn-tail repair + decode in a worker thread
+    # while the snapshot sections stream into the shard workers below
+    def _scan_all():
+        classes = _frame_ctx()[1:]
+        return {g: [_decode_stream(r)
+                    for r in scan_generation(aof_dir, g, info, classes)]
+                for g in gens}
+
+    scan_fut = loop.run_in_executor(None, _scan_all)
+
     snap_name = meta.get("snapshot", "")
     base = os.path.join(aof_dir, snap_name) if snap_name else ""
     snap_meta = None
     records = []
-    loop = asyncio.get_running_loop()
     base_failed = False
     for candidate, label in ((base, "aof-base"),
                              (app.snapshot_path if boot_ok else "",
@@ -1401,104 +1799,193 @@ async def recover_into_plane(app) -> RecoveryInfo:
         info.source = f"{label}-snapshot"
         break
 
+    decoded = await scan_fut
+    if restore_to and snap_meta is not None and \
+            snap_meta.repl_last_uuid > restore_to:
+        raise OpLogError(
+            f"--restore-to {restore_to} predates the recovered snapshot "
+            f"cut (uuid {snap_meta.repl_last_uuid}); restore from a "
+            "copy of an older checkpoint")
+
     # -- log replay: frames route to the worker owning their shard (the
     # worker-side per-key apply path); unroutable frames apply on the
     # parent exactly as ShardApplier.aapply does.  BATCH records only
     # appear when a node previously ran unsharded on the same log —
-    # decode and fan the columnar rows out like a snapshot chunk.
+    # decode and aggregate into merge rounds fanned out like snapshot
+    # chunks (bulk) or ingest one at a time (serial reference).
+    from ..replica import wire
     from ..resp.codec import encode_into
-    from ..resp.message import Arr, Bulk, Int
+    from ..resp.message import Arr, Bulk, Int, as_bytes
     from ..server.commands import COMMANDS, shard_routable
     from ..store.sharded_keyspace import shard_of
     n_shards = plane.n_shards
-    bufs = [bytearray() for _ in range(n_shards)]
-    counts = [0] * n_shards
-    pending = 0
-    wmark = None
+    wmarks: list = []
+    prog = [_REPLAY_PROGRESS_EVERY]
 
-    async def flush_routed():
-        nonlocal pending
-        if not pending:
-            return
-        futs = []
-        for s in range(n_shards):
-            if counts[s]:
-                futs.append((s, plane.pool.submit(
-                    s, ("apply", bytes(bufs[s]), counts[s]))))
-                bufs[s] = bytearray()
-                counts[s] = 0
-        pending = 0
-        for s, fut in futs:
-            entries, _deleted, _stats = await fut
-            if entries:
-                plane.merged.segments[s].push_many(entries)
+    class _SegReplay:
+        """One record stream's router: per-key frames buffer toward the
+        owning worker, barriers drain and apply on the parent, batch
+        records aggregate into merge rounds.  One instance per
+        concurrent segment task — buffers and futures are private, so
+        within-segment order survives the concurrency."""
+
+        def __init__(self):
+            self.bufs = [bytearray() for _ in range(n_shards)]
+            self.counts = [0] * n_shards
+            self.pending = 0
+            self.round: list = []
+            self.round_rows = 0
+
+        async def flush_routed(self):
+            if not self.pending:
+                return
+            futs = []
+            for s in range(n_shards):
+                if self.counts[s]:
+                    futs.append((s, plane.pool.submit(
+                        s, ("apply", bytes(self.bufs[s]),
+                            self.counts[s]))))
+                    self.bufs[s] = bytearray()
+                    self.counts[s] = 0
+            self.pending = 0
+            for s, fut in futs:
+                entries, _deleted, _stats = await fut
+                if entries:
+                    plane.merged.segments[s].push_many(entries)
+
+        async def flush_round(self):
+            rnd, self.round = self.round, []
+            self.round_rows = 0
+            if rnd:
+                # one wide batch per round: ingest_batches splits,
+                # encodes and submits per batch, so concatenating
+                # first pays those once per round (engine/base.py)
+                from ..engine.base import concat_batches
+                await plane.ingest_batches([concat_batches(rnd)])
+                info.merge_rounds += 1
+
+        def _observe(self, origin, uuid):
+            info.replayed_max = max(info.replayed_max, uuid)
+            if origin == node.node_id:
+                info.local_max = max(info.local_max, uuid)
+            node.hlc.observe(uuid)
+            done = info.frames + info.batch_frames
+            if done >= prog[0]:
+                prog[0] += _REPLAY_PROGRESS_EVERY
+                log.info("aof replay progress: %d ops replayed "
+                         "(%d skipped, %d merge rounds)", done,
+                         info.skipped, info.merge_rounds)
+
+        async def run(self, items):
+            for item in items:
+                rtype = item[1]
+                if rtype == REC_FRAME:
+                    origin, uuid, name, args = item[2]
+                    if restore_to and uuid > restore_to:
+                        info.restore_skipped += 1
+                        continue
+                    cmd = COMMANDS.get(name) or \
+                        COMMANDS.get(name.lower())
+                    routable = cmd is not None and \
+                        shard_routable(cmd) and len(args) >= 1
+                    key = None
+                    if routable:
+                        try:
+                            key = as_bytes(args[0])
+                        except CstError:
+                            key = None
+                    if key is not None:
+                        # a pending batch round must land before any
+                        # later frame touches its keys in a worker
+                        if self.round:
+                            await self.flush_round()
+                        s = shard_of(key, n_shards)
+                        encode_into(self.bufs[s], Arr([
+                            Bulk(b"replicate"), Int(origin), Int(0),
+                            Int(uuid), Bulk(name), *args]))
+                        self.counts[s] += 1
+                        self.pending += 1
+                        info.frames += 1
+                        if self.pending >= 512:
+                            await self.flush_routed()
+                    else:
+                        await self.flush_round()
+                        await self.flush_routed()
+                        try:
+                            node.apply_replicated(name, args, origin,
+                                                  uuid)
+                            info.frames += 1
+                        except CstError as e:
+                            log.warning("aof replay: op %d (%s) failed "
+                                        "(%s); skipped", uuid, name, e)
+                            info.skipped += 1
+                    self._observe(origin, uuid)
+                elif rtype == REC_BATCH:
+                    origin, bbase, lastu, n, body = item[2]
+                    if restore_to and lastu > restore_to:
+                        info.restore_skipped += n
+                        continue
+                    await self.flush_routed()
+                    try:
+                        wb = wire.decode_wire_batch(body, node.ks,
+                                                    origin, bbase)
+                        if wb.n_frames != n:
+                            raise wire.WireFormatError(
+                                "frame count mismatch")
+                    except wire.WireFormatError as e:
+                        log.error("aof replay: undecodable batch "
+                                  "record (%s); skipping %d ops", e, n)
+                        info.skipped += n
+                        continue
+                    if bulk:
+                        b = wb.finalize()
+                        # close before crossing the row budget (the
+                        # host micro-strategy ceiling — see
+                        # _REPLAY_ROUND_ROWS)
+                        if self.round and self.round_rows + b.n_rows \
+                                > _REPLAY_ROUND_ROWS:
+                            await self.flush_round()
+                        self.round.append(b)
+                        self.round_rows += b.n_rows
+                    else:
+                        await plane.ingest_batches([wb.finalize()])
+                    info.batches += 1
+                    info.batch_frames += n
+                    self._observe(origin, lastu)
+                else:
+                    try:
+                        w = _decode_wmark(item[2])
+                        info.wmarks += 1
+                        info.hlc_mark = max(info.hlc_mark, w[1])
+                        if not restore_to or w[0] <= restore_to:
+                            wmarks.append(w)
+                    except (ValueError, IndexError, OverflowError):
+                        log.error("aof replay: undecodable wmark "
+                                  "skipped")
+            await self.flush_round()
+            await self.flush_routed()
 
     for gen in gens:
-        streams = scan_generation(aof_dir, gen, info)
-        for item in _merge_streams(streams):
-            rtype = item[0]
-            if rtype == REC_FRAME:
-                origin, uuid, name, args = item[1]
-                cmd = COMMANDS.get(name) or COMMANDS.get(name.lower())
-                routable = cmd is not None and shard_routable(cmd) \
-                    and len(args) >= 1
-                key = None
-                if routable:
-                    from ..resp.message import as_bytes
-                    try:
-                        key = as_bytes(args[0])
-                    except CstError:
-                        key = None
-                if key is not None:
-                    s = shard_of(key, n_shards)
-                    encode_into(bufs[s], Arr([
-                        Bulk(b"replicate"), Int(origin), Int(0),
-                        Int(uuid), Bulk(name), *args]))
-                    counts[s] += 1
-                    pending += 1
-                    info.frames += 1
-                    if pending >= 512:
-                        await flush_routed()
-                else:
-                    await flush_routed()
-                    try:
-                        node.apply_replicated(name, args, origin, uuid)
-                        info.frames += 1
-                    except CstError as e:
-                        log.warning("aof replay: op %d (%s) failed "
-                                    "(%s); skipped", uuid, name, e)
-                        info.skipped += 1
-                info.replayed_max = max(info.replayed_max, uuid)
-                if origin == node.node_id:
-                    info.local_max = max(info.local_max, uuid)
-                node.hlc.observe(uuid)
-            elif rtype == REC_BATCH:
-                origin, bbase, lastu, n, body = item[1]
-                await flush_routed()
-                from ..replica import wire
-                try:
-                    wb = wire.decode_wire_batch(body, node.ks, origin,
-                                                bbase)
-                except wire.WireFormatError as e:
-                    log.error("aof replay: undecodable batch record "
-                              "(%s); skipping %d ops", e, n)
-                    info.skipped += n
-                    continue
-                await plane.ingest_batches([wb.finalize()])
-                info.batches += 1
-                info.batch_frames += n
-                info.replayed_max = max(info.replayed_max, lastu)
-                if origin == node.node_id:
-                    info.local_max = max(info.local_max, lastu)
-                node.hlc.observe(lastu)
-            else:
-                try:
-                    wmark = _decode_wmark(item[1])
-                    info.wmarks += 1
-                    info.hlc_mark = max(info.hlc_mark, wmark[1])
-                except (ValueError, IndexError, OverflowError):
-                    log.error("aof replay: undecodable wmark skipped")
-        await flush_routed()
+        streams = decoded.get(gen, [])
+        nonempty = [s for s in streams if s]
+        parallel = shards_knob != 1 and len(nonempty) > 1 and not any(
+            r[1] == REC_BATCH for s in nonempty for r in s)
+        if parallel:
+            conc = len(nonempty) if shards_knob <= 0 \
+                else min(shards_knob, len(nonempty))
+            info.shards = max(info.shards, conc)
+            sem = asyncio.Semaphore(conc)
+
+            async def _one(items):
+                async with sem:
+                    await _SegReplay().run(items)
+
+            await asyncio.gather(*[_one(s) for s in nonempty])
+        else:
+            await _SegReplay().run(_merge_decoded(streams))
+
+    info.mode = ("bulk" if bulk else "serial") + (
+        f"+shards{info.shards}" if info.shards > 1 else "")
     if info.frames or info.batches:
         info.source = (info.source + "+log") if snap_meta is not None \
             else "log-only"
@@ -1509,6 +1996,12 @@ async def recover_into_plane(app) -> RecoveryInfo:
         node.hlc.observe(snap_meta.repl_last_uuid)
         info.fence = max(info.fence, snap_meta.repl_last_uuid)
     adopt = list(records)
+    # the newest surviving WMARK wins: landed coverage is non-decreasing
+    # in file order, and all WMARKs live in one segment's stream
+    wmark = None
+    for w in wmarks:
+        if wmark is None or w[0] >= wmark[0]:
+            wmark = w
     if wmark is not None and not info.wmark_unsafe:
         landed, _hlc, wrecords = wmark
         info.fence = max(info.fence, landed)
@@ -1550,13 +2043,26 @@ def prescan_node_id(aof_dir: str, boot_snapshot: str = "") -> int:
 
 
 def recover(node, aof_dir: str, boot_snapshot: str = "",
-            engine=None) -> RecoveryInfo:
+            engine=None, bulk=None, restore_to: int = 0) -> RecoveryInfo:
     """Single-keyspace boot recovery: base/boot snapshot + oplog tail,
     replayed through the real merge path (module docstring).  The
     caller (server/io.py start_node) sets the repl-log fences and INFO
     gauges from the returned RecoveryInfo.  Blocking; runs before the
-    listener opens."""
+    listener opens.
+
+    `bulk` selects the merge-round landing strategy (None reads
+    CONSTDB_RECOVER_BULK; see _ReplayApplier).  `restore_to` caps the
+    replay at a point-in-time uuid: records above it are skipped (batch
+    records at record granularity — a batch whose last uuid exceeds the
+    target is dropped whole), watermarks above it are not adopted, and
+    the caller must re-base the log afterwards (arm() flags the log
+    dirty so the next rewrite cuts a fresh generation)."""
+    from ..conf import env_flag
+    if bulk is None:
+        bulk = env_flag("CONSTDB_RECOVER_BULK", True)
     info = RecoveryInfo()
+    info.mode = "bulk" if bulk else "serial"
+    info.restore_to = restore_to
     meta = _read_meta(OpLog.meta_path(aof_dir))
     start_gen = int(meta.get("gen", 0) or 0)
     info.fence = int(meta.get("fence", 0) or 0)
@@ -1598,25 +2104,44 @@ def recover(node, aof_dir: str, boot_snapshot: str = "",
                 base_failed = True
                 info.wmark_unsafe = True
 
+    if restore_to and snap_meta is not None and \
+            snap_meta.repl_last_uuid > restore_to:
+        raise OpLogError(
+            f"--restore-to {restore_to} predates the recovered snapshot "
+            f"cut (uuid {snap_meta.repl_last_uuid}); restore from a "
+            "copy of an older checkpoint")
+
     # -- log replay through the real apply path
-    applier = _ReplayApplier(node, info)
+    applier = _ReplayApplier(node, info, bulk=bulk)
     wmark = None
+    classes = _frame_ctx()[1:]
     for gen in gens:
-        for item in _merge_streams(scan_generation(aof_dir, gen, info)):
+        for item in _merge_streams(
+                scan_generation(aof_dir, gen, info, classes, raw=bulk)):
             rtype = item[0]
             if rtype == REC_FRAME:
+                if restore_to and item[1][1] > restore_to:
+                    info.restore_skipped += 1
+                    continue
                 applier.frame(*item[1])
             elif rtype == REC_BATCH:
+                if restore_to and item[1][2] > restore_to:
+                    info.restore_skipped += item[1][3]
+                    continue
                 applier.batch(*item[1])
             else:
                 try:
-                    wmark = _decode_wmark(item[1])
+                    w = _decode_wmark(item[1])
                     info.wmarks += 1
-                    info.hlc_mark = max(info.hlc_mark, wmark[1])
+                    info.hlc_mark = max(info.hlc_mark, w[1])
+                    if not restore_to or w[0] <= restore_to:
+                        wmark = w
                 except (ValueError, IndexError, OverflowError):
                     log.error("aof replay: undecodable wmark skipped")
-        applier.flush()
-    applier.flush()
+        # generation boundary = a rewrite cut: land everything before
+        # the next generation's records (they may read barrier state)
+        applier.drain()
+    applier.drain()
     if info.frames or info.batches:
         info.source = (info.source + "+log") if snap_meta is not None \
             else "log-only"

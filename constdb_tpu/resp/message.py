@@ -148,9 +148,13 @@ def mkcmd(*parts) -> Arr:
 
 def as_bytes(m: Msg) -> bytes:
     # exact-type fast path first: Bulk is ~every argument on the wire,
-    # and these coercions sit on the per-frame replication hot path
+    # and these coercions sit on the per-frame replication hot path.
+    # Plain bytes pass through: the native AOF scanner's raw mode hands
+    # bulk-replay frames their arguments unwrapped (persist/oplog.py).
     if type(m) is Bulk or isinstance(m, (Simple, Err, Bulk)):
         return m.val
+    if type(m) is bytes:
+        return m
     if isinstance(m, Int):
         return i64_to_bytes(m.val)
     raise InvalidRequestMsg("should be non-array type")
@@ -159,6 +163,11 @@ def as_bytes(m: Msg) -> bytes:
 def as_int(m: Msg) -> int:
     if type(m) is Int or isinstance(m, Int):
         return m.val
+    if type(m) is bytes:
+        v = bytes2i64(m)
+        if v is None:
+            raise InvalidRequestMsg("string should be an integer")
+        return v
     if isinstance(m, (Simple, Bulk)):
         v = bytes2i64(m.val)
         if v is None:
@@ -172,6 +181,11 @@ def as_uint(m: Msg) -> int:
         if m.val < 0:
             raise InvalidRequestMsg("argument should be an unsigned integer")
         return m.val
+    if type(m) is bytes:
+        v = bytes2u64(m)
+        if v is None:
+            raise InvalidRequestMsg("string should be an unsigned integer")
+        return v
     if isinstance(m, (Simple, Bulk)):
         v = bytes2u64(m.val)
         if v is None:

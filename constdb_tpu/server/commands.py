@@ -1379,7 +1379,8 @@ def _enc_set(bb, recs: list) -> None:
     # op twin: get_or_create + register_set (LWW) + updated_at-on-win;
     # the unconditional envelope max is identical because ct >= rv_t
     # holds invariantly, so a losing write's max(ct, uuid) is a no-op
-    vals = [as_bytes(r[3][6]) for r in recs]
+    vals = [v if type(v := r[3][6]) is bytes else as_bytes(v)
+            for r in recs]
     uuids = [r[2] for r in recs]
     ki0 = bb.add_keys([r[0] for r in recs], S.ENC_BYTES, uuids)
     bb.reg_run(ki0, uuids, [r[1] for r in recs], vals)
@@ -1406,6 +1407,11 @@ def _enc_cntset(bb, recs: list) -> None:
 def _members_of(items: list) -> list:
     if len(items) < 7:
         raise NotColumnar("bad arity")  # the handler raises WrongArity
+    if type(items[6]) is bytes:
+        # raw-scanned replay record (persist/oplog.py scan raw mode):
+        # arguments are plain bytes, all-or-nothing — skip the
+        # coercion map on the replay hot path
+        return list(items[6:])
     return list(map(as_bytes, items[6:]))
 
 
@@ -1416,8 +1422,11 @@ def _genc_elem_adds(bb, recs, enc, with_vals: bool) -> None:
             it = r[3]
             if len(it) < 8 or len(it) & 1:
                 raise NotColumnar("bad arity")
-            pairs.append((list(map(as_bytes, it[6::2])),
-                          list(map(as_bytes, it[7::2]))))
+            if type(it[6]) is bytes:   # raw-scanned: all-bytes args
+                pairs.append((list(it[6::2]), list(it[7::2])))
+            else:
+                pairs.append((list(map(as_bytes, it[6::2])),
+                              list(map(as_bytes, it[7::2]))))
     else:
         pairs = [(_members_of(r[3]), None) for r in recs]
     ki0 = bb.add_keys([r[0] for r in recs], enc, [r[2] for r in recs])

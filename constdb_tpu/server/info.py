@@ -284,6 +284,31 @@ def _section_durability(node, out):
     out.append(("aof_recovered_ops", x.get("aof_recovered_ops", 0)))
 
 
+def _section_recovery(node, out):
+    """Fast-restart observability (persist/oplog.py): how the last boot
+    recovery ran (wall time, landing strategy, replay concurrency) and
+    the incremental-checkpoint cut the NEXT restart will replay from."""
+    x = node.stats.extra
+    out.append(("recovery_wall_s", x.get("recovery_wall_s", 0)))
+    out.append(("recovery_mode", x.get("recovery_mode", "")))
+    out.append(("recovery_shards", x.get("recovery_shards", 0)))
+    out.append(("recovery_merge_rounds",
+                x.get("recovery_merge_rounds", 0)))
+    if "digest_warm_s" in x:
+        out.append(("digest_warm_s", x["digest_warm_s"]))
+    if "recovery_restore_to" in x:
+        out.append(("recovery_restore_to", x["recovery_restore_to"]))
+        out.append(("recovery_restore_skipped",
+                    x.get("recovery_restore_skipped", 0)))
+    lg = getattr(node, "oplog", None)
+    if lg is not None:
+        out.append(("checkpoint_secs", lg.checkpoint_secs))
+        out.append(("checkpoint_last_uuid", lg.checkpoint_uuid))
+        out.append(("checkpoint_age_s",
+                    round(time.time() - lg.checkpoint_ts, 3)
+                    if lg.checkpoint_ts else -1))
+
+
 def _section_replication(node, out):
     peers = node.replicas.describe() if node.replicas else []
     live = [m for _, m in peers if m.alive]
@@ -379,6 +404,7 @@ SECTIONS = {
     "stats": _section_stats,
     "cpu": _section_cpu,
     "durability": _section_durability,
+    "recovery": _section_recovery,
     "replication": _section_replication,
     "keyspace": _section_keyspace,
 }

@@ -74,7 +74,10 @@ class ServerApp:
                  aof_fsync: Optional[str] = None,
                  aof_rewrite_pct: Optional[int] = None,
                  aof_rewrite_min_mb: Optional[int] = None,
-                 aof_dir: str = ""):
+                 aof_dir: str = "",
+                 checkpoint_secs: Optional[float] = None,
+                 checkpoint_min_mb: Optional[int] = None,
+                 restore_to: int = 0):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -244,6 +247,20 @@ class ServerApp:
             env_int("CONSTDB_AOF_REWRITE_MIN_MB", 16) \
             if aof_rewrite_min_mb is None else aof_rewrite_min_mb
         self.aof_dir = aof_dir or os.path.join(work_dir, "aof")
+        # incremental checkpoints: a time-triggered rewrite cadence —
+        # every checkpoint_secs (once the tail exceeds checkpoint_min_mb)
+        # the log cuts a fresh generation behind a consistent snapshot,
+        # keeping the restart tail short.  0 = size-triggered rewrites
+        # only (the CONSTDB_AOF_REWRITE_PCT policy, unchanged).
+        from ..conf import env_float
+        self.checkpoint_secs = env_float("CONSTDB_CHECKPOINT_SECS", 0.0) \
+            if checkpoint_secs is None else checkpoint_secs
+        self.checkpoint_min_mb = \
+            env_int("CONSTDB_CHECKPOINT_MIN_MB", 1) \
+            if checkpoint_min_mb is None else checkpoint_min_mb
+        # point-in-time restore: replay stops at this uuid and the log
+        # re-bases on the next rewrite.  Run against a COPY of the dir.
+        self.restore_to = restore_to
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -731,6 +748,27 @@ _SNAPSHOT_LOAD_ERRORS = (CstError, OSError, ValueError, KeyError,
                          IndexError, OverflowError, EOFError)
 
 
+def _schedule_cache_warm(app: ServerApp) -> None:
+    """Digest crc caches warm OFF the boot path: an executor thread
+    fills them after the listener opens (keyspace.warm_digest_caches
+    takes its own lock — the replica-link digest path uses the same
+    off-loop discipline), so restart wall time measures replay, not
+    cache rebuilds.  The read cache stays cold until traffic arrives."""
+    node = app.node
+    loop = asyncio.get_event_loop()
+    t0 = time.monotonic()
+
+    def _warm() -> None:
+        try:
+            node.ks.warm_digest_caches()
+            node.stats.extra["digest_warm_s"] = round(
+                time.monotonic() - t0, 3)
+        except Exception:  # noqa: BLE001 - warming is best-effort
+            log.exception("digest cache warm failed")
+
+    loop.run_in_executor(None, _warm)
+
+
 async def start_node(node: Node, **kwargs) -> ServerApp:
     """Convenience: build + start a ServerApp (optionally restoring the
     boot snapshot — a capability the reference lacks, SURVEY.md §5.4)."""
@@ -752,16 +790,34 @@ async def start_node(node: Node, **kwargs) -> ServerApp:
                     node.node_id = nid
 
             async def _restore_aof_plane() -> None:
-                await oplog_mod.recover_into_plane(app)
+                t0 = time.monotonic()
+                await oplog_mod.recover_into_plane(
+                    app, restore_to=app.restore_to)
+                node.stats.extra["recovery_wall_s"] = round(
+                    time.monotonic() - t0, 3)
+                if app.restore_to and node.oplog is not None:
+                    # cut the fresh base NOW (arm flagged the log
+                    # dirty): the tail above the restore target must
+                    # never replay again
+                    await node.oplog.rewrite(app)
 
             app._boot_restore = _restore_aof_plane
             await app.start()
+            _schedule_cache_warm(app)
             return app
+        t0 = time.monotonic()
         info = oplog_mod.recover(node, app.aof_dir,
                                  boot_snapshot=app.snapshot_path,
-                                 engine=node.engine)
-        oplog_mod.arm(app, info)
+                                 engine=node.engine,
+                                 restore_to=app.restore_to)
+        lg = oplog_mod.arm(app, info)
+        node.stats.extra["recovery_wall_s"] = round(
+            time.monotonic() - t0, 3)
         await app.start()
+        if app.restore_to:
+            # see the sharded branch above: re-base immediately
+            await lg.rewrite(app)
+        _schedule_cache_warm(app)
         return app
     if app.serve_shards > 1:
         # shard-per-core node: workers ARE the store, so the boot

@@ -97,6 +97,20 @@ class ServeShardPlane:
         self._inflight: dict[int, list] = {}
         self._next_token = 0
         self._last_stats = [dict() for _ in range(n_shards)]
+        # Serve-ack ORDERING: worker futures complete FIFO per shard,
+        # but their handlers can run out of order ACROSS connections —
+        # one connection's inline quiesce ack for a later window vs
+        # another connection's still-queued done-callback for an
+        # earlier one.  Mirroring the later window's entries into the
+        # segment first would make push_many reject the earlier
+        # window (uuid regression) and silently LOSE its acked writes
+        # from the repl stream and the AOF.  Every dispatched
+        # sub-chunk takes a per-shard ticket; handlers drain tickets
+        # strictly in ticket order (worker FIFO means a later future
+        # being done implies every earlier one is).
+        self._ack_pend: list[dict] = [dict() for _ in range(n_shards)]
+        self._ack_seq = [0] * n_shards
+        self._ack_next = [0] * n_shards
 
     # ----------------------------------------------------------- lifecycle
 
@@ -173,9 +187,11 @@ class ServeShardPlane:
                             len(sub.msgs)))
                 if sub.token is not None:
                     dispatched.add(sub.token)
+                seq = self._ack_seq[shard]
+                self._ack_seq[shard] = seq + 1
+                self._ack_pend[shard][seq] = (sub.token, fut)
                 fut.add_done_callback(
-                    lambda f, s=shard, t=sub.token:
-                        self._on_serve_ack(s, t, f))
+                    lambda f, s=shard: self._on_serve_ack(s))
                 futs.append((fut, sub.idxs, shard, sub.token))
             subs.clear()
 
@@ -191,11 +207,11 @@ class ServeShardPlane:
                 # read the merged repl_log MISSING entries whose writes
                 # already replied OK (found by the overload round's
                 # stress runs: REPLLOG UUIDS intermittently saw one
-                # shard's sub-chunk absent).  _on_serve_ack is
+                # shard's sub-chunk absent).  The ticket drain is
                 # idempotent, so the still-queued callback is a no-op —
                 # and remains the mirror-of-record when a client
                 # disconnect cancels this coroutine mid-quiesce.
-                self._on_serve_ack(shard, token, fut)
+                self._on_serve_ack(shard)
                 sout, spans = res[0], res[1]
                 prev = 0
                 for j, idx in enumerate(idxs):
@@ -263,17 +279,29 @@ class ServeShardPlane:
         for r in replies:
             out += r
 
-    def _on_serve_ack(self, shard: int, token: Optional[int], fut) -> None:
-        """Reply-order ack bookkeeping (FIFO per shard): mirror the
-        worker's log entries into this shard's segment, then release
-        the floor window, then wake the pushers — that order is what
-        keeps the merged stream strictly increasing.  Runs exactly once
-        per future (idempotence guard): inline from quiesce() for
-        already-resolved futures (see the race note there) and via the
-        done-callback otherwise."""
-        if getattr(fut, "_cst_acked", False):
-            return
-        fut._cst_acked = True
+    def _on_serve_ack(self, shard: int) -> None:
+        """Reply-order ack bookkeeping (FIFO per shard): drain this
+        shard's ack tickets strictly in dispatch order, stopping at the
+        first unresolved future.  Called both inline from quiesce()
+        for already-resolved futures (see the race note there) and
+        from every done-callback; each ticket is processed exactly
+        once, and a ticket is never processed before every earlier
+        ticket of its shard — the ordering push_many and the AOF
+        segment mirror both require."""
+        pend = self._ack_pend[shard]
+        while True:
+            entry = pend.get(self._ack_next[shard])
+            if entry is None or not entry[1].done():
+                return
+            del pend[self._ack_next[shard]]
+            self._ack_next[shard] += 1
+            self._ack_one(shard, entry[0], entry[1])
+
+    def _ack_one(self, shard: int, token: Optional[int], fut) -> None:
+        """Land one resolved sub-chunk: mirror the worker's log entries
+        into this shard's segment (and the AOF), then release the floor
+        window, then wake the pushers — that order is what keeps the
+        merged stream strictly increasing."""
         if fut.cancelled() or fut.exception() is not None:
             # the worker failed mid-chunk: its entries may be missing,
             # so the window stays HELD — the peer stream stalls on this

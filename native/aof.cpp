@@ -1,0 +1,299 @@
+// Native AOF segment scanner: record framing + crc + frame decode in C.
+//
+// Boot replay is scan-bound before it is merge-bound: a segment is
+// millions of tiny `len | crc32 | rtype | payload` records, and the
+// pure-Python loop in persist/oplog.py scan_segment pays ~9us of
+// interpreter dispatch per record before a single op applies.  The
+// recovery bench (bench.py --mode recover) showed the scan+decode floor
+// capping the bulk-replay speedup, so this moves the whole per-record
+// walk into one C call per segment.
+//
+// aof_scan(buf, pos, max_record[, Arr, Bulk, Int, Simple, Err, nil])
+//   -> (records, valid_pos)
+//
+//   * records — the maximal valid record prefix, in file order.  Every
+//     record is `(rtype, payload_bytes)` — EXCEPT REC_FRAME records
+//     when the six RESP message classes are passed AND the payload
+//     parses cleanly, which come back pre-decoded as
+//     `(2, origin, uuid, name_bytes, args_list)` so the replay loop
+//     never touches the payload again (no intermediate payload bytes
+//     object, no second parse pass).
+//   * valid_pos — offset of the first invalid byte (the torn-tail
+//     truncation point), exactly scan_segment's contract: short length
+//     word, zero/oversized length, crc mismatch, or unknown rtype all
+//     stop the scan there.
+//
+// Fidelity rule: a REC_FRAME payload is pre-decoded ONLY when this C
+// path reproduces the Python decode bit-for-bit — canonical varint
+// header, exactly one flat RESP array consuming the whole payload,
+// first element a Bulk.  Anything else (overlong varint, trailing
+// bytes, fallback-grade RESP, top-level non-array) degrades to the raw
+// `(rtype, payload)` tuple and the Python side re-decodes it — and
+// accepts or loudly skips it — through the reference path.  The crc
+// is zlib.crc32 (CRC-32/ISO-HDLC), table-driven here.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+
+namespace aof {
+
+constexpr int kRecBatch = 1;
+constexpr int kRecFrame = 2;
+constexpr int kRecWmark = 3;
+
+inline const uint32_t* crc_table() {
+    static uint32_t tab[256];
+    static bool built = false;
+    if (!built) {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            tab[i] = c;
+        }
+        built = true;
+    }
+    return tab;
+}
+
+inline uint32_t crc32(const uint8_t* p, Py_ssize_t n) {
+    const uint32_t* tab = crc_table();
+    uint32_t c = 0xFFFFFFFFu;
+    for (Py_ssize_t i = 0; i < n; i++)
+        c = tab[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// Canonical uvarint (utils/varint.py write_uvarint's exact envelope:
+// tag in the top 2 bits, big-endian value bytes, overlong forms
+// REJECTED).  Returns 1 ok, 0 malformed/truncated — no Python error.
+inline int uvarint(const uint8_t* b, Py_ssize_t len, Py_ssize_t* pos,
+                   uint64_t* out) {
+    Py_ssize_t p = *pos;
+    if (p >= len) return 0;
+    const uint8_t flag = b[p];
+    const int tag = flag >> 6;
+    if (tag == 0) {
+        *out = flag;
+        *pos = p + 1;
+        return 1;
+    }
+    if (tag == 1) {
+        if (p + 2 > len) return 0;
+        const uint64_t v = ((uint64_t)(flag & 0x3Fu) << 8) | b[p + 1];
+        if (v < (1u << 6)) return 0;  // non-canonical (overlong)
+        *out = v;
+        *pos = p + 2;
+        return 1;
+    }
+    if (tag == 2) {
+        if (p + 4 > len) return 0;
+        const uint64_t v = ((uint64_t)(flag & 0x3Fu) << 24) |
+                           ((uint64_t)b[p + 1] << 16) |
+                           ((uint64_t)b[p + 2] << 8) | b[p + 3];
+        if (v < (1u << 14)) return 0;
+        *out = v;
+        *pos = p + 4;
+        return 1;
+    }
+    if (flag != 0xC0u) return 0;  // tag-3 flag low bits must be clear
+    if (p + 9 > len) return 0;
+    uint64_t v = 0;
+    for (int i = 1; i <= 8; i++) v = (v << 8) | b[p + i];
+    if (v < (1ull << 30)) return 0;
+    *out = v;
+    *pos = p + 9;
+    return 1;
+}
+
+// Decode one REC_FRAME payload body into `(2, origin, uuid, name, args)`.
+// Returns nullptr WITHOUT a Python error when the payload needs the
+// pure-path fallback; nullptr WITH an error only on CPython failures.
+PyObject* decode_frame(const uint8_t* p, Py_ssize_t len, PyObject* arr_t,
+                       PyObject* bulk_t, PyObject* int_t,
+                       PyObject* simple_t, PyObject* err_t,
+                       PyObject* nil_obj) {
+    Py_ssize_t pos = 0;
+    uint64_t origin, uuid;
+    if (!uvarint(p, len, &pos, &origin)) return nullptr;
+    if (!uvarint(p, len, &pos, &uuid)) return nullptr;
+    resp::ParseCtx ctx{reinterpret_cast<const char*>(p),
+                       len,
+                       arr_t,
+                       bulk_t,
+                       int_t,
+                       simple_t,
+                       err_t,
+                       nil_obj,
+                       resp::kMaxBulk};
+    PyObject* msg = nullptr;
+    const int st = resp::parse_any(ctx, &pos, 0, &msg, nullptr);
+    if (st == -2) return nullptr;  // CPython error already set
+    if (st != 1) return nullptr;   // partial / fallback-grade payload
+    if (pos != len ||
+        Py_TYPE(msg) != reinterpret_cast<PyTypeObject*>(arr_t)) {
+        // trailing bytes, or a top-level non-array (nil/bulk/int...)
+        Py_DECREF(msg);
+        return nullptr;
+    }
+    PyObject* items = PyObject_GetAttr(msg, resp::names().items);
+    Py_DECREF(msg);
+    if (!items) return nullptr;  // error set
+    if (!PyList_CheckExact(items) || PyList_GET_SIZE(items) < 1) {
+        Py_DECREF(items);
+        return nullptr;
+    }
+    PyObject* first = PyList_GET_ITEM(items, 0);
+    if (Py_TYPE(first) != reinterpret_cast<PyTypeObject*>(bulk_t)) {
+        Py_DECREF(items);
+        return nullptr;
+    }
+    PyObject* name = PyObject_GetAttr(first, resp::names().val);
+    if (!name) {
+        Py_DECREF(items);
+        return nullptr;  // error set
+    }
+    if (!PyBytes_CheckExact(name)) {
+        Py_DECREF(name);
+        Py_DECREF(items);
+        return nullptr;
+    }
+    PyObject* rest = PyList_GetSlice(items, 1, PyList_GET_SIZE(items));
+    Py_DECREF(items);
+    if (!rest) {
+        Py_DECREF(name);
+        return nullptr;  // error set
+    }
+    // (iKKNN): N steals name/rest
+    PyObject* rec =
+        Py_BuildValue("(iKKNN)", kRecFrame, (unsigned long long)origin,
+                      (unsigned long long)uuid, name, rest);
+    return rec;  // nullptr -> error set, refs already consumed
+}
+
+// Raw-mode frame decode: a FLAT command array of bulk strings comes
+// back as plain PyBytes name + args (no message objects).  The bulk
+// replay path unwraps every argument into bytes immediately (columnar
+// group-encode), so building Bulk wrappers just to strip them is pure
+// overhead — about half the scan cost at the record sizes the recovery
+// bench replays.  Anything non-flat (ints, nested arrays, nils) bails
+// so the caller can fall back to the object decode.  Returns nullptr
+// WITHOUT a Python error on any bail; WITH an error only on CPython
+// failures.
+PyObject* decode_frame_raw(const uint8_t* p, Py_ssize_t len) {
+    Py_ssize_t pos = 0;
+    uint64_t origin, uuid;
+    if (!uvarint(p, len, &pos, &origin)) return nullptr;
+    if (!uvarint(p, len, &pos, &uuid)) return nullptr;
+    const char* b = reinterpret_cast<const char*>(p);
+    if (pos >= len || b[pos] != '*') return nullptr;
+    long long cnt;
+    Py_ssize_t q;
+    if (resp::int_line(b, len, pos + 1, &cnt, &q) != 1) return nullptr;
+    if (cnt < 1 || cnt > (long long)resp::kMaxArr) return nullptr;
+    PyObject* name = nullptr;
+    PyObject* args = PyList_New((Py_ssize_t)cnt - 1);
+    if (!args) return nullptr;
+    bool ok = true;
+    for (long long i = 0; ok && i < cnt; i++) {
+        long long ln;
+        Py_ssize_t r;
+        if (q >= len || b[q] != '$' ||
+            resp::int_line(b, len, q + 1, &ln, &r) != 1 || ln < 0 ||
+            ln > resp::kMaxBulk || r + ln + 2 > len || b[r + ln] != '\r' ||
+            b[r + ln + 1] != '\n') {
+            ok = false;
+            break;
+        }
+        PyObject* s = PyBytes_FromStringAndSize(b + r, (Py_ssize_t)ln);
+        if (!s) {
+            Py_XDECREF(name);
+            Py_DECREF(args);
+            return nullptr;  // error set
+        }
+        if (i == 0)
+            name = s;
+        else
+            PyList_SET_ITEM(args, i - 1, s);
+        q = r + ln + 2;
+    }
+    if (!ok || q != len) {
+        Py_XDECREF(name);
+        Py_DECREF(args);
+        return nullptr;
+    }
+    return Py_BuildValue("(iKKNN)", kRecFrame, (unsigned long long)origin,
+                         (unsigned long long)uuid, name, args);
+}
+
+}  // namespace aof
+
+static PyObject* py_aof_scan(PyObject*, PyObject* args) {
+    Py_buffer view;
+    Py_ssize_t pos;
+    long long max_record;
+    PyObject *arr_t = nullptr, *bulk_t = nullptr, *int_t = nullptr,
+             *simple_t = nullptr, *err_t = nullptr, *nil_obj = nullptr;
+    int raw = 0;
+    if (!PyArg_ParseTuple(args, "y*nL|OOOOOOi", &view, &pos, &max_record,
+                          &arr_t, &bulk_t, &int_t, &simple_t, &err_t,
+                          &nil_obj, &raw))
+        return nullptr;
+    const uint8_t* b = static_cast<const uint8_t*>(view.buf);
+    const Py_ssize_t n = view.len;
+    const bool fuse = nil_obj != nullptr;
+    PyObject* out = PyList_New(0);
+    if (!out) {
+        PyBuffer_Release(&view);
+        return nullptr;
+    }
+    while (pos + 8 <= n) {
+        const uint64_t ln = (uint64_t)b[pos] | ((uint64_t)b[pos + 1] << 8) |
+                            ((uint64_t)b[pos + 2] << 16) |
+                            ((uint64_t)b[pos + 3] << 24);
+        if (ln < 1 || (long long)ln > max_record ||
+            pos + 8 + (Py_ssize_t)ln > n)
+            break;
+        const uint32_t want = (uint32_t)b[pos + 4] |
+                              ((uint32_t)b[pos + 5] << 8) |
+                              ((uint32_t)b[pos + 6] << 16) |
+                              ((uint32_t)b[pos + 7] << 24);
+        const uint8_t* body = b + pos + 8;
+        if (aof::crc32(body, (Py_ssize_t)ln) != want) break;
+        const int rtype = body[0];
+        if (rtype < aof::kRecBatch || rtype > aof::kRecWmark) break;
+        PyObject* rec = nullptr;
+        if (fuse && rtype == aof::kRecFrame) {
+            if (raw) {
+                rec = aof::decode_frame_raw(body + 1, (Py_ssize_t)(ln - 1));
+                if (!rec && PyErr_Occurred()) goto fail;
+            }
+            if (!rec) {
+                rec = aof::decode_frame(body + 1, (Py_ssize_t)(ln - 1),
+                                        arr_t, bulk_t, int_t, simple_t,
+                                        err_t, nil_obj);
+                if (!rec && PyErr_Occurred()) goto fail;
+            }
+        }
+        if (!rec)
+            rec = Py_BuildValue("(iy#)", rtype,
+                                reinterpret_cast<const char*>(body) + 1,
+                                (Py_ssize_t)(ln - 1));
+        if (!rec) goto fail;
+        {
+            const int rc = PyList_Append(out, rec);
+            Py_DECREF(rec);
+            if (rc != 0) goto fail;
+        }
+        pos += 8 + (Py_ssize_t)ln;
+    }
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(Nn)", out, pos);
+
+fail:
+    Py_DECREF(out);
+    PyBuffer_Release(&view);
+    return nullptr;
+}

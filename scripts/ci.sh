@@ -418,6 +418,182 @@ print("aof bench smoke verified:",
 EOF
 
 echo
+echo "== recovery smoke (parallel bulk-merge restart + checkpointed tail) =="
+# the fast-restart plane end to end on a REAL server process: firehose
+# acked writes over a socket, kill -9 mid-burst, and time the cold
+# restart — the default parallel bulk-merge recovery must come up with
+# ZERO acked writes lost and say so in the INFO Recovery gauges
+# (recovery_mode/recovery_wall_s/recovery_merge_rounds).  Then an
+# incremental-checkpoint phase (CONSTDB_CHECKPOINT_SECS cadence) cuts a
+# mid-run checkpoint and proves the NEXT restart replays only the
+# post-checkpoint tail, gauge-asserted (aof_recovered_ops collapses,
+# checkpoint_last_uuid survives the restart).  The differential suites
+# proper run inside tier-1 (tests/test_oplog.py); the crash-mid-
+# checkpoint cells run in the chaos smoke below.
+JAX_PLATFORMS=cpu timeout -k 10 420 python - <<'EOF' || exit $?
+import asyncio, os, signal, socket, subprocess, sys, tempfile, time
+
+async def connect(port, tries=150):
+    from constdb_tpu.chaos.cluster import Client
+    c = Client()
+    for _ in range(tries):
+        try:
+            await c.connect(f"127.0.0.1:{port}")
+            return c
+        except OSError:
+            await asyncio.sleep(0.1)
+    raise SystemExit("server never came up")
+
+async def info_map(c, section):
+    raw = (await c.cmd("info", section)).val.decode()
+    out = {}
+    for line in raw.splitlines():
+        if ":" in line and not line.startswith("#"):
+            k, _, v = line.partition(":")
+            out[k] = v.strip()
+    return out
+
+async def main():
+    with tempfile.TemporaryDirectory(prefix="constdb-rec-") as work:
+        s = socket.socket(); s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]; s.close()
+        args = [sys.executable, "-m", "constdb_tpu.bin.server",
+                "--port", str(port), "--work-dir", work,
+                "--aof", "--aof-fsync", "always", "--node-id", "1"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(args, env=env)
+        c = await connect(port)
+        # -- phase 1: acked firehose (mixed columnar shapes so the bulk
+        # replay actually group-encodes), then a pipelined burst the
+        # kill -9 lands inside
+        acked = {}
+        for i in range(300):
+            k = f"k{i % 16}"
+            await c.cmd("set", k, f"v{i:06d}")
+            acked[k] = i
+            if i % 3 == 0:
+                await c.cmd("sadd", f"s{i % 8}", f"m{i}")
+            elif i % 3 == 1:
+                await c.cmd("hset", f"h{i % 8}", f"f{i % 5}", f"w{i}")
+        from constdb_tpu.resp.codec import encode_msg
+        from constdb_tpu.resp.message import Arr, Bulk
+        buf = bytearray()
+        for i in range(300, 2300):
+            buf += encode_msg(Arr([Bulk(b"set"), Bulk(b"k%d" % (i % 16)),
+                                   Bulk(b"v%06d" % i)]))
+        c.writer.write(bytes(buf))
+        await c.writer.drain()
+        got = 0
+        t0 = time.monotonic()
+        await asyncio.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)
+        try:
+            while got < 2000 and time.monotonic() - t0 < 5:
+                data = await asyncio.wait_for(c.reader.read(1 << 16), 2.0)
+                if not data:
+                    break
+                c.parser.feed(data)
+                while c.parser.next_msg() is not None:
+                    acked[f"k{(300 + got) % 16}"] = 300 + got
+                    got += 1
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        proc.wait(timeout=10)
+        print(f"[smoke] killed -9 mid-firehose after {500 + got} acked "
+              f"writes")
+        # -- phase 2: timed cold restart through the default parallel
+        # bulk-merge recovery; every acked write must be back
+        t0 = time.monotonic()
+        proc = subprocess.Popen(args, env=env)
+        c2 = await connect(port)
+        boot_wall = time.monotonic() - t0
+        lost = []
+        for k, serial in acked.items():
+            r = await c2.cmd("get", k)
+            v = r.val.decode() if hasattr(r, "val") and r.val else ""
+            if not v.startswith("v") or int(v[1:]) < serial:
+                lost.append((k, serial, v))
+        assert not lost, f"acked writes lost after kill -9: {lost[:5]}"
+        rec = await info_map(c2, "recovery")
+        assert rec["recovery_mode"].startswith("bulk"), rec
+        assert float(rec["recovery_wall_s"]) > 0, rec
+        assert int(rec["recovery_merge_rounds"]) >= 1, rec
+        dur = await info_map(c2, "durability")
+        full_ops = int(dur["aof_recovered_ops"])
+        assert full_ops >= 500 + got, (full_ops, 500 + got)
+        await c2.close()
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.wait(timeout=15)
+        print(f"[smoke] parallel restart verified: {full_ops} ops "
+              f"replayed in {rec['recovery_wall_s']}s "
+              f"({rec['recovery_mode']}, {rec['recovery_merge_rounds']} "
+              f"merge rounds; boot-to-serve {boot_wall:.2f}s), zero "
+              f"acked writes lost")
+        # -- phase 3: incremental checkpoints — run with a fast cadence
+        # until a checkpoint cuts, append a small tail, and prove the
+        # next clean restart replays ONLY the tail
+        env_ck = dict(env, CONSTDB_CHECKPOINT_SECS="0.3",
+                      CONSTDB_CHECKPOINT_MIN_MB="0")
+        proc = subprocess.Popen(args, env=env_ck)
+        c3 = await connect(port)
+        ck_uuid = 0
+        for i in range(200):
+            await c3.cmd("set", f"ck{i % 8}", f"x{i:04d}")
+            rec = await info_map(c3, "recovery")
+            ck_uuid = int(rec.get("checkpoint_last_uuid", 0))
+            if ck_uuid:
+                break
+            await asyncio.sleep(0.1)
+        assert ck_uuid > 0, "checkpoint cadence never cut"
+        assert float(rec["checkpoint_age_s"]) >= 0, rec
+        for i in range(40):
+            await c3.cmd("set", f"t{i}", f"y{i:04d}")
+        await c3.close()
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.wait(timeout=15)
+        proc = subprocess.Popen(args, env=env)
+        c4 = await connect(port)
+        dur = await info_map(c4, "durability")
+        tail_ops = int(dur["aof_recovered_ops"])
+        assert dur["aof_recovery_source"].startswith("aof-base-snapshot"), \
+            dur
+        assert tail_ops < full_ops // 4, (tail_ops, full_ops)
+        rec = await info_map(c4, "recovery")
+        assert int(rec["checkpoint_last_uuid"]) > 0, rec
+        v = (await c4.cmd("get", "t39")).val
+        assert v == b"y0039", v
+        await c4.close()
+        os.kill(proc.pid, signal.SIGTERM)
+        proc.wait(timeout=15)
+        print(f"[smoke] checkpointed restart verified: {tail_ops} "
+              f"tail ops replayed (vs {full_ops} full-log), "
+              f"checkpoint uuid {ck_uuid} survived the restart")
+
+asyncio.run(main())
+EOF
+JAX_PLATFORMS=cpu CONSTDB_BENCH_RECOVER_OPS=8000 \
+CONSTDB_BENCH_RECOVER_REPS=1 \
+    timeout -k 10 420 python bench.py --mode recover \
+    > /tmp/_ci_recover.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_recover.json"))
+assert out["verified"], "recover bench legs failed oracle verification"
+legs = {leg["leg"]: leg for leg in out["legs"]}
+assert legs["frames-bulk"]["byte_identical"], "bulk replay diverged"
+assert legs["batch-bulk"]["byte_identical"], "batch bulk replay diverged"
+assert legs["checkpointed-tail"]["tail_ops"] < out["ops"] // 4, \
+    "checkpointed restart replayed more than the tail"
+assert all(s["verified"] for s in out["shard_curve"]), \
+    "sharded restart failed its oracle"
+print("recover bench smoke verified:",
+      f"frames {legs['frames-bulk']['speedup_vs_serial']}x,",
+      f"batches {legs['batch-bulk']['speedup_vs_serial']}x,",
+      f"tail {legs['checkpointed-tail']['tail_ops']} of",
+      out["ops"], "ops")
+EOF
+
+echo
 echo "== chaos smoke (fixed-seed certification cells) =="
 # the scripted chaos scenario — partitions + reorder + duplication +
 # mid-frame truncation + connection/process kills + clock jitter + one

@@ -86,6 +86,18 @@ def test_certify_aof_cell(tmp_path):
     assert stats["journal_ops"] > 0
 
 
+def test_certify_checkpoint_crash_cell(tmp_path):
+    """Crash-mid-checkpoint (round 20): the schedule fault-injects the
+    rewrite at each commit interleaving — after the generation switch,
+    after the base snapshot write, and after the meta commit with the
+    old generations still on disk — then kill -9s and cold-restarts.
+    Every interleaving must replay idempotently to the same bytes (the
+    checkpoint-cut consistency law)."""
+    stats = run_scenario(certify_scenario(7, Cell(aof="always",
+                                                  ckpt=True)))
+    assert stats["journal_ops"] > 0
+
+
 @pytest.mark.slow  # ~5s: the 1s group-commit cadence paces every
 #                    crash/restart window (the cell also runs in the
 #                    ci.sh chaos smoke and the full matrix)
