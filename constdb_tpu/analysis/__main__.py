@@ -8,6 +8,12 @@ Modes:
   --write-baseline   regenerate baseline.json from the current findings,
                      preserving existing per-key notes.
   --list-rules       print each rule's name + one-line purpose.
+  --json             machine-readable output on stdout instead of the
+                     human rendering (composes with --baseline).  The
+                     payload's `counts` map uses the same
+                     rule:path:qualname:token keys as baseline.json, so
+                     CI can artifact a run and diff it against another
+                     or against the committed baseline directly.
 
 Default scan: the constdb_tpu package (plus the project-level README ↔
 ENV_REGISTRY check).  Explicit paths skip the project-level check and
@@ -40,6 +46,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate baseline.json (keeps existing notes)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout (stable "
+                         "keys matching baseline.json)")
     ns = ap.parse_args(argv)
 
     if ns.list_rules:
@@ -64,6 +73,27 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote {bpath}: {len(payload['findings'])} keys "
               f"({len(findings)} findings)")
         return 0
+
+    if ns.as_json:
+        import json
+        payload = {
+            "version": 1,
+            "counts": baseline_payload(findings, {})["findings"],
+            "findings": [{
+                "key": f.key, "rule": f.rule, "severity": f.severity,
+                "path": f.path, "line": f.line, "qualname": f.qualname,
+                "token": f.token, "message": f.message, "hint": f.hint,
+            } for f in findings],
+        }
+        if ns.baseline:
+            growth, stale = compare_to_baseline(findings,
+                                                load_baseline(bpath))
+            payload["baseline"] = {"growth": sorted(f.key for f in growth),
+                                   "stale": stale}
+            print(json.dumps(payload, indent=1, sort_keys=True))
+            return 1 if growth else 0
+        print(json.dumps(payload, indent=1, sort_keys=True))
+        return 1 if findings else 0
 
     if ns.baseline:
         growth, stale = compare_to_baseline(findings, load_baseline(bpath))

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import ast
 
+from . import flow
+from .cfg import awaits_in
 from .core import FileContext, Rule, dotted, own_nodes
 
 
@@ -598,6 +600,188 @@ class KeyConfinedRule(Rule):
             "confinement is not statically derivable")
 
 
+class AwaitAtomicityRule(Rule):
+    """AWAIT-ATOMICITY: a shared-state read cached across an await must
+    not guard a mutation — the bug class behind three shipped races
+    (PR 2 close-window link sweep, PR 11 consistency cut, PR 12 quiesce
+    done-callback).
+
+    Flow-sensitive (analysis/cfg.py + analysis/flow.py): the dataflow
+    engine tracks which locals are derived from shared node/link/plane
+    state and marks them stale at every await point the CFG says can
+    interleave before their use.  The rule fires only on the high-signal
+    shape: a STALE local in a guard position (an `if`/`while` test or a
+    `for` iterable) over a suite that mutates shared state.  Re-reading
+    after the await clears the fact; a deliberate pre-await snapshot
+    (the PR 11 fix captures the cut FIRST on purpose) is declared with
+    `# lint: pin[name]` on the capture line."""
+
+    name = "AWAIT-ATOMICITY"
+    hint = ("re-read the shared state after the await (other tasks ran "
+            "there), or declare a deliberate pre-await snapshot with "
+            "# lint: pin[name] on the capture line")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "server", "replica", "persist", "parallel")
+
+    def check(self, ctx: FileContext):
+        pins = flow.pins_by_line(ctx.source)
+        for qual, fn, is_async, _actx in ctx.functions:
+            if not is_async:
+                continue
+            if not any(isinstance(n, ast.Await) for n in own_nodes(fn)):
+                continue
+            fa = flow.FunctionFlow(fn, pins)
+            for node in own_nodes(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    env = fa.env_at.get(id(node.test))
+                    if env is None:
+                        continue
+                    suites = list(node.body) + list(node.orelse)
+                    yield from self._guard(ctx, qual, node, node.test,
+                                           env, suites, "test")
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    env = fa.env_at.get(id(node))
+                    if env is None:
+                        continue
+                    yield from self._guard(ctx, qual, node, node.iter,
+                                           env, list(node.body), "iterable")
+
+    def _guard(self, ctx, qual, node, expr, env, suites, where):
+        muts = None
+        # only VALUE usages can be stale: locals are task-private, so
+        # deref bases and `is None` binding tests read fresh state
+        for nm in sorted(flow.value_used_names(expr)):
+            st = env.get(nm)
+            if st is None or not st.sources or not st.stale:
+                continue
+            if muts is None:
+                muts = flow.shared_mutations(suites, env)
+            if not muts:
+                return
+            src = ", ".join(sorted(st.sources)[:2])
+            mut_what = muts[0][1]
+            yield self.finding(
+                ctx, node, qual, nm,
+                f"local {nm!r} (from {src}, line {st.line}) is read in "
+                f"this {where} after the await at line {st.stale_line} "
+                f"and guards a mutation of {mut_what} — tasks "
+                "interleaving at that await can invalidate the cached "
+                "view (the close-window / quiesce-callback race shape)")
+
+
+class LockDisciplineRule(Rule):
+    """LOCK-DISCIPLINE: lock windows and the event loop don't mix.
+
+    Two directions, one per lock flavor:
+    * a SYNC `with <...>_lock:` body containing an `await` parks the
+      thread lock across an arbitrary number of scheduler turns — every
+      other thread contending on it (the keyspace `_crc_lock` protects
+      merge-worker CRC reads) stalls for as long as the loop pleases,
+      and re-entry through the same coroutine path self-deadlocks;
+    * an ASYNC `with <...>_lock:` body making blocking sync calls
+      (file IO, sleeps, `.result()`) wedges the loop while holding the
+      lock, so every waiter behind it (the `_stream_lock` serializes
+      snapshot streams against spill downloads) is wedged too — spill
+      IO belongs in run_in_executor, like link._stream_file does."""
+
+    name = "LOCK-DISCIPLINE"
+    hint = ("keep thread-lock bodies synchronous (snapshot the data, "
+            "release, then await), and move blocking IO under asyncio "
+            "locks to loop.run_in_executor(...)")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "server", "replica", "store", "persist",
+                       "parallel")
+
+    @staticmethod
+    def _lock_names(node: ast.AST) -> list[str]:
+        out = []
+        for item in node.items:
+            name = dotted(item.context_expr)
+            if name and name.rsplit(".", 1)[-1].endswith("_lock"):
+                out.append(name)
+        return out
+
+    def check(self, ctx: FileContext):
+        for qual, fn, _is_async, _actx in ctx.functions:
+            for node in own_nodes(fn):
+                if isinstance(node, ast.With):
+                    for lock in self._lock_names(node):
+                        hits = [a for s in node.body for a in awaits_in(s)]
+                        if hits:
+                            yield self.finding(
+                                ctx, hits[0], qual, lock,
+                                f"await inside the sync `with {lock}:` "
+                                "window parks the thread lock across "
+                                "scheduler turns — contending threads "
+                                "stall and re-entry self-deadlocks")
+                elif isinstance(node, ast.AsyncWith):
+                    for lock in self._lock_names(node):
+                        yield from self._blocking_in(ctx, qual, lock,
+                                                     node.body)
+
+    def _blocking_in(self, ctx, qual, lock, body):
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in AsyncBlockRule.BLOCKING:
+                yield self.finding(
+                    ctx, node, qual, f"{lock}:{name}",
+                    f"blocking call {name}() while holding the "
+                    f"asyncio lock {lock} wedges the loop AND every "
+                    "waiter queued on the lock — run it in an "
+                    "executor")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "result" and not node.args:
+                yield self.finding(
+                    ctx, node, qual, f"{lock}:.result()",
+                    f".result() while holding the asyncio lock "
+                    f"{lock} blocks the loop with the lock held")
+
+
+class CutOrderingRule(Rule):
+    """CUT-ORDERING: watermark/record capture precedes any awaited state
+    export in the same function — the INVARIANTS "consistency cuts" law
+    (PR 11: a digest awaited BEFORE the replication watermark was read
+    described a cut no replica could ever converge to, because writes
+    landing during the await advanced the watermark past the digest).
+
+    Must-analysis over the CFG (analysis/flow.py cut_violations): an
+    awaited export (`export_batches`, `state_digest`, `key_count`, ...)
+    is flagged when some path reaches it with NO prior capture of
+    `last_uuid`/`landed_last_uuid`/`.records()`.  Functions that never
+    capture a watermark are not building a cut and stay out of scope."""
+
+    name = "CUT-ORDERING"
+    hint = ("capture the watermark/record cut into locals FIRST, then "
+            "await the derived exports (the PR 11 fix ordering: "
+            "watermarks first, digest after)")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "server", "replica", "persist", "bin")
+
+    def check(self, ctx: FileContext):
+        for qual, fn, is_async, _actx in ctx.functions:
+            if not is_async:
+                continue
+            for aw, term in flow.cut_violations(fn):
+                yield self.finding(
+                    ctx, aw, qual, term,
+                    f"awaited export {term}() is reachable before the "
+                    "watermark/record capture in this function — writes "
+                    "landing during the await advance the watermark "
+                    "past the exported state, describing a cut no "
+                    "replica can converge to")
+
+
 class NativeContractRule(Rule):
     """NATIVE-CONTRACT: the C intake stage's command table and the Python
     serve registries never drift apart.
@@ -629,9 +813,12 @@ class NativeContractRule(Rule):
     def __init__(self) -> None:
         self._table: tuple | None = None
         self._registry: set | None = None
+        self._aof_table: tuple | None = None
 
     def applies(self, ctx: FileContext) -> bool:
-        return ctx.basename == "commands.py" and _scoped(ctx, "server")
+        if ctx.basename == "commands.py" and _scoped(ctx, "server"):
+            return True
+        return ctx.basename == "oplog.py" and _scoped(ctx, "persist")
 
     def table(self) -> tuple:
         """(found, native, native_reads, python_only) from the marker
@@ -674,7 +861,87 @@ class NativeContractRule(Rule):
                 {k.decode() for k in C.SERVE_READS}
         return self._registry
 
+    def aof_table(self) -> tuple:
+        """(found, {record-name: int}) from the NATIVE-AOF-TABLE marker
+        block in native/aof.cpp (added in PR 17 — the disk-format twin
+        of the intake command table)."""
+        if self._aof_table is None:
+            import os
+            import re
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            path = os.path.join(root, "native", "aof.cpp")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                src = ""
+            m = re.search(r"NATIVE-AOF-TABLE-BEGIN(.*?)"
+                          r"NATIVE-AOF-TABLE-END", src, re.S)
+            types: dict[str, int] = {}
+            if m:
+                for line in m.group(1).splitlines():
+                    line = line.strip().lstrip("/").strip()
+                    if line.startswith("record-types:"):
+                        for pair in line[len("record-types:"):].split():
+                            name, _, val = pair.partition("=")
+                            if name and val.isdigit():
+                                types[name] = int(val)
+            self._aof_table = (m is not None, types)
+        return self._aof_table
+
+    @staticmethod
+    def _rec_constants(ctx: FileContext) -> dict[str, tuple[int, ast.AST]]:
+        """Module-level `REC_<NAME> = <int>` bindings of the checked
+        file, keyed by the lowercased record name."""
+        out: dict[str, tuple[int, ast.AST]] = {}
+        for node in ast.iter_child_nodes(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.startswith("REC_") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                name = node.targets[0].id[len("REC_"):].lower()
+                out[name] = (node.value.value, node)
+        return out
+
+    def _check_aof(self, ctx: FileContext):
+        found, types = self.aof_table()
+        if not found:
+            yield self.finding(
+                ctx, ctx.tree, "", "aof-table-missing",
+                "native/aof.cpp has no NATIVE-AOF-TABLE marker block — "
+                "the C record-type contract cannot be checked")
+            return
+        consts = self._rec_constants(ctx)
+        # direction 1: every Python record type the C table knows, with
+        # the same wire value
+        for name, (val, node) in sorted(consts.items()):
+            if name not in types:
+                yield self.finding(
+                    ctx, node, "", f"aof:{name}:missing-from-table",
+                    f"REC_{name.upper()}={val} has no entry in the "
+                    "native/aof.cpp record-type table — the C scanner's "
+                    "crc gate rejects the record as corruption")
+            elif types[name] != val:
+                yield self.finding(
+                    ctx, node, "", f"aof:{name}:drift",
+                    f"REC_{name.upper()}={val} but native/aof.cpp "
+                    f"declares {name}={types[name]} — the two sides "
+                    "would classify each other's records as corrupt")
+        # direction 2: every C record type has a Python twin
+        for name, val in sorted(types.items()):
+            if name not in consts:
+                yield self.finding(
+                    ctx, ctx.tree, "", f"aof:{name}:unknown-record-type",
+                    f"native/aof.cpp record type {name}={val} has no "
+                    f"REC_{name.upper()} constant here — the Python "
+                    "decoder cannot replay what the C scanner emits")
+
     def check(self, ctx: FileContext):
+        if ctx.basename == "oplog.py":
+            yield from self._check_aof(ctx)
+            return
         found, native, reads, pyonly = self.table()
         if not found:
             yield self.finding(
@@ -718,4 +985,7 @@ ALL_RULES: list[Rule] = [
     ForkCaptureRule(),
     KeyConfinedRule(),
     NativeContractRule(),
+    AwaitAtomicityRule(),
+    LockDisciplineRule(),
+    CutOrderingRule(),
 ]

@@ -652,6 +652,11 @@ class ReplicaLink:
         loop = asyncio.get_running_loop()
         try:
             synced = False  # peer_resume not yet honored
+            # lint: pin[cursor] — the send cursor is OWNED by this loop
+            # (docstring above: a local cursor confines every advance to
+            # this connection); every use re-validates against the live
+            # ring via can_resume_from/run_after, so the pre-await value
+            # is the intended one, not a stale shared read
             cursor = 0
             last_ack = 0.0
             while True:

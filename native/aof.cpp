@@ -39,6 +39,15 @@
 
 namespace aof {
 
+// NATIVE-AOF-TABLE-BEGIN (parsed by analysis/rules.py NATIVE-CONTRACT)
+//   record-types: batch=1 frame=2 wmark=3
+// NATIVE-AOF-TABLE-END
+//
+// The marker block above is the checkable contract with
+// persist/oplog.py's REC_* constants: the lint cross-checks both
+// directions (a REC_ constant the table doesn't know, a table entry
+// with no REC_ twin, or a value drift all fail), so the two decoders
+// can never silently classify each other's records as corruption.
 constexpr int kRecBatch = 1;
 constexpr int kRecFrame = 2;
 constexpr int kRecWmark = 3;

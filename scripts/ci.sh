@@ -13,22 +13,74 @@ echo "== lint (baseline mode) =="
 
 echo
 echo "== lint baseline ratchet =="
-# Retired debt must not silently regrow: the committed baseline's total
-# finding count may only go DOWN.  PR 4 retired the last 17 findings
-# (13 STAGE-PURE fold-stack builds, 4 ASYNC-BLOCK spill opens), so the
-# ratchet sits at zero — any future baselined finding needs this number
-# raised in review, on purpose.
+# Retired debt must not silently regrow.  PR 4 retired the last 17
+# per-node findings, so the per-node rules ratchet at ZERO: any
+# baselined finding from them fails here.  The flow-sensitive rules
+# (AWAIT-ATOMICITY / LOCK-DISCIPLINE / CUT-ORDERING) reason about
+# interleavings, so a deliberate, documented exception is a legitimate
+# outcome — for THOSE rules only, a baselined key is allowed iff it
+# carries a tracking note in baseline.json's notes map (a muted alarm
+# nobody can explain is still a failure).  The live tree is currently
+# clean either way; this gate is what keeps new debt honest.
 python - <<'EOF' || exit $?
 import json, sys
-MAX_BASELINED = 0
+FLOW_RULES = ("AWAIT-ATOMICITY", "LOCK-DISCIPLINE", "CUT-ORDERING")
 base = json.load(open("constdb_tpu/analysis/baseline.json"))
-total = sum(base.get("findings", {}).values())
-print(f"baselined findings: {total} (ratchet: {MAX_BASELINED})")
-if total > MAX_BASELINED:
-    print("ci.sh: baseline GREW past the ratchet — fix the findings or "
-          "raise MAX_BASELINED in scripts/ci.sh deliberately")
+findings = base.get("findings", {})
+notes = base.get("notes", {})
+bad = []
+for key in sorted(findings):
+    rule = key.split(":", 1)[0]
+    if rule not in FLOW_RULES:
+        bad.append(f"  {key}\n    per-node rules ratchet at zero — fix "
+                   f"the finding, do not baseline it")
+    elif not any(key.startswith(p) for p in notes):
+        bad.append(f"  {key}\n    baselined flow finding has no tracking "
+                   f"note (add one under notes in baseline.json)")
+flow = sum(v for k, v in findings.items()
+           if k.split(":", 1)[0] in FLOW_RULES)
+print(f"baselined findings: {sum(findings.values())} "
+      f"({flow} noted flow-rule, ratchet: 0 for all other rules)")
+if bad:
+    print("ci.sh: baseline violates the ratchet:")
+    print("\n".join(bad))
     sys.exit(1)
 EOF
+
+echo
+echo "== sanitizer fuzz gate (make -C native san + scripts/fuzz_native.py) =="
+# Memory-safety smoke for the four untrusted-byte C scanners
+# (resp_parse, intake_scan, wire blob pack/unpack, aof_scan): rebuild
+# the extension under ASan+UBSan (native/build/san/, never installed
+# into the package) and replay the tier-1 fuzz corpora plus seeded
+# mutations through it — any sanitizer report aborts the driver
+# non-zero.  The sanitized .so links its runtimes dynamically, so the
+# gate needs the toolchain's libasan/libubsan; where they are missing
+# the stage SKIPS LOUDLY rather than pretending the check ran.
+SAN_LIBS=""
+if command -v g++ >/dev/null 2>&1; then
+    for lib in libasan.so libubsan.so; do
+        p="$(g++ -print-file-name=$lib 2>/dev/null)"
+        [ -n "$p" ] && [ "$p" != "$lib" ] && [ -e "$p" ] && \
+            SAN_LIBS="$SAN_LIBS $p"
+    done
+fi
+if [ "$(echo $SAN_LIBS | wc -w)" -ne 2 ]; then
+    echo "ci.sh: SKIPPING sanitizer fuzz gate — this toolchain lacks the"
+    echo "       dynamic ASan/UBSan runtimes (found:${SAN_LIBS:- none})."
+    echo "       The untrusted-byte scanners are NOT memory-checked on"
+    echo "       this builder; run ci.sh where g++ ships libasan+libubsan."
+else
+    make -s -C native san || exit $?
+    LD_PRELOAD="${SAN_LIBS# }" ASAN_OPTIONS=detect_leaks=0 \
+    JAX_PLATFORMS=cpu timeout -k 10 420 python scripts/fuzz_native.py || {
+        echo "ci.sh: sanitizer fuzz gate FAILED — ASan/UBSan report (or"
+        echo "       driver error) replaying the scanner corpora; rerun"
+        echo "       scripts/fuzz_native.py under the LD_PRELOAD above to"
+        echo "       reproduce deterministically"
+        exit 1
+    }
+fi
 
 echo
 echo "== native intake smoke (make -C native + bench --mode intake) =="

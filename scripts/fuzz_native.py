@@ -1,0 +1,359 @@
+#!/usr/bin/env python
+"""Deterministic fuzz replay for the four untrusted-byte C scanners.
+
+The native extension parses bytes that arrive from outside the trust
+boundary — client sockets (resp_parse, intake_scan), peer replication
+streams (wire_unpack_blobs) and on-disk op-log segments (aof_scan).  A
+memory-safety bug in any of them is a remote crash primitive, and the
+regular test suite runs them under a non-instrumented build where an
+out-of-bounds read is usually silent.
+
+This driver loads a SANITIZED build of the same single-TU extension
+(`make -C native san` -> native/build/san/cst_ext.so, ASan+UBSan,
+never copied into the package) by explicit path and replays:
+
+  * the existing fuzz corpora — the same generators tier-1 uses
+    (tests/test_resp_fuzz.py: rand_msg / rand_command, the malformed
+    and absurd-header fixed cases), re-encoded with fixed seeds;
+  * seeded mutations of every corpus buffer — bit flips, truncations,
+    splices, inserts and deletes — so framing arithmetic sees torn and
+    hostile inputs, not just well-formed ones;
+  * structural edge cases per scanner (every prefix of a small wire,
+    wrong counts/positions for the blob codec, torn + bit-flipped
+    op-log segments in both raw and frame-decoding modes).
+
+Python-level exceptions are FINE (that is the reject path under test);
+the failure signal is the sanitizer itself — any ASan/UBSan report
+aborts the process non-zero, which is what scripts/ci.sh gates on.
+
+Run under the sanitizer runtime (the .so links it dynamically):
+
+    LD_PRELOAD="$(g++ -print-file-name=libasan.so) \\
+                $(g++ -print-file-name=libubsan.so)" \\
+    ASAN_OPTIONS=detect_leaks=0 python scripts/fuzz_native.py
+
+Deterministic by construction: fixed --seed, and no wall-clock or pid
+inputs — a failing run replays exactly.
+"""
+
+import argparse
+import importlib.util
+import os
+import random
+import sys
+import zlib
+
+# The production extension must never load in this process: the package
+# is imported only for message classes / encoders, and every native
+# tier declines under CONSTDB_NO_NATIVE, so the sanitized module passed
+# by path is the ONLY native code exercised.
+os.environ["CONSTDB_NO_NATIVE"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from constdb_tpu.resp.codec import encode_into  # noqa: E402
+from constdb_tpu.resp.message import (NIL, Arr, Bulk, Err, Int,  # noqa: E402
+                                      Simple)
+
+CLASSES = (Arr, Bulk, Int, Simple, Err, NIL)
+MAX_BULK = 512 * 1024 * 1024
+
+
+def load_sanitized_ext(path: str):
+    spec = importlib.util.spec_from_file_location("cst_ext", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_fuzz_generators():
+    """rand_msg / rand_command from tests/test_resp_fuzz.py — the
+    corpora ARE the tier-1 generators, imported by path so this driver
+    replays exactly what the differential suites feed."""
+    path = os.path.join(REPO, "tests", "test_resp_fuzz.py")
+    spec = importlib.util.spec_from_file_location("_resp_fuzz", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.rand_msg, mod.rand_command
+
+
+# Fixed malformed / absurd-header cases (mirrors the tier-1 parametrized
+# cases plus raw-garbage frames the generators cannot emit).
+FIXED_CASES = (
+    b"",
+    b"\r\n",
+    b"$-1\r\n",
+    b"$0\r\n\r\n",
+    b"$5\r\nab",                        # torn bulk
+    b"$99999999999\r\n",                # absurd bulk: 93GB declared
+    b"$536870913\r\n",                  # one past the 512MB hard ceiling
+    b"*1\r\n$99999999999\r\n",          # absurd bulk inside an array
+    b"*99999999\r\n",                   # absurd array header
+    b"*-1\r\n",
+    b"*1\r\n*1\r\n*1\r\n*1\r\n:1\r\n",  # deep nesting
+    b":99999999999999999999999999\r\n",
+    b":-\r\n:+\r\n::\r\n",
+    b"+ok\r-err\n$\r\n",
+    b"\x00" * 64,
+    b"*" * 64,
+    b"$" * 64 + b"\r\n",
+    b"*3\r\n$3\r\nset\r\n$1\r\nk\r\n$1",  # torn command tail
+)
+
+
+def mutate(rng: random.Random, buf: bytes, n: int):
+    """n seeded mutants of buf: bit flips, truncations, splices,
+    inserts, deletes — every mutant deterministic from rng state."""
+    out = []
+    for _ in range(n):
+        b = bytearray(buf)
+        op = rng.randrange(5)
+        if not b:
+            op = 3
+        if op == 0:                       # bit flip(s)
+            for _ in range(rng.randrange(1, 4)):
+                i = rng.randrange(len(b))
+                b[i] ^= 1 << rng.randrange(8)
+        elif op == 1:                     # truncate
+            b = b[:rng.randrange(len(b))]
+        elif op == 2:                     # splice a slice over another
+            i, j = sorted(rng.randrange(len(b) + 1) for _ in range(2))
+            k = rng.randrange(len(b) + 1)
+            b = b[:i] + b[k:k + (j - i)] + b[j:]
+        elif op == 3:                     # insert noise
+            i = rng.randrange(len(b) + 1)
+            noise = bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(1, 9)))
+            b = b[:i] + noise + b[i:]
+        else:                             # delete a run
+            i = rng.randrange(len(b))
+            b = b[:i] + b[i + rng.randrange(1, 9):]
+        out.append(bytes(b))
+    return out
+
+
+class Driver:
+    def __init__(self, ext, seed: int, rounds: int):
+        self.ext = ext
+        self.seed = seed
+        self.rounds = rounds
+        self.calls = {}
+
+    def _call(self, name, fn, *args):
+        self.calls[name] = self.calls.get(name, 0) + 1
+        try:
+            return fn(*args)
+        except Exception:
+            return None  # reject path — only sanitizer reports fail
+
+    # ------------------------------------------------------ resp_parse
+
+    def run_resp(self, rand_msg):
+        rng = random.Random(self.seed)
+        parse = getattr(self.ext, "resp_parse", None)
+        if parse is None:
+            raise SystemExit("sanitized ext lacks resp_parse")
+
+        def drive(buf: bytes):
+            self._call("resp_parse", parse, buf, 0, *CLASSES, 1024,
+                       MAX_BULK)
+            # partial-frame handling: a random prefix, and a resume
+            # from a random interior position
+            if buf:
+                self._call("resp_parse", parse, buf[:rng.randrange(len(buf))],
+                           0, *CLASSES, 1024, MAX_BULK)
+                self._call("resp_parse", parse, bytearray(buf),
+                           rng.randrange(len(buf)), *CLASSES, 1024,
+                           MAX_BULK)
+
+        for case in FIXED_CASES:
+            drive(case)
+            for m in mutate(rng, case, 4):
+                drive(m)
+        # every prefix of one small composite wire — off-by-one framing
+        # arithmetic lives at prefix boundaries
+        wire = bytearray()
+        for _ in range(6):
+            encode_into(wire, rand_msg(rng))
+        for k in range(len(wire) + 1):
+            self._call("resp_parse", parse, bytes(wire[:k]), 0, *CLASSES,
+                       1024, MAX_BULK)
+        for _ in range(self.rounds):
+            wire = bytearray()
+            for _ in range(rng.randrange(1, 8)):
+                encode_into(wire, rand_msg(rng))
+            wire = bytes(wire)
+            drive(wire)
+            for m in mutate(rng, wire, 6):
+                drive(m)
+
+    # ----------------------------------------------------- intake_scan
+
+    def run_intake(self, rand_command):
+        rng = random.Random(self.seed + 1)
+        scan = getattr(self.ext, "intake_scan", None)
+        if scan is None:
+            raise SystemExit("sanitized ext lacks intake_scan")
+
+        def drive(buf: bytes):
+            self._call("intake_scan", scan, buf, 0, *CLASSES, MAX_BULK)
+            if buf:
+                self._call("intake_scan", scan, bytearray(buf),
+                           rng.randrange(len(buf)), *CLASSES, MAX_BULK)
+
+        for case in FIXED_CASES:
+            drive(case)
+        for _ in range(self.rounds):
+            wire = bytearray()
+            for _ in range(rng.randrange(1, 10)):
+                encode_into(wire, rand_command(rng))
+            wire = bytes(wire)
+            drive(wire)
+            for m in mutate(rng, wire, 6):
+                drive(m)
+
+    # ------------------------------------------------- wire blob codec
+
+    def run_wire(self):
+        rng = random.Random(self.seed + 2)
+        pack = getattr(self.ext, "wire_pack_blobs", None)
+        unpack = getattr(self.ext, "wire_unpack_blobs", None)
+        if pack is None or unpack is None:
+            raise SystemExit("sanitized ext lacks wire blob codec")
+        for _ in range(self.rounds * 2):
+            n = rng.randrange(0, 24)
+            items = []
+            for _ in range(n):
+                r = rng.random()
+                if r < 0.15:
+                    items.append(None)
+                elif r < 0.25:      # decline-path shapes (non-bytes)
+                    items.append(rng.choice(("s", 7, b"x" * 70000)))
+                else:
+                    items.append(bytes(rng.randrange(256) for _ in
+                                       range(rng.randrange(0, 300))))
+            out = bytearray()
+            ok = self._call("wire_pack_blobs", pack, out, items)
+            if ok:
+                # round-trip, then hostile re-reads of the same bytes:
+                # wrong count, interior position, mutated framing
+                packed = bytes(out)
+                self._call("wire_unpack_blobs", unpack, packed, 0, n)
+                self._call("wire_unpack_blobs", unpack, packed, 0, n + 3)
+                self._call("wire_unpack_blobs", unpack, packed,
+                           rng.randrange(len(packed) + 1), n)
+                for m in mutate(rng, packed, 4):
+                    self._call("wire_unpack_blobs", unpack, m, 0, n)
+            # raw garbage with arbitrary declared counts
+            junk = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 64)))
+            self._call("wire_unpack_blobs", unpack, junk, 0,
+                       rng.randrange(0, 1 << 16))
+
+    # -------------------------------------------------------- aof_scan
+
+    def run_aof(self):
+        rng = random.Random(self.seed + 3)
+        scan = getattr(self.ext, "aof_scan", None)
+        if scan is None:
+            raise SystemExit("sanitized ext lacks aof_scan")
+        from constdb_tpu.persist.oplog import (_MAX_RECORD, MAGIC,
+                                               REC_BATCH, REC_FRAME,
+                                               REC_WMARK, OpLog,
+                                               _pack_record)
+        from constdb_tpu.utils.varint import write_uvarint
+
+        def segment():
+            seg = bytearray(MAGIC)
+            for _ in range(rng.randrange(1, 10)):
+                kind = rng.randrange(4)
+                if kind == 0:
+                    seg += _pack_record(REC_FRAME, OpLog._frame_payload(
+                        rng.randrange(16), rng.randrange(1 << 20),
+                        b"set",
+                        [Bulk(b"k%d" % rng.randrange(64)),
+                         Bulk(bytes(rng.randrange(256) for _ in
+                                    range(rng.randrange(0, 24))))]))
+                elif kind == 1:
+                    payload = bytearray()
+                    for v in (rng.randrange(16), rng.randrange(1 << 20),
+                              rng.randrange(1 << 20), rng.randrange(64)):
+                        write_uvarint(payload, v)
+                    payload += bytes(rng.randrange(256) for _ in
+                                     range(rng.randrange(0, 120)))
+                    seg += _pack_record(REC_BATCH, bytes(payload))
+                elif kind == 2:
+                    payload = bytearray()
+                    write_uvarint(payload, rng.randrange(1 << 20))
+                    payload += bytes(rng.randrange(256) for _ in
+                                     range(rng.randrange(0, 40)))
+                    seg += _pack_record(REC_WMARK, bytes(payload))
+                else:  # unknown rtype — must end the valid prefix
+                    seg += _pack_record(rng.randrange(4, 256),
+                                        b"\x00" * rng.randrange(0, 16))
+            return bytes(seg)
+
+        def drive(data: bytes):
+            # raw walk, frame-decoding walk, and the raw-args flag
+            self._call("aof_scan", scan, data, len(MAGIC), _MAX_RECORD)
+            self._call("aof_scan", scan, data, len(MAGIC), _MAX_RECORD,
+                       *CLASSES)
+            self._call("aof_scan", scan, data, len(MAGIC), _MAX_RECORD,
+                       *CLASSES, 1)
+
+        for _ in range(self.rounds):
+            seg = segment()
+            drive(seg)
+            for k in (len(seg) - 1, len(seg) - 5,
+                      rng.randrange(len(seg) + 1)):
+                drive(seg[:max(0, k)])            # torn tails
+            for m in mutate(rng, seg, 6):
+                drive(m)
+            # crc-valid body with a hostile declared length
+            body = b"\x01" + b"z" * 8
+            evil = (bytearray(MAGIC)
+                    + (1 << 31).to_bytes(4, "little")
+                    + zlib.crc32(body).to_bytes(4, "little") + body)
+            drive(bytes(evil))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay fuzz corpora against the sanitized extension")
+    ap.add_argument("--ext", default=os.path.join(
+        REPO, "native", "build", "san", "cst_ext.so"))
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--rounds", type=int, default=40,
+                    help="random corpus buffers per scanner")
+    ns = ap.parse_args(argv)
+
+    if not os.path.exists(ns.ext):
+        print(f"fuzz_native: sanitized extension not built: {ns.ext} "
+              f"(run `make -C native san`)", file=sys.stderr)
+        return 2
+    try:
+        ext = load_sanitized_ext(ns.ext)
+    except ImportError as e:
+        print(f"fuzz_native: cannot load {ns.ext}: {e}\n"
+              f"hint: the sanitized .so links ASan/UBSan dynamically — "
+              f"run under LD_PRELOAD=\"$(g++ -print-file-name=libasan.so)"
+              f" $(g++ -print-file-name=libubsan.so)\"", file=sys.stderr)
+        return 2
+
+    rand_msg, rand_command = load_fuzz_generators()
+    drv = Driver(ext, ns.seed, ns.rounds)
+    drv.run_resp(rand_msg)
+    drv.run_intake(rand_command)
+    drv.run_wire()
+    drv.run_aof()
+    total = sum(drv.calls.values())
+    per = ", ".join(f"{k}={v}" for k, v in sorted(drv.calls.items()))
+    print(f"fuzz_native: {total} scanner calls clean under ASan+UBSan "
+          f"(seed {ns.seed}: {per})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,11 +81,37 @@ def test_corpus_expectations(corpus_findings):
     kc = by["KEY-CONFINED"]
     assert {f.token for f in kc} == {"badswap", "nokey"}
     assert not any("good" in f.qualname for f in kc)
-    # NATIVE-CONTRACT: the uncovered @serve_plan command only — the
-    # covered twin (sadd) and the table's own entries stay silent
+    # NATIVE-CONTRACT: the uncovered @serve_plan command (intake
+    # direction) + every aof record-type failure mode (drift, python-
+    # only type, C-only type); the covered twin (sadd) and the matching
+    # REC_BATCH stay silent
     nc = by["NATIVE-CONTRACT"]
-    assert [f.token for f in nc] == ["zadd"]
-    assert nc[0].qualname == "_plan_zadd"
+    assert {f.token for f in nc} == \
+        {"zadd", "aof:frame:drift", "aof:chunk:missing-from-table",
+         "aof:wmark:unknown-record-type"}
+    assert [f.qualname for f in nc if f.token == "zadd"] == ["_plan_zadd"]
+    # AWAIT-ATOMICITY: the PR 2 close-window and PR 12 quiesce-callback
+    # race shapes; the post-fix re-reading forms and the pinned
+    # deliberate snapshot stay silent
+    aa = by["AWAIT-ATOMICITY"]
+    assert {f.token for f in aa} == {"links", "pend"}
+    assert {f.qualname.rsplit(".", 1)[-1] for f in aa} == \
+        {"close_bad", "quiesce_bad"}
+    # CUT-ORDERING: the PR 11 consistency-cut shape (export awaited
+    # before the watermark capture), incl. the some-path branchy case;
+    # the capture-first forms stay silent
+    co = by["CUT-ORDERING"]
+    assert {f.token for f in co} == {"_local_digest", "key_count"}
+    assert {f.qualname.rsplit(".", 1)[-1] for f in co} == \
+        {"send_delta_bad", "export_branchy_bad"}
+    # LOCK-DISCIPLINE: await under a thread lock + blocking IO /
+    # .result() under an asyncio lock; the snapshot-then-release and
+    # run_in_executor forms stay silent
+    ld = by["LOCK-DISCIPLINE"]
+    assert {f.token for f in ld} == \
+        {"self._crc_lock", "self._stream_lock:open",
+         "self._stream_lock:.result()"}
+    assert not any("fixed" in f.qualname for f in ld)
 
 
 def test_findings_have_location_and_hint(corpus_findings):
@@ -155,6 +181,26 @@ def test_cli_plain_mode_reports(capsys):
     rc = lint_main([CORPUS, "--root", CORPUS])
     out = capsys.readouterr().out
     assert rc == 1 and "finding(s)" in out
+
+
+def test_cli_json_mode(capsys, corpus_findings):
+    """--json: stable keys matching baseline.json, both modes."""
+    import json
+    rc = lint_main([CORPUS, "--root", CORPUS, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["version"] == 1
+    assert len(payload["findings"]) == len(corpus_findings)
+    # the counts map IS the baseline.json findings shape
+    from constdb_tpu.analysis.core import baseline_payload
+    assert payload["counts"] == \
+        baseline_payload(corpus_findings, {})["findings"]
+    for f in payload["findings"]:
+        assert f["key"] == \
+            f"{f['rule']}:{f['path']}:{f['qualname']}:{f['token']}"
+    # baseline mode: growth/stale keys in the payload, clean -> rc 0
+    rc = lint_main(["--baseline", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["baseline"]["growth"] == []
 
 
 # ----------------------------------------------------------- env registry

@@ -1,0 +1,196 @@
+"""The flow-sensitive half of the lint engine (analysis/cfg.py +
+analysis/flow.py): CFG shape, staleness dataflow, pin/value-usage
+semantics, and the cut-ordering must-analysis — unit-level, so rule
+regressions point at the engine layer, not just a corpus diff."""
+
+import ast
+import textwrap
+
+from constdb_tpu.analysis import flow
+from constdb_tpu.analysis.cfg import awaits_in, build_cfg
+
+
+def _fn(src: str) -> ast.AST:
+    tree = ast.parse(textwrap.dedent(src))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.AsyncFunctionDef, ast.FunctionDef)):
+            return node
+    raise AssertionError("no function in snippet")
+
+
+def _flow(src: str, pins=None) -> flow.FunctionFlow:
+    return flow.FunctionFlow(_fn(src), pins)
+
+
+# ------------------------------------------------------------------ cfg
+
+def test_cfg_straight_line_and_branches():
+    fn = _fn("""
+    async def f(self, x):
+        a = 1
+        if x:
+            b = 2
+        else:
+            b = 3
+        while b:
+            b -= 1
+        return b
+    """)
+    cfg = build_cfg(fn)
+    order = cfg.rpo()
+    assert order[0] is cfg.entry
+    # every non-exit block reaches the exit
+    reach = {cfg.exit.bid}
+    for blk in reversed(order):
+        if any(s in reach for s in blk.succs):
+            reach.add(blk.bid)
+    assert cfg.entry.bid in reach
+
+
+def test_cfg_await_points_found():
+    fn = _fn("""
+    async def f(self):
+        await self.a()
+        async with self.lk:
+            pass
+        async for x in self.it:
+            await self.b(x)
+    """)
+    assert len(awaits_in(fn)) == 2  # explicit awaits; async-with/for
+    #                                 are handled as header effects
+
+
+def test_cfg_nested_defs_opaque():
+    fn = _fn("""
+    async def f(self):
+        def g():
+            return self._links
+        await self.h(g)
+    """)
+    assert len(awaits_in(fn)) == 1
+
+
+# ----------------------------------------------------------- staleness
+
+def test_snapshot_goes_stale_across_await():
+    fa = _flow("""
+    async def f(self):
+        links = list(self._links)
+        await self.close()
+        if links:
+            self._links.clear()
+    """)
+    test_envs = [env for env in fa.env_at.values() if "links" in env]
+    assert test_envs, "snapshot local never tracked"
+    final = max(test_envs, key=lambda e: e["links"].stale)
+    st = final["links"]
+    assert st.sources == frozenset({"self._links"})
+    assert st.stale and st.stale_line > st.line
+
+
+def test_rebind_after_await_clears_staleness():
+    fa = _flow("""
+    async def f(self):
+        links = list(self._links)
+        await self.close()
+        links = list(self._links)
+        if links:
+            self._links.clear()
+    """)
+    fn = fa.fn
+    guard = [n for n in ast.walk(fn) if isinstance(n, ast.If)][0]
+    st = fa.env_at[id(guard.test)]["links"]
+    assert not st.stale
+
+
+def test_pin_is_function_scoped():
+    src = """
+    async def f(self):
+        doomed = list(self._links)  # lint: pin[doomed]
+        await self.close()
+        doomed = list(self._links)
+        if doomed:
+            self._links.clear()
+    """
+    pins = flow.pins_by_line(textwrap.dedent(src))
+    fa = _flow(src, pins)
+    assert all("doomed" not in env or not env["doomed"].sources
+               for env in fa.env_at.values())
+
+
+def test_loop_back_edge_joins_staleness():
+    fa = _flow("""
+    async def f(self):
+        snap = dict(self._warm)
+        while True:
+            if snap:
+                self._warm.clear()
+            await self.tick()
+    """)
+    fn = fa.fn
+    guard = [n for n in ast.walk(fn) if isinstance(n, ast.If)][0]
+    st = fa.env_at[id(guard.test)]["snap"]
+    # first iteration: fresh; via the back edge: stale — the join must
+    # keep the MAY-stale fact
+    assert st.stale
+
+
+def test_value_used_names_exemptions():
+    names = flow.value_used_names(ast.parse(
+        "meta.needs_full or coal is None or cursor > 0",
+        mode="eval").body)
+    assert names == {"cursor"}  # deref base + is-None test are exempt
+
+
+# -------------------------------------------------------- cut ordering
+
+def test_cut_violation_and_fix():
+    bad = _fn("""
+    async def f(self):
+        d = await self._local_digest(self.node)
+        last = self.node.repl_log.last_uuid
+        return d, last
+    """)
+    got = flow.cut_violations(bad)
+    assert [term for _aw, term in got] == ["_local_digest"]
+
+    fixed = _fn("""
+    async def f(self):
+        last = self.node.repl_log.last_uuid
+        d = await self._local_digest(self.node)
+        return d, last
+    """)
+    assert flow.cut_violations(fixed) == []
+
+
+def test_cut_requires_both_halves():
+    no_capture = _fn("""
+    async def f(self):
+        return await self.node.serve_plane.key_count()
+    """)
+    assert flow.cut_violations(no_capture) == []
+    no_export = _fn("""
+    async def f(self):
+        last = self.node.repl_log.last_uuid
+        await self.flush()
+        return last
+    """)
+    assert flow.cut_violations(no_export) == []
+
+
+def test_cut_some_path_semantics():
+    branchy = _fn("""
+    async def f(self):
+        if self.app.fast:
+            last = self.node.repl_log.last_uuid
+        return await self.node.serve_plane.key_count()
+    """)
+    assert [t for _a, t in flow.cut_violations(branchy)] == ["key_count"]
+    dominated = _fn("""
+    async def f(self):
+        last = self.node.repl_log.last_uuid
+        if self.app.fast:
+            return await self.node.serve_plane.key_count()
+        return last
+    """)
+    assert flow.cut_violations(dominated) == []
