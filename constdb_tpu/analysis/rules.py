@@ -670,6 +670,53 @@ class AwaitAtomicityRule(Rule):
                 "view (the close-window / quiesce-callback race shape)")
 
 
+class SlotEpochRule(AwaitAtomicityRule):
+    """SLOT-EPOCH: AWAIT-ATOMICITY specialized to the slot table.
+
+    Slot ownership is epoch-versioned and every migration await is an
+    ownership-flap window: the peer can FINALIZE, gossip a newer table,
+    or the local node can adopt one over CLUSTERTAB while a coroutine
+    sleeps.  A local derived from ``*.cluster`` / slot-table state that
+    goes stale across an await must therefore not guard a mutation —
+    the handler has to re-read ``cl.epoch`` (or compare against the
+    live table) after the await before it flips ownership, pops a
+    migrating/importing entry, or adopts a watermark.  Same dataflow
+    engine as AWAIT-ATOMICITY; this rule narrows the sources to the
+    cluster plane and extends coverage to ``cluster/``, which the
+    general rule deliberately leaves to this specialization."""
+
+    name = "SLOT-EPOCH"
+    hint = ("re-validate the slot-table epoch after the await "
+            "(compare cl.epoch, not a pre-await copy) before mutating "
+            "ownership; a deliberate pre-handoff snapshot is declared "
+            "with # lint: pin[name] on the capture line")
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _scoped(ctx, "cluster", "server", "replica")
+
+    def _guard(self, ctx, qual, node, expr, env, suites, where):
+        muts = None
+        for nm in sorted(flow.value_used_names(expr)):
+            st = env.get(nm)
+            if st is None or not st.sources or not st.stale:
+                continue
+            if not any("cluster" in s for s in st.sources):
+                continue
+            if muts is None:
+                muts = flow.shared_mutations(suites, env)
+            if not muts:
+                return
+            src = ", ".join(sorted(st.sources)[:2])
+            mut_what = muts[0][1]
+            yield self.finding(
+                ctx, node, qual, nm,
+                f"local {nm!r} caches slot-table state ({src}, line "
+                f"{st.line}) across the await at line {st.stale_line} "
+                f"and guards a mutation of {mut_what} — a FINALIZE or "
+                "CLUSTERTAB adoption interleaving there bumps the epoch "
+                "and invalidates the cached ownership view")
+
+
 class LockDisciplineRule(Rule):
     """LOCK-DISCIPLINE: lock windows and the event loop don't mix.
 
@@ -809,6 +856,32 @@ class NativeContractRule(Rule):
             "drop the stale table entry")
 
     DECOS = {"serve_plan", "serve_read"}
+
+    @staticmethod
+    def _register_info(deco: ast.AST):
+        """(name, is_ctrl, keyless) for an ``@register("x", FLAGS,
+        families=...)`` decorator, else None.  is_ctrl: the flags
+        expression names CMD_CTRL.  keyless: families is declared an
+        EMPTY tuple/list (default = all families = first-key-confined,
+        so only an explicit () opts a command out of key routing)."""
+        if not (isinstance(deco, ast.Call)
+                and isinstance(deco.func, ast.Name)
+                and deco.func.id == "register"
+                and deco.args
+                and isinstance(deco.args[0], ast.Constant)
+                and isinstance(deco.args[0].value, str)):
+            return None
+        is_ctrl = any(isinstance(n, ast.Name) and n.id == "CMD_CTRL"
+                      for a in deco.args[1:]
+                      for n in ast.walk(a))
+        fam = None
+        if len(deco.args) > 2:
+            fam = deco.args[2]
+        for kw in deco.keywords:
+            if kw.arg == "families":
+                fam = kw.value
+        keyless = isinstance(fam, (ast.Tuple, ast.List)) and not fam.elts
+        return deco.args[0].value, is_ctrl, keyless
 
     def __init__(self) -> None:
         self._table: tuple | None = None
@@ -973,6 +1046,32 @@ class NativeContractRule(Rule):
                     "runtime planner/encoder/read-spec is registered "
                     "under that name — the C scanner would emit an "
                     "opcode serve.py cannot plan")
+        # direction 3 (cluster): every native-table command must be
+        # slot-routable.  The router keys off the first argument
+        # (shard_routable: not CMD_CTRL, non-empty families), and the
+        # native fast path trusts that the redirect demotion in
+        # serve.py can always extract that key from the scanned
+        # payload.  A native/native-reads entry registered CMD_CTRL or
+        # with families=() would take the C fast path yet be invisible
+        # to the router — in cluster mode the two planes disagree on
+        # where the command runs.
+        for qual, fn, _a, _c in ctx.functions:
+            for deco in getattr(fn, "decorator_list", ()):
+                info = self._register_info(deco)
+                if info is None:
+                    continue
+                nm, is_ctrl, keyless = info
+                if nm in (native | reads) and (is_ctrl or keyless):
+                    why = "CMD_CTRL" if is_ctrl else "families=()"
+                    yield self.finding(
+                        ctx, deco, qual, f"{nm}:unroutable",
+                        f"command {nm!r} is in the native/intake.cpp "
+                        f"fast-path table but registered {why} — the "
+                        "slot router (cluster/slots.py) skips it while "
+                        "the C scanner still classifies it, so cluster "
+                        "mode would execute it on a non-owner (move it "
+                        "to python-only:, or make it first-key-"
+                        "confined)")
 
 
 ALL_RULES: list[Rule] = [
@@ -986,6 +1085,7 @@ ALL_RULES: list[Rule] = [
     KeyConfinedRule(),
     NativeContractRule(),
     AwaitAtomicityRule(),
+    SlotEpochRule(),
     LockDisciplineRule(),
     CutOrderingRule(),
 ]

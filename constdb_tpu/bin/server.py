@@ -139,6 +139,7 @@ async def amain(cfg: Config) -> None:
         aof_rewrite_pct=cfg.aof_rewrite_pct
         if cfg.aof_rewrite_pct >= 0 else None,
         aof_dir=cfg.aof_dir,
+        cluster_group=cfg.cluster_group,
         restore_to=cfg.restore_to)
     log.info("constdb-tpu node %d (engine=%s) serving on %s",
              node.node_id, node.engine.name, app.advertised_addr)

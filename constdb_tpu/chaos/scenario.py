@@ -55,6 +55,12 @@ class Cell:
     #                         fault-inject each rewrite interleaving
     #                         (generation switch / snapshot / meta
     #                         commit), kill -9, certify the replay
+    cluster: str = ""       # cluster-mode cell name (round 21): when
+    #                         set, the cell runs a hash-slot migration
+    #                         scenario (cluster_cells.CLUSTER_CELLS)
+    #                         instead of the replication matrix — two
+    #                         slot groups, no inter-group repl links,
+    #                         the other knobs above do not apply
 
     @property
     def name(self) -> str:
@@ -62,7 +68,8 @@ class Cell:
                 f"-comp{int(self.compress)}"
                 f"-shards{self.shards}-{self.engine}"
                 + (f"-aof-{self.aof}" if self.aof else "")
-                + ("-ckpt" if self.ckpt else ""))
+                + ("-ckpt" if self.ckpt else "")
+                + (f"-cluster-{self.cluster}" if self.cluster else ""))
 
     def specs(self, n: int = 3, mixed_idx: Optional[int] = None
               ) -> list[NodeSpec]:
@@ -129,6 +136,10 @@ def matrix_cells() -> list[Cell]:
     # crash-mid-checkpoint (round 20): the incremental-checkpoint cut
     # must be idempotent at every fault interleaving
     cells.append(Cell(aof="always", ckpt=True))
+    # cluster mode (round 21): slot migration under partition, the
+    # ownership flap, and deletes landing mid-move (cluster_cells.py)
+    from .cluster_cells import CLUSTER_CELLS
+    cells.extend(Cell(cluster=c) for c in CLUSTER_CELLS)
     return cells
 
 
@@ -140,7 +151,8 @@ def smoke_cells() -> list[Cell]:
     plane."""
     return [Cell(), Cell(wire=False, delta=False, compress=False),
             Cell(engine="xla-resident"), Cell(shards=2, wire=False),
-            Cell(aof="always", ckpt=True), Cell(aof="everysec")]
+            Cell(aof="always", ckpt=True), Cell(aof="everysec"),
+            Cell(cluster="migrate-partition")]
 
 
 @dataclass
@@ -676,6 +688,10 @@ def _check_probes(sc: Scenario, cluster, wl: _Workload, canon: dict,
 def run_scenario(sc: Scenario) -> dict:
     """Run one scenario to completion (sync wrapper; prints nothing —
     every failure message carries `[chaos seed=N …]`)."""
+    if sc.cell.cluster:
+        from .cluster_cells import run_cluster_cell
+        return run_cluster_cell(sc.cell.cluster, sc.seed,
+                                ops=sc.ops_per_burst)
     return asyncio.run(_run_scenario_async(sc))
 
 

@@ -1,0 +1,27 @@
+"""Cluster mode: hash-slot keyspace partitioning across replication
+groups (ROADMAP item 1 — beyond one box).
+
+The keyspace is split into NSLOTS=16384 hash slots by the SAME crc32
+the digest plane partitions on (store/digest.py), so with the canonical
+64x256 geometry every slot IS one digest bucket: per-slot digests and
+per-slot ColumnarBatch exports come free from the PR 7 machinery, and a
+migrating slot is just a replica that catches up by delta then flips
+ownership at an epoch bump (docs/INVARIANTS.md "Slot ownership laws").
+
+Layout:
+  * slots.py     — slot math, the epoch-versioned SlotTable, ClusterState
+                   (routing: None | MOVED | ASK), CLUSTERTAB codec
+  * migrate.py   — live slot migration driver (source side) riding the
+                   digest->delta path over the command plane
+  * commands.py  — the CLUSTER admin/migration command family
+
+Disabled (CONSTDB_CLUSTER=0, the default) the subsystem does not exist:
+node.cluster stays None, no capability bit is advertised, and every
+wire byte is exactly the pre-cluster single-group stream (pinned by
+tests/test_cluster.py)."""
+
+from .slots import (NSLOTS, SLOT_FANOUT, SLOT_LEAVES, ClusterState,
+                    SlotTable, bucket_of_slot, even_split, slot_of)
+
+__all__ = ["NSLOTS", "SLOT_FANOUT", "SLOT_LEAVES", "ClusterState",
+           "SlotTable", "bucket_of_slot", "even_split", "slot_of"]

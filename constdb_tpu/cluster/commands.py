@@ -1,0 +1,144 @@
+"""The CLUSTER admin + migration command family.
+
+Registered against the ONE dispatch table (server/commands.py) like the
+membership commands (replica/commands.py): flags CTRL (takes
+subcommands, not keys — shard_routable() and the slot router both skip
+it) + WRITE (the import/finalize arms mutate state) + NO_REPLICATE (a
+migration intake is STATE transfer, not an op — re-replicating it would
+re-broadcast a foreign group's keys into ours, exactly what cluster
+mode removes; and merges never adopt watermarks, preserving the
+emit-only-durable law across the move).
+
+Observability arms (INFO / SLOTS / SLOTDIGEST) answer on any node;
+mutation arms require cluster mode on.  The migration wire protocol
+(SETSLOT IMPORTING -> IMPORT chunks -> SLOTDIGEST -> FINALIZE) is
+driven by cluster/migrate.py on the source."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..errors import CstError, UnknownSubCmd
+from ..resp.message import Arr, Bulk, Err, Int, OK
+from ..server.commands import (CMD_CTRL, CMD_NO_REPLICATE, CMD_WRITE,
+                               register)
+from .slots import NSLOTS
+
+log = logging.getLogger(__name__)
+
+_OFF_ERR = b"cluster mode is off (CONSTDB_CLUSTER=0)"
+
+
+def _slot_arg(args) -> int:
+    slot = args.next_int()
+    if not 0 <= slot < NSLOTS:
+        raise CstError(f"slot {slot} out of range (0..{NSLOTS - 1})")
+    return slot
+
+
+@register("cluster", CMD_WRITE | CMD_CTRL | CMD_NO_REPLICATE)
+def cluster_command(node, ctx, args):
+    sub = args.next_bytes().lower()
+    cl = node.cluster
+    if sub == b"info":
+        pairs = cl.info_pairs() if cl is not None \
+            else [("cluster_enabled", "0")]
+        return Bulk("".join(f"{k}:{v}\r\n" for k, v in pairs).encode())
+    if cl is None:
+        return Err(_OFF_ERR)
+    if sub == b"slots":
+        return Arr([Arr([Int(a), Int(b), Int(g),
+                         Bulk(cl.addr_of(g).encode())])
+                    for a, b, g in cl.table.ranges()])
+    if sub == b"slotdigest":
+        from .migrate import slot_digest
+        return Bulk(b"%d" % slot_digest(node, _slot_arg(args)))
+    if sub == b"setaddr":
+        # address book entry for a group (bootstrap/ops; gossip merges
+        # addresses on adopt, so one MEET-style seeding per node is
+        # enough).  No epoch bump: addresses are not ownership.
+        gid = args.next_int()
+        cl.table.groups[gid] = args.next_str()
+        return OK
+    if sub == b"setslot":
+        slot = _slot_arg(args)
+        verb = args.next_bytes().lower()
+        if verb != b"importing":
+            raise UnknownSubCmd(f"setslot {verb.decode('utf-8', 'replace')}")
+        args.next_int()  # source epoch (diagnostic; flip is epoch-gated
+        #                  by FINALIZE, not by this intake mark)
+        source = args.next_str()
+        cl.importing[slot] = source
+        # a RETRIED migration (the first attempt's channel died mid-
+        # chunk) re-marks the slot; any partial chunk buffer from the
+        # dead attempt would corrupt the fresh stream's decode
+        cl._import_buf.pop(slot, None)
+        # tombstone-GC pin mirrors the source's: nothing collected on
+        # the target either while the slot's story is still arriving
+        cl.pin_gc(node.hlc.current)
+        return OK
+    if sub == b"import":
+        slot = _slot_arg(args)
+        more = args.next_int()
+        chunk = args.next_bytes()
+        if slot not in cl.importing:
+            return Err(b"IMPORT for a slot not marked importing")
+        buf = cl._import_buf.setdefault(slot, bytearray())
+        buf += chunk
+        if more:
+            return Int(len(buf))
+        payload = bytes(cl._import_buf.pop(slot))
+        from ..persist.snapshot import _decode_batch
+        batch = _decode_batch(payload)
+        # state merge, NOT op replay: no repl-log append, no watermark
+        # adoption — the batch carries the slot's rows + tombstones and
+        # lands through the same engine seam snapshot ingest uses
+        node.merge_batches([batch])
+        return Int(len(payload))
+    if sub == b"finalize":
+        slot = _slot_arg(args)
+        if slot not in cl.importing:
+            return Err(b"FINALIZE for a slot not marked importing")
+        table = cl.table.copy()
+        table.assign(slot, slot + 1, cl.my_gid)
+        table.epoch += 1
+        app = node.app
+        if app is not None and getattr(app, "advertised_addr", None):
+            table.groups[cl.my_gid] = app.advertised_addr
+        # the atomic flip: table swap + import-window close together,
+        # before the reply carrying the new table leaves this handler
+        cl.table = table
+        cl.importing.pop(slot, None)
+        cl.migrations_in += 1
+        cl.unpin_gc()
+        return Bulk(table.serialize())
+    if sub == b"migrate":
+        # source-side admin entry: schedule the async driver; progress
+        # is observable via CLUSTER INFO (migrations_out / migrating_
+        # slots) and INFO's Cluster section
+        start = _slot_arg(args)
+        stop = args.next_int()  # exclusive; start+1 migrates one slot
+        target = args.next_str()
+        from .migrate import migrate_slot_range
+        app = node.app
+        if app is None:
+            return Err(b"MIGRATE needs a serving app context")
+        task = asyncio.get_running_loop().create_task(
+            migrate_slot_range(node, app, start, stop, target))
+        cl._tasks.add(task)
+        task.add_done_callback(cl._tasks.discard)
+        task.add_done_callback(_log_migrate_result)
+        return OK
+    raise UnknownSubCmd(sub.decode("utf-8", "replace"))
+
+
+def _log_migrate_result(task) -> None:
+    try:
+        st = task.result()
+    except asyncio.CancelledError:
+        pass
+    except Exception as e:
+        log.warning("slot migration failed: %s", e)
+    else:
+        log.info("slot migration done: %s", st)

@@ -1,0 +1,247 @@
+"""Live slot migration — the source-side driver.
+
+A migrating slot is a replica that catches up by delta and then flips
+ownership (ISSUE 19 / the certified-MRDT correspondence): the source
+streams the slot's digest bucket as a ColumnarBatch (store/digest.py
+export_bucket_batch — O(slot bytes), tombstones included) over the
+COMMAND plane to any member of the target group, re-exports to catch
+up while it keeps serving, then opens the ASK handoff window (new
+client writes drain to the target), ships the final delta, proves the
+target's coverage with a per-slot digest fixpoint (re-merging the full
+export leaves the target's digest unchanged — CRDT idempotence, so
+target >= frozen source), and finalizes: the target
+assigns itself the slot at a bumped epoch and returns the new table,
+which the source adopts and gossip (CLUSTERTAB, replica/link.py)
+spreads through both groups' meshes.
+
+Why the command plane and not a repl link: the target is in a
+DIFFERENT replication group — there is deliberately no repl stream
+between groups (that full-mesh stream is exactly what cluster mode
+removes).  The migration connection is a plain RESP client of the
+CLUSTER command family (cluster/commands.py), dialed through
+app.open_peer_connection so the chaos transport can partition it like
+any other link.
+
+Safety laws (docs/INVARIANTS.md "Slot ownership laws"):
+  * every ownership mutation re-validates the live epoch after each
+    await (the SLOT-EPOCH lint rule pins this shape) — a table adopted
+    mid-migration aborts the flip instead of racing it;
+  * the GC horizon is pinned below the migration start for its whole
+    duration (server/node.py gc_horizon), so a delete landing during
+    the handoff is still present — as a tombstone — in the final
+    export, and the key cannot resurrect across the flip;
+  * the import path merges state batches WITHOUT adopting watermarks
+    and WITHOUT re-replication (CMD_NO_REPLICATE), so the emit-only-
+    durable law and the repl-log cursor discipline survive the move.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from ..errors import CstError
+from ..resp.codec import encode_msg, make_parser
+from ..resp.message import Arr, Bulk, Err, Int, as_bytes
+from .slots import NSLOTS, SLOT_FANOUT, SLOT_LEAVES, bucket_of_slot
+
+log = logging.getLogger(__name__)
+
+# catch-up re-export rounds before the ASK window opens; the window
+# itself makes the final round exact, so this only bounds how much the
+# last delta has to carry
+_CATCHUP_ROUNDS = 2
+_DIGEST_RETRIES = 5
+
+
+def slot_digest(node, slot: int) -> int:
+    """This node's digest cell for `slot` (flush-first; the 64x256
+    geometry under which slot == bucket — cluster/slots.py)."""
+    from ..store.digest import state_digest_matrix
+    node.ensure_flushed()
+    m = state_digest_matrix(node.ks, SLOT_FANOUT, SLOT_LEAVES)
+    return int(m.reshape(-1)[bucket_of_slot(slot)])
+
+
+def export_slot_batch(node, slot: int):
+    """The slot's whole logical state as one ColumnarBatch (live rows +
+    key tombstones) — O(slot bytes) by construction."""
+    from ..store.digest import export_bucket_batch
+    node.ensure_flushed()
+    mask = np.zeros(NSLOTS, dtype=bool)
+    mask[bucket_of_slot(slot)] = True
+    return export_bucket_batch(node.ks, SLOT_FANOUT, SLOT_LEAVES, mask)
+
+
+def migrate_batch_bytes(app) -> int:
+    """Wire chunk size for CLUSTER IMPORT payloads (CONSTDB_MIGRATE_
+    BATCH_MB): bounds the largest single frame the migration writes, so
+    a big slot streams as many bounded frames instead of one giant one."""
+    mb = getattr(app, "migrate_batch_mb", None)
+    if mb is None:
+        from ..conf import env_int
+        mb = env_int("CONSTDB_MIGRATE_BATCH_MB", 8)
+    return max(1, mb) << 20
+
+
+class _Chan:
+    """One RESP request/response channel to the migration target."""
+
+    def __init__(self, reader, writer, timeout: float):
+        self.reader = reader
+        self.writer = writer
+        self.parser = make_parser()
+        self.timeout = timeout
+
+    async def call(self, *parts):
+        items = [p if isinstance(p, (Bulk, Int)) else Bulk(p)
+                 for p in parts]
+        self.writer.write(encode_msg(Arr(items)))
+        await self.writer.drain()
+        while True:
+            msg = self.parser.next_msg()
+            if msg is not None:
+                if isinstance(msg, Err):
+                    raise CstError("migration target error: "
+                                   + msg.val.decode("utf-8", "replace"))
+                return msg
+            data = await asyncio.wait_for(self.reader.read(1 << 16),
+                                          self.timeout)
+            if not data:
+                raise ConnectionError("migration target EOF")
+            self.parser.feed(data)
+
+    def close(self):
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+async def _ship_slot(chan: _Chan, node, slot: int, chunk_bytes: int) -> int:
+    """Export + stream one round of the slot's state; returns payload
+    bytes shipped."""
+    from ..persist.snapshot import _encode_batch
+    payload = bytes(_encode_batch(export_slot_batch(node, slot)))
+    total = len(payload)
+    off = 0
+    while True:
+        chunk = payload[off:off + chunk_bytes]
+        off += len(chunk)
+        more = 1 if off < total else 0
+        await chan.call(b"cluster", b"import", b"%d" % slot,
+                        b"%d" % more, Bulk(chunk))
+        if not more:
+            return total
+
+
+async def migrate_slot(node, app, slot: int, target_addr: str, *,
+                       timeout: float = 30.0) -> dict:
+    """Drive one slot's migration to `target_addr` (any member of the
+    target group).  Returns {"slot", "bytes", "rounds", "epoch"} for the
+    bench/ops surface.  Raises on any epoch race or digest mismatch —
+    ownership never flips on an unproven copy."""
+    cl = node.cluster
+    if cl is None:
+        raise CstError("cluster mode is off")
+    if not 0 <= slot < NSLOTS:
+        raise CstError(f"slot {slot} out of range")
+    if not cl.owns(slot):
+        raise CstError(f"slot {slot} not owned by this group")
+    if slot in cl.migrating:
+        raise CstError(f"slot {slot} already migrating")
+    epoch0 = cl.epoch
+    # pin tombstone GC below every op the migration window can produce:
+    # a delete landing mid-handoff must still be a visible tombstone in
+    # the final export (no-resurrection across the flip)
+    cl.pin_gc(node.hlc.current)
+    chunk_bytes = migrate_batch_bytes(app)
+    host, port = target_addr.rsplit(":", 1)
+    shipped = rounds = 0
+    reader, writer = await asyncio.wait_for(
+        app.open_peer_connection(host, int(port)), timeout)
+    chan = _Chan(reader, writer, timeout)
+    try:
+        if node.cluster is not cl or cl.epoch != epoch0:
+            raise CstError("slot table changed while dialing; aborting")
+        await chan.call(b"cluster", b"setslot", b"%d" % slot,
+                        b"importing", b"%d" % epoch0,
+                        app.advertised_addr.encode())
+        # bulk + catch-up rounds while still serving the slot
+        for _ in range(1 + _CATCHUP_ROUNDS):
+            if node.cluster is not cl or cl.epoch != epoch0:
+                raise CstError("slot table changed mid-migration; aborting")
+            shipped += await _ship_slot(chan, node, slot, chunk_bytes)
+            rounds += 1
+        if node.cluster is not cl or cl.epoch != epoch0:
+            raise CstError("slot table changed mid-migration; aborting")
+        # ASK handoff window: from here every new client write on the
+        # slot redirects to the target, so the final export is the
+        # whole remaining story
+        cl.migrating[slot] = target_addr
+        try:
+            # convergence certificate: the flip is safe iff the target
+            # holds EVERYTHING the (now frozen — ASK redirects all new
+            # writes) source copy holds.  The target may legally hold
+            # MORE (ASK-window writes land there), so source-vs-target
+            # digest equality is the wrong test; instead we use CRDT
+            # idempotence as a fixpoint probe — if re-merging the
+            # slot's full export leaves the target's per-slot digest
+            # unchanged, the export was a no-op and target >= source.
+            for attempt in range(_DIGEST_RETRIES):
+                if node.cluster is not cl or cl.epoch != epoch0:
+                    raise CstError(
+                        "slot table changed mid-handoff; aborting")
+                before = int(as_bytes(await chan.call(
+                    b"cluster", b"slotdigest", b"%d" % slot)))
+                shipped += await _ship_slot(chan, node, slot, chunk_bytes)
+                rounds += 1
+                after = int(as_bytes(await chan.call(
+                    b"cluster", b"slotdigest", b"%d" % slot)))
+                if after == before:
+                    break
+            else:
+                raise CstError(
+                    f"slot {slot} digest never reached its fixpoint on "
+                    f"{target_addr} after {_DIGEST_RETRIES} deltas")
+            if node.cluster is not cl or cl.epoch != epoch0:
+                raise CstError("slot table changed pre-finalize; aborting")
+            # the flip: the target assigns itself the slot at a bumped
+            # epoch and returns the table; adopting it atomically turns
+            # our ASK window into a plain MOVED
+            reply = await chan.call(b"cluster", b"finalize", b"%d" % slot)
+            from .slots import SlotTable
+            table = SlotTable.deserialize(as_bytes(reply))
+            if table.epoch <= epoch0 or \
+                    table.owner[slot] == cl.my_gid:
+                raise CstError("finalize returned a non-advancing table")
+        finally:
+            cl.migrating.pop(slot, None)
+        cl.adopt(table)
+        cl.migrations_out += 1
+        log.info("slot %d migrated to %s: %d bytes over %d rounds, "
+                 "epoch %d -> %d", slot, target_addr, shipped, rounds,
+                 epoch0, table.epoch)
+        return {"slot": slot, "bytes": shipped, "rounds": rounds,
+                "epoch": table.epoch}
+    finally:
+        cl.unpin_gc()
+        chan.close()
+
+
+async def migrate_slot_range(node, app, start: int, stop: int,
+                             target_addr: str, **kw) -> dict:
+    """Migrate slots [start, stop) sequentially; aggregate stats."""
+    total = {"slots": 0, "bytes": 0, "rounds": 0}
+    for slot in range(start, stop):
+        cl = node.cluster
+        if cl is not None and not cl.owns(slot):
+            continue  # already elsewhere (flap/retry idempotence)
+        st = await migrate_slot(node, app, slot, target_addr, **kw)
+        total["slots"] += 1
+        total["bytes"] += st["bytes"]
+        total["rounds"] += st["rounds"]
+        total["epoch"] = st["epoch"]
+    return total

@@ -1,0 +1,274 @@
+"""Slot math + the epoch-versioned slot table + per-node cluster state.
+
+Slot <-> digest-bucket correspondence (the load-bearing trick): the
+digest plane partitions keys by ``crc32(key)`` into ``fanout x leaves``
+buckets as ``(crc % fanout) * leaves + (crc // fanout) % leaves``
+(store/digest.py _buckets).  With the canonical 64x256 geometry,
+``fanout * leaves == NSLOTS`` and both coordinates are exact functions
+of ``crc % 16384`` — i.e. of the slot — so
+
+    bucket_of_slot(s) == (s % 64) * 256 + s // 64
+
+is a bijection: every slot IS one digest bucket.  Per-slot digest =
+one matrix cell; per-slot export = export_bucket_batch with that one
+bucket masked (tombstones included).  Migration therefore ships
+O(slot bytes), never a full-keyspace snapshot, with convergence
+certified by the same digest the delta-sync plane already trusts.
+
+Routing contract (server/commands.py execute + server/serve.py): every
+data command is FIRST-KEY-CONFINED (the KEY-CONFINED lint rule pins
+this statically), so ``ClusterState.route(key)`` decides from the first
+argument alone:
+
+    owned, not migrating      -> None               (serve locally)
+    owned, slot mid-handoff   -> -ASK <slot> <addr>  (writes drain to
+                                                      the target during
+                                                      the handoff window)
+    not owned, slot importing -> None               (serve: the ASK
+                                                      target side)
+    not owned                 -> -MOVED <slot> <addr>
+
+Ownership is EPOCH-GATED: the table only ever adopts a peer's table at
+a strictly higher epoch (adopt()), and every migration finalize bumps
+the epoch exactly once, so a stale owner converges to redirecting at
+its first gossip exchange and two groups never both serve a slot at
+the same epoch."""
+
+from __future__ import annotations
+
+import json
+import zlib
+from array import array
+from typing import Optional
+
+from ..resp.message import Err
+
+NSLOTS = 16384
+# the canonical digest geometry under which slot == bucket (module doc)
+SLOT_FANOUT = 64
+SLOT_LEAVES = 256
+assert SLOT_FANOUT * SLOT_LEAVES == NSLOTS
+
+
+def slot_of(key: bytes) -> int:
+    """The hash slot of a key — the digest plane's crc32, mod NSLOTS."""
+    return zlib.crc32(key) % NSLOTS
+
+
+def bucket_of_slot(slot: int) -> int:
+    """The flat 64x256 digest-bucket index holding exactly this slot's
+    keys (module doc derivation; property-tested against digest._buckets
+    in tests/test_cluster.py)."""
+    return (slot % SLOT_FANOUT) * SLOT_LEAVES + slot // SLOT_FANOUT
+
+
+class SlotTable:
+    """Epoch-versioned slot -> group ownership map.
+
+    ``owner[slot]`` is a group id (gid); ``groups`` maps gid to the
+    group's advertised client address ("host:port" — any member of the
+    group; redirects land on it and its mesh replicates inside the
+    group).  ``epoch`` totally orders tables: higher epoch wins,
+    unconditionally, everywhere (adopt below).  A single-group table
+    (every slot owned by gid 0) is the legacy picture — what a
+    CONSTDB_CLUSTER=0 node, or any pre-cluster peer, implicitly holds."""
+
+    __slots__ = ("epoch", "owner", "groups")
+
+    def __init__(self, epoch: int = 0, owner=None, groups=None):
+        self.epoch = epoch
+        self.owner = owner if owner is not None \
+            else array("i", bytes(4 * NSLOTS))
+        self.groups: dict[int, str] = dict(groups) if groups else {}
+
+    def owner_of(self, slot: int) -> int:
+        return self.owner[slot]
+
+    def assign(self, start: int, stop: int, gid: int) -> None:
+        """Assign slots [start, stop) to gid (no epoch change — callers
+        bump once per atomic ownership flip)."""
+        for s in range(start, stop):
+            self.owner[s] = gid
+
+    def slots_owned(self, gid: int) -> int:
+        return sum(1 for g in self.owner if g == gid)
+
+    def ranges(self) -> list[tuple[int, int, int]]:
+        """Contiguous (start, end_inclusive, gid) runs — the CLUSTER
+        SLOTS reply shape."""
+        out = []
+        start = 0
+        cur = self.owner[0]
+        for s in range(1, NSLOTS):
+            g = self.owner[s]
+            if g != cur:
+                out.append((start, s - 1, cur))
+                start, cur = s, g
+        out.append((start, NSLOTS - 1, cur))
+        return out
+
+    # ------------------------------------------------------------ codec
+    # run-length JSON: small (a fresh table is one run), stdlib-only,
+    # and self-describing for the CLUSTERTAB gossip frame and the
+    # CLUSTER FINALIZE reply.
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "epoch": self.epoch,
+            "groups": {str(g): a for g, a in sorted(self.groups.items())},
+            "runs": [[a, b, g] for a, b, g in self.ranges()],
+        }, separators=(",", ":")).encode()
+
+    @classmethod
+    def deserialize(cls, payload: bytes) -> "SlotTable":
+        doc = json.loads(payload.decode("utf-8"))
+        t = cls(epoch=int(doc["epoch"]),
+                groups={int(g): str(a) for g, a in doc["groups"].items()})
+        for a, b, g in doc["runs"]:
+            t.assign(int(a), int(b) + 1, int(g))
+        return t
+
+    def copy(self) -> "SlotTable":
+        return SlotTable(self.epoch, array("i", self.owner),
+                         dict(self.groups))
+
+
+def even_split(n_groups: int, addrs=None) -> SlotTable:
+    """The bootstrap table: NSLOTS split into n_groups contiguous
+    ranges (gid 0..n-1).  ``addrs`` optionally seeds the group address
+    map."""
+    t = SlotTable(epoch=1)
+    per = NSLOTS // max(1, n_groups)
+    for g in range(n_groups):
+        hi = NSLOTS if g == n_groups - 1 else (g + 1) * per
+        t.assign(g * per, hi, g)
+    if addrs:
+        for g, a in enumerate(addrs):
+            if a:
+                t.groups[g] = a
+    return t
+
+
+class ClusterState:
+    """Per-node cluster view, attached as ``node.cluster`` (None when
+    cluster mode is off — every hot-path gate is a single ``is None``
+    test, so the disabled cost is one attribute load).
+
+    Holds the slot table, this node's group id, the live migration
+    windows (``migrating``: slot -> target addr, the ASK window on the
+    source; ``importing``: slot -> source addr, the serve-anyway window
+    on the target), the redirect/migration counters INFO reports, and
+    the GC migration pin: while any slot is mid-flight, gc_horizon()
+    (server/node.py) is clamped at the pin so no tombstone written
+    during the handoff is collected before the target holds it — the
+    no-resurrection law extended across an ownership flip."""
+
+    __slots__ = ("my_gid", "table", "migrating", "importing",
+                 "redirects_sent", "migrations_in", "migrations_out",
+                 "_gc_pin", "_import_buf", "_tasks")
+
+    def __init__(self, my_gid: int, table: SlotTable):
+        self.my_gid = my_gid
+        self.table = table
+        self.migrating: dict[int, str] = {}
+        self.importing: dict[int, str] = {}
+        self.redirects_sent = 0
+        self.migrations_in = 0
+        self.migrations_out = 0
+        self._gc_pin: Optional[int] = None
+        self._import_buf: dict[int, bytearray] = {}
+        self._tasks: set = set()
+
+    @property
+    def epoch(self) -> int:
+        return self.table.epoch
+
+    def owns(self, slot: int) -> bool:
+        return self.table.owner[slot] == self.my_gid
+
+    def slots_owned(self) -> int:
+        return self.table.slots_owned(self.my_gid)
+
+    def addr_of(self, gid: int) -> str:
+        return self.table.groups.get(gid, "?")
+
+    # ---------------------------------------------------------- routing
+
+    def needs_redirect(self, key: bytes) -> bool:
+        """Counter-free probe of route(): True iff route(key) would
+        return a redirect.  The serve coalescer demotes such commands
+        out of its planned runs with this, and the ONE counted route()
+        call then happens in commands.execute — so pure, native, and
+        lone-command intakes produce the identical reply bytes and the
+        identical redirects_sent count."""
+        slot = slot_of(key)
+        if self.table.owner[slot] == self.my_gid:
+            return slot in self.migrating
+        return slot not in self.importing
+
+    def route(self, key: bytes):
+        """None = serve locally; otherwise the exact redirect Err.
+        See the module doc for the four-way contract."""
+        slot = slot_of(key)
+        if self.table.owner[slot] == self.my_gid:
+            target = self.migrating.get(slot)
+            if target is None:
+                return None
+            # handoff window: the slot's bulk state is already on the
+            # target; new writes must land THERE so the final delta is
+            # the whole story (ASK-window exactness law)
+            self.redirects_sent += 1
+            return Err(b"ASK %d %s" % (slot, target.encode()))
+        if slot in self.importing:
+            # the ASK target side: serve redirected traffic for a slot
+            # we are importing even though the table still names the
+            # source as owner
+            return None
+        self.redirects_sent += 1
+        addr = self.addr_of(self.table.owner[slot])
+        return Err(b"MOVED %d %s" % (slot, addr.encode()))
+
+    # ------------------------------------------------- table adoption
+
+    def adopt(self, table: SlotTable) -> bool:
+        """Adopt a gossiped/finalized table iff it is STRICTLY newer.
+        Preserves locally-known group addresses the newer table lacks
+        (gossip carries ownership, not necessarily every address)."""
+        if table.epoch <= self.table.epoch:
+            return False
+        merged = dict(self.table.groups)
+        merged.update(table.groups)
+        table.groups = merged
+        self.table = table
+        return True
+
+    # ----------------------------------------------------- GC pinning
+
+    def pin_gc(self, uuid: int) -> None:
+        """Clamp the tombstone-GC horizon at `uuid` for the duration of
+        a migration (lowest pin wins across overlapping migrations)."""
+        if self._gc_pin is None or uuid < self._gc_pin:
+            self._gc_pin = uuid
+
+    def unpin_gc(self) -> None:
+        if not self.migrating and not self.importing:
+            self._gc_pin = None
+
+    def gc_pin(self) -> Optional[int]:
+        return self._gc_pin
+
+    # ------------------------------------------------------ INFO feed
+
+    def info_pairs(self) -> list[tuple[str, str]]:
+        return [
+            ("cluster_enabled", "1"),
+            ("cluster_group", str(self.my_gid)),
+            ("cluster_epoch", str(self.epoch)),
+            ("cluster_known_groups", str(len(self.table.groups))),
+            ("slots_owned", str(self.slots_owned())),
+            ("migrations_in", str(self.migrations_in)),
+            ("migrations_out", str(self.migrations_out)),
+            ("migrating_slots", str(len(self.migrating))),
+            ("importing_slots", str(len(self.importing))),
+            ("redirects_sent", str(self.redirects_sent)),
+        ]

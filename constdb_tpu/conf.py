@@ -269,6 +269,24 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "minimum MB of post-checkpoint log tail before a time-due "
            "checkpoint actually cuts — an idle node never churns "
            "snapshots just because the clock advanced"),
+    EnvVar("CONSTDB_CLUSTER", "0",
+           "cluster mode (constdb_tpu/cluster): partition the 16384 "
+           "hash slots (crc32(key) mod 16384 — the digest plane's own "
+           "partition) across replication groups; non-owned keys get "
+           "MOVED/ASK redirects and slots migrate live over the "
+           "digest->delta path; 0 (default) = the exact pre-cluster "
+           "single-group node, byte for byte"),
+    EnvVar("CONSTDB_SLOT_GROUPS", "1",
+           "bootstrap slot-table shape under CONSTDB_CLUSTER=1: the "
+           "16384 slots split into this many contiguous group ranges "
+           "at epoch 1 (each node's group id is supplied by the "
+           "harness/operator); live migration + gossip rebalance from "
+           "there"),
+    EnvVar("CONSTDB_MIGRATE_BATCH_MB", "8",
+           "slot-migration wire chunk (MB): a migrating slot's "
+           "ColumnarBatch export streams as CLUSTER IMPORT frames of "
+           "at most this size, so one big slot cannot wedge the "
+           "target's loop behind a single giant frame"),
 )}
 
 
@@ -369,6 +387,12 @@ class Config:
     #                        the post-rewrite base; 0 = off); -1 = the
     #                        CONSTDB_AOF_REWRITE_PCT env default (100)
     aof_dir: str = ""      # segment directory; "" = <work_dir>/aof
+    cluster_group: int = 0  # this node's replication-group id under
+    #                        CONSTDB_CLUSTER=1 (which slot range of the
+    #                        CONSTDB_SLOT_GROUPS bootstrap split it
+    #                        owns); every member of a group shares one
+    #                        id.  Deliberately a flag, not an env: two
+    #                        nodes of one cluster differ ONLY here.
     restore_to: int = 0    # point-in-time restore: boot replays the AOF
     #                        only up to this uuid (record-boundary
     #                        granularity), then re-bases the log on the
@@ -414,6 +438,11 @@ def load_config(argv: list[str] | None = None) -> Config:
                     help="point-in-time restore: replay the AOF only up "
                          "to this uuid, then re-base the log (run "
                          "against a copy of the data dir)")
+    ap.add_argument("--cluster-group", type=int, dest="cluster_group",
+                    metavar="GID",
+                    help="this node's replication-group id under "
+                         "CONSTDB_CLUSTER=1 (default 0; see "
+                         "CONSTDB_SLOT_GROUPS)")
     ap.add_argument("--log-level", dest="log_level")
     ns = ap.parse_args(argv)
 

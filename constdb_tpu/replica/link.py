@@ -93,6 +93,7 @@ REPLACK = b"replack"
 DIGEST = b"digest"
 DIGESTACK = b"digestack"
 DELTASYNC = b"deltasync"
+CLUSTERTAB = b"clustertab"
 
 # Handshake capability bits: items[6] of BOTH sync frames (dialer and
 # reply).  A pre-capability peer sends 6-item frames and parses as 0 —
@@ -105,6 +106,13 @@ CAP_BATCH_STREAM = 4     # decodes REPLBATCH columnar run frames
 CAP_COMPRESS = 8         # validates the chunked compression framing
 #                          (utils/compressio.py): REPLBATCH payloads
 #                          over the floor + FULLSYNC/DELTASYNC windows
+CAP_CLUSTER = 16         # decodes CLUSTERTAB slot-table gossip frames
+#                          (cluster/slots.py).  Advertised ONLY when
+#                          cluster mode is on — deliberately outside
+#                          MY_CAPS, so a CONSTDB_CLUSTER=0 node (and
+#                          every stream to/from a legacy peer) stays
+#                          byte-exact pre-cluster (tests/test_cluster.py
+#                          pins the stream)
 MY_CAPS = CAP_FULLSYNC_RESET | CAP_DELTA_SYNC | CAP_BATCH_STREAM \
     | CAP_COMPRESS
 
@@ -141,6 +149,11 @@ def my_caps(app, meta=None) -> int:
             (meta is not None and
              getattr(meta, "compress_wire_off", False)):
         caps &= ~CAP_COMPRESS
+    if getattr(getattr(app, "node", None), "cluster", None) is not None:
+        # slot-table gossip rides the repl stream only between two
+        # cluster-mode nodes; a disabled node advertises nothing and a
+        # legacy peer is never sent a CLUSTERTAB frame (push-loop gate)
+        caps |= CAP_CLUSTER
     return caps
 
 
@@ -659,6 +672,7 @@ class ReplicaLink:
             # is the intended one, not a stale shared read
             cursor = 0
             last_ack = 0.0
+            tab_epoch = -1  # slot-table epoch last gossiped on this conn
             while True:
                 acked = meta.uuid_i_acked
                 while inflight and inflight[0][0] <= acked:
@@ -887,6 +901,19 @@ class ReplicaLink:
                     # loop) instead of sleeping out a heartbeat first
                     await writer.drain()
                     continue
+
+                cl = node.cluster
+                if cl is not None and (self._peer_caps & CAP_CLUSTER) \
+                        and cl.epoch != tab_epoch:
+                    # slot-table gossip: once per epoch change per
+                    # connection (first round includes the initial
+                    # table).  Only to peers that advertised the
+                    # capability — a legacy or disabled peer's stream
+                    # carries zero cluster bytes (the byte-exact pin).
+                    tab_epoch = cl.epoch
+                    self._write(writer, encode_msg(Arr([
+                        Bulk(CLUSTERTAB), Int(tab_epoch),
+                        Bulk(cl.table.serialize())])))
 
                 now = asyncio.get_running_loop().time()
                 # durable-ack cap (persist/oplog.py): the advertised
@@ -1415,6 +1442,23 @@ class ReplicaLink:
                 # reply to OUR push loop's digest question (bridged)
                 if self._digest_acks is not None and len(items) >= 4:
                     self._digest_acks.put_nowait(items)
+            elif kind == CLUSTERTAB:
+                # slot-table gossip (cluster/slots.py): adopt iff
+                # STRICTLY newer — epoch-gated routing is what keeps a
+                # flapped owner from resurrecting a stale table.  Only
+                # cluster-mode peers send these (we advertised
+                # CAP_CLUSTER); a disabled node treats one as the
+                # protocol error it is, like any unknown frame.
+                cl = self.node.cluster
+                if cl is None:
+                    raise CstError("clustertab frame on a non-cluster "
+                                   "node (capability mismatch)")
+                if len(items) > 2:
+                    from ..cluster.slots import SlotTable
+                    table = SlotTable.deserialize(as_bytes(items[2]))
+                    if cl.adopt(table):
+                        log.info("adopted slot table epoch %d from %s",
+                                 table.epoch, self.meta.addr)
             elif kind == PARTSYNC:
                 pass  # stream continues from our requested resume point
             else:

@@ -249,6 +249,21 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
     if cmd.flags & CMD_REPL_ONLY:
         return Err(b"this command can only be sent by replicas")
     node.stats.cmds_processed += 1
+    cl = node.cluster
+    if cl is not None and len(items) > 1 and shard_routable(cmd):
+        # slot routing (cluster/slots.py): every data command is FIRST-
+        # KEY-CONFINED (the KEY-CONFINED lint convention), so the slot
+        # decision needs only items[1].  A redirect mints NO uuid,
+        # touches NO state, and replicates NOTHING — to this node the
+        # command never happened.  The replication path never routes:
+        # replicated ops are already group-scoped by construction (the
+        # writer routed), and must always land (apply_replicated).
+        try:
+            redirect = cl.route(as_bytes(items[1]))
+        except CstError:
+            redirect = None  # unkeyable arg: the handler's exact error
+        if redirect is not None:
+            return redirect
     if cmd.flags & CMD_DENYOOM and node.governor.shed_writes():
         # maxmemory shed, at the CLIENT edge only: nothing was applied,
         # logged, or replicated — this write never existed, so the
@@ -1967,3 +1982,4 @@ def _plan_hset(coal, items):
 # membership + observability commands register themselves against this table
 from ..replica import commands as _replica_commands  # noqa: E402,F401
 from . import info as _info_commands  # noqa: E402,F401
+from ..cluster import commands as _cluster_commands  # noqa: E402,F401

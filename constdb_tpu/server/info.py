@@ -397,6 +397,18 @@ def _section_keyspace(node, out):
     out.append(("pending_tombstones", len(ks.garbage)))
 
 
+def _section_cluster(node, out) -> None:
+    """Slot ownership + migration observability (constdb_tpu/cluster).
+    cluster_enabled:0 is the whole story on a non-cluster node — the
+    section shape stays stable either way, so dashboards need no
+    probing."""
+    cl = node.cluster
+    if cl is None:
+        out.append(("cluster_enabled", 0))
+        return
+    out.extend(cl.info_pairs())
+
+
 SECTIONS = {
     "server": _section_server,
     "clients": _section_clients,
@@ -407,6 +419,7 @@ SECTIONS = {
     "recovery": _section_recovery,
     "replication": _section_replication,
     "keyspace": _section_keyspace,
+    "cluster": _section_cluster,
 }
 
 

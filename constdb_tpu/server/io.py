@@ -77,7 +77,11 @@ class ServerApp:
                  aof_dir: str = "",
                  checkpoint_secs: Optional[float] = None,
                  checkpoint_min_mb: Optional[int] = None,
-                 restore_to: int = 0):
+                 restore_to: int = 0,
+                 cluster: Optional[bool] = None,
+                 cluster_group: int = 0,
+                 slot_groups: Optional[int] = None,
+                 migrate_batch_mb: Optional[int] = None):
         self.node = node
         node.app = self
         if node.replicas is None:
@@ -261,6 +265,24 @@ class ServerApp:
         # point-in-time restore: replay stops at this uuid and the log
         # re-bases on the next rewrite.  Run against a COPY of the dir.
         self.restore_to = restore_to
+        # cluster mode (constdb_tpu/cluster): hash-slot keyspace
+        # partitioning across replication groups.  None = the
+        # CONSTDB_CLUSTER / CONSTDB_SLOT_GROUPS / CONSTDB_MIGRATE_
+        # BATCH_MB env defaults; `cluster_group` is this node's group id
+        # (harness/ops supplied — forked bench/chaos nodes pass it
+        # directly).  Off (the default) node.cluster stays None and
+        # every code path is the exact pre-cluster node.
+        self.cluster = env_flag("CONSTDB_CLUSTER", False) \
+            if cluster is None else cluster
+        self.slot_groups = env_int("CONSTDB_SLOT_GROUPS", 1) \
+            if slot_groups is None else slot_groups
+        self.migrate_batch_mb = env_int("CONSTDB_MIGRATE_BATCH_MB", 8) \
+            if migrate_batch_mb is None else migrate_batch_mb
+        self.cluster_group = cluster_group
+        if self.cluster and node.cluster is None:
+            from ..cluster.slots import ClusterState, even_split
+            node.cluster = ClusterState(
+                cluster_group, even_split(max(1, self.slot_groups)))
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -322,6 +344,12 @@ class ServerApp:
             self._on_connection, self.host, self.port,
             backlog=self.tcp_backlog, start_serving=False)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.node.cluster is not None:
+            # our own group's address book entry: live as soon as the
+            # (possibly ephemeral) port is known, so redirects and
+            # gossiped tables name a dialable address
+            self.node.cluster.table.groups.setdefault(
+                self.node.cluster.my_gid, self.advertised_addr)
         if self._boot_restore is not None:
             await self._boot_restore()
         await self._server.start_serving()

@@ -254,6 +254,11 @@ class Node:
         # data command executes inside the shard worker owning its key,
         # and self.repl_log is the plane's MergedReplLog view.
         self.serve_plane = None
+        # cluster mode (cluster/slots.py ClusterState) when
+        # CONSTDB_CLUSTER=1 — armed by server/io.py before serving; None
+        # = the exact pre-cluster single-group node (every hot-path gate
+        # is one `is None` test)
+        self.cluster = None
 
     def _make_keyspace(self) -> KeySpace:
         """Fresh keyspace with the node's event wiring (shared by boot and
@@ -295,12 +300,24 @@ class Node:
     def gc_horizon(self) -> int:
         """Tombstones at or below this uuid are collectable: every live peer's
         stream has passed it (reference replica/replica.rs:87-89 min over
-        uuid_he_sent; standalone nodes collect up to their own clock)."""
+        uuid_he_sent; standalone nodes collect up to their own clock).
+
+        A mid-flight slot migration additionally clamps the horizon at
+        its start pin (cluster/slots.py pin_gc): a delete landing during
+        the handoff must still be a visible TOMBSTONE in the final
+        export, or the moved copy resurrects the key across the
+        ownership flip (docs/INVARIANTS.md "Slot ownership laws")."""
+        horizon = None
         if self.replicas is not None:
-            m = self.replicas.min_uuid()
-            if m is not None:
-                return m
-        return self.hlc.current
+            horizon = self.replicas.min_uuid()
+        if horizon is None:
+            horizon = self.hlc.current
+        cl = self.cluster
+        if cl is not None:
+            pin = cl.gc_pin()
+            if pin is not None and pin < horizon:
+                horizon = pin
+        return horizon
 
     def gc(self) -> int:
         self.ensure_flushed()

@@ -277,6 +277,25 @@ class ServeCoalescer:
             # never shed.
             plan = [None if callable(fn) and self._oom_gated(m) else fn
                     for fn, m in zip(plan, msgs)]
+        cl = self.node.cluster
+        if cl is not None:
+            # slot routing (cluster/slots.py): a planned command on a
+            # slot this group does not serve must NOT ride the run —
+            # demote it to the exact per-command path, where execute()
+            # returns (and counts) the byte-exact MOVED/ASK redirect.
+            # Keys come from the same first-arg confinement the
+            # planners ride (KEY-CONFINED).
+            for i, fn in enumerate(plan):
+                if fn is None:
+                    continue
+                if type(fn) is tuple:
+                    key = fn[2]
+                else:
+                    it = msgs[i].items
+                    key = it[1].val if len(it) > 1 and \
+                        type(it[1]) is Bulk else None
+                if key is not None and cl.needs_redirect(key):
+                    plan[i] = None
         n = len(msgs)
         n_plannable = sum(callable(f) for f in plan)
         if n_plannable >= _PREPROBE_MIN:
@@ -386,6 +405,28 @@ class ServeCoalescer:
             plan = [None if (type(fn) is int and fn in _OOM_OPS) or
                     (callable(fn) and self._oom_gated(pl)) else fn
                     for fn, pl in zip(plan, payloads)]
+        cl = self.node.cluster
+        if cl is not None:
+            # slot routing, native intake: same demotion as run_chunk —
+            # a native opcode IS a registered key-confined data command
+            # (write key = first raw arg, read key = pl[0]), so demoting
+            # it to _exec lands on the SAME execute() redirect and the
+            # reply bytes stay byte-identical to the pure drain
+            # (tests/test_native_intake.py redirect differential)
+            for i, fn in enumerate(plan):
+                if fn is None:
+                    continue
+                if type(fn) is int:
+                    pl = payloads[i]
+                    key = pl[1][0] if fn < _FIRST_READ_OP else pl[0]
+                elif type(fn) is tuple:
+                    key = fn[2]
+                else:
+                    it = payloads[i].items
+                    key = it[1].val if len(it) > 1 and \
+                        type(it[1]) is Bulk else None
+                if key is not None and cl.needs_redirect(key):
+                    plan[i] = None
         n_plannable = sum(1 for fn in plan if callable(fn) or
                           (type(fn) is int and fn < _FIRST_READ_OP))
         if n_plannable >= _PREPROBE_MIN:
@@ -441,9 +482,14 @@ class ServeCoalescer:
             op = ops[i]
             if read_run:
                 # same commutes-with-the-run gate as run_chunk: a native
-                # write opcode IS a registered key-confined data command,
-                # so its confined key is its first payload byte-string
-                key = pl[1][0] if op else self._confined_key(pl)
+                # opcode IS a registered key-confined data command, so
+                # its confined key is its first payload byte-string
+                # (write: first raw arg; read: pl[0] — a slot-demoted
+                # native read reaches here with fn=None but op set)
+                if op:
+                    key = pl[1][0] if op < _FIRST_READ_OP else pl[0]
+                else:
+                    key = self._confined_key(pl)
                 if key is None or key in run_keys:
                     self._run_read_batch(read_run, out, None, deferred)
                     read_run = []

@@ -19,6 +19,15 @@
 //   python-only: cntundo tensor.set tensor.merge lrange
 // NATIVE-INTAKE-TABLE-END
 //
+// Routability contract (cluster mode): every native/native-reads entry
+// must be slot-routable — first-key-confined, non-CTRL, non-empty
+// families — because the serve coalescer extracts the routing key from
+// the scanned payload (payloads[i][1][0] for writes, payloads[i][0] for
+// reads) to demote would-redirect commands back to the per-command
+// path.  A CTRL or keyless command in these rows would fast-path here
+// while the slot router skips it; the NATIVE-CONTRACT lint's
+// `:unroutable` direction rejects that statically.
+//
 // intake_scan(buf, pos, Arr, Bulk, Int, Simple, Err, nil[, max_bulk,
 // max_msgs]) returns (ops, payloads, new_pos):
 //   * ops      — bytes; ops[i] is message i's opcode (Op below; 0 means

@@ -157,6 +157,40 @@ print("serve-shards smoke verified:",
 EOF
 
 echo
+echo "== cluster smoke (bench --mode cluster) =="
+# tiny oracle-verified run of the hash-slot partitioning legs: the
+# same op stream partitioned by slot owner must union back to the
+# single group's visible-value export with zero redirects (client
+# partitioning and server routing agree on the slot math), the
+# redirect-tax pair must match reply-for-reply, and a live slot-range
+# migration must flip ownership with the moved keys serving from the
+# target at O(slot bytes) shipped (the differential suite proper runs
+# inside tier-1 — tests/test_cluster.py; the partition/flap/
+# resurrection convergence cells run in the chaos smoke below)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_CLUSTER_OPS=4000 \
+CONSTDB_BENCH_CLUSTER_CONNS=2 CONSTDB_BENCH_CLUSTER_GROUPS=2 \
+CONSTDB_BENCH_CLUSTER_REPS=1 CONSTDB_BENCH_CLUSTER_TAX_REPS=1 \
+CONSTDB_BENCH_CLUSTER_MIG_KEYS=2000 CONSTDB_BENCH_CLUSTER_MIG_SLOTS=16 \
+    timeout -k 10 300 python bench.py --mode cluster \
+    > /tmp/_ci_cluster.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_cluster.json"))
+assert out["verified"], "cluster smoke failed oracle verification"
+mig = out["migration"]
+assert mig["ok"] and mig["slots"] == 16, mig
+assert mig["shipped_vs_full"] < 0.25, \
+    f"migration shipped {mig['shipped_vs_full']:.0%} of the full state"
+assert out["route_check_pct_of_op"] < 5.0, \
+    f"route check at {out['route_check_pct_of_op']}% of the op budget"
+print("cluster smoke verified:",
+      f"{out['groups']} groups {out['value']}x,",
+      f"route check {out['route_check_ns']}ns,",
+      f"migration {mig['slots']} slots =",
+      f"{mig['shipped_vs_full']:.1%} of full state shipped")
+EOF
+
+echo
 echo "== read-path smoke (bench --mode serve --read-pct 90) =="
 # tiny oracle-verified run of the coalesced read plane over real
 # sockets: a mixed 90:10 pipelined workload on the coalesced+cache,

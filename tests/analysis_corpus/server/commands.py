@@ -4,7 +4,15 @@ decorated @serve_plan but appears in none of the marker table's rows
 (native / native-reads / python-only), so the C scanner would demote it
 to OTHER silently — exactly one finding, on the decorator.  The handler
 itself is first-key-confined, so KEY-CONFINED stays quiet; `sadd`
-mirrors a real covered command and may not fire anything."""
+mirrors a real covered command and may not fire anything.
+
+Also seeds the cluster routability direction: `smembers` IS in the
+intake table's native-reads row, but registering it CMD_CTRL makes the
+slot router skip it while the C scanner still fast-paths it — exactly
+one `smembers:unroutable` finding on the decorator."""
+
+CMD_READONLY = 1
+CMD_CTRL = 4
 
 
 def register(name, flags=0, families=()):
@@ -52,3 +60,9 @@ def sadd_command(node, ctx, args):
 @serve_plan("sadd")
 def _plan_sadd(coal, items):
     return None
+
+
+@register("smembers", CMD_READONLY | CMD_CTRL)
+def smembers_command(node, ctx, args):
+    key = args.next_bytes()
+    return node.ks.members(key)

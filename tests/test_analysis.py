@@ -87,9 +87,11 @@ def test_corpus_expectations(corpus_findings):
     # REC_BATCH stay silent
     nc = by["NATIVE-CONTRACT"]
     assert {f.token for f in nc} == \
-        {"zadd", "aof:frame:drift", "aof:chunk:missing-from-table",
-         "aof:wmark:unknown-record-type"}
+        {"zadd", "smembers:unroutable", "aof:frame:drift",
+         "aof:chunk:missing-from-table", "aof:wmark:unknown-record-type"}
     assert [f.qualname for f in nc if f.token == "zadd"] == ["_plan_zadd"]
+    assert [f.qualname for f in nc if f.token.endswith(":unroutable")] \
+        == ["smembers_command"]
     # AWAIT-ATOMICITY: the PR 2 close-window and PR 12 quiesce-callback
     # race shapes; the post-fix re-reading forms and the pinned
     # deliberate snapshot stay silent
@@ -97,6 +99,13 @@ def test_corpus_expectations(corpus_findings):
     assert {f.token for f in aa} == {"links", "pend"}
     assert {f.qualname.rsplit(".", 1)[-1] for f in aa} == \
         {"close_bad", "quiesce_bad"}
+    # SLOT-EPOCH: the cached-epoch ownership flip; the re-reading and
+    # pinned forms stay silent, and the general AWAIT-ATOMICITY rule
+    # does not cover cluster/ (the specialization owns that dir)
+    se = by["SLOT-EPOCH"]
+    assert {f.token for f in se} == {"epoch"}
+    assert [f.qualname for f in se] == ["flip_bad"]
+    assert not any(f.path.startswith("cluster") for f in aa)
     # CUT-ORDERING: the PR 11 consistency-cut shape (export awaited
     # before the watermark capture), incl. the some-path branchy case;
     # the capture-first forms stay silent

@@ -1,0 +1,479 @@
+"""Cluster mode (constdb_tpu/cluster): slot math, routing, migration,
+and the off-means-off wire pins.
+
+The load-bearing identities under test (docs/INVARIANTS.md "Slot
+ownership laws"):
+
+  * slot == digest bucket under the canonical 64x256 geometry, so the
+    digest plane's per-bucket exports/digests ARE the per-slot ones;
+  * the four-way routing contract (None | MOVED | ASK | import-serve),
+    with the redirect minting no uuid and replicating nothing;
+  * a live migration flips ownership only behind the digest fixpoint,
+    releases its GC pin, and leaves both groups on the same epoch;
+  * CONSTDB_CLUSTER=0 (the default) and legacy peers see byte-exact
+    pre-cluster replication streams — zero CLUSTERTAB frames, no
+    CAP_CLUSTER bit (replica/link.py points here for that pin).
+"""
+
+import asyncio
+import os
+import sys
+import types
+import zlib
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_link_pushloop import (_log_write, _SharedDumpStub,  # noqa: E402
+                                _Writer)
+
+from constdb_tpu.cluster import (NSLOTS, SLOT_FANOUT,  # noqa: E402
+                                 SLOT_LEAVES, ClusterState, SlotTable,
+                                 bucket_of_slot, even_split, slot_of)
+from constdb_tpu.replica.link import (CAP_CLUSTER,  # noqa: E402
+                                      CAP_FULLSYNC_RESET, MY_CAPS,
+                                      ReplicaLink, my_caps)
+from constdb_tpu.replica.manager import ReplicaMeta  # noqa: E402
+from constdb_tpu.resp.codec import make_parser  # noqa: E402
+from constdb_tpu.resp.message import (Arr, Bulk, Err, Int,  # noqa: E402
+                                      as_bytes, as_int)
+from constdb_tpu.server.commands import execute  # noqa: E402
+from constdb_tpu.server.node import Node  # noqa: E402
+
+ADDRS = ["127.0.0.1:7100", "127.0.0.1:7101"]
+
+
+def _key_for_group(gid: int, prefix: bytes = b"k") -> bytes:
+    """A key the even 2-group split assigns to `gid`."""
+    j = 0
+    while True:
+        k = prefix + b"%d" % j
+        if (slot_of(k) < NSLOTS // 2) == (gid == 0):
+            return k
+        j += 1
+
+
+def _two_group_state(my_gid: int = 0) -> ClusterState:
+    return ClusterState(my_gid, even_split(2, addrs=ADDRS))
+
+
+# --------------------------------------------------------------- slot math
+
+
+def test_slot_of_is_the_digest_crc():
+    for k in (b"a", b"foo", b"k%d" % 12345, b"\x00\xff" * 9):
+        assert slot_of(k) == zlib.crc32(k) % NSLOTS
+
+
+def test_bucket_of_slot_is_a_bijection():
+    assert sorted(bucket_of_slot(s) for s in range(NSLOTS)) == \
+        list(range(NSLOTS))
+    assert SLOT_FANOUT * SLOT_LEAVES == NSLOTS
+
+
+def test_slot_is_one_digest_cell():
+    """A single write perturbs exactly its slot's cell of the 64x256
+    digest matrix — the identity the migration fixpoint stands on."""
+    from constdb_tpu.store.digest import state_digest_matrix
+    node = Node(node_id=1)
+    key = b"cellkey7"
+    execute(node, Arr([Bulk(b"set"), Bulk(key), Bulk(b"v")]))
+    node.ensure_flushed()
+    mat = state_digest_matrix(node.ks, SLOT_FANOUT, SLOT_LEAVES).reshape(-1)
+    hot = [i for i in range(NSLOTS) if int(mat[i]) != 0]
+    assert hot == [bucket_of_slot(slot_of(key))]
+
+
+def test_slot_export_carries_exactly_the_slot():
+    """export_slot_batch ships the slot's keys (and nothing else) and
+    merges into a fresh node — the migration payload path."""
+    from constdb_tpu.cluster.migrate import export_slot_batch
+    node = Node(node_id=1)
+    key, other = b"exp0", None
+    for j in range(1, 200):
+        other = b"exp%d" % j
+        if slot_of(other) != slot_of(key):
+            break
+    execute(node, Arr([Bulk(b"set"), Bulk(key), Bulk(b"inslot")]))
+    execute(node, Arr([Bulk(b"set"), Bulk(other), Bulk(b"outside")]))
+    sink = Node(node_id=2)
+    sink.merge_batches([export_slot_batch(node, slot_of(key))])
+    canon = sink.canonical()
+    assert key in canon and other not in canon
+
+
+def test_slot_table_codec_roundtrip():
+    t = even_split(3, addrs=ADDRS + ["127.0.0.1:7102"])
+    t.assign(100, 200, 2)
+    t.epoch = 9
+    back = SlotTable.deserialize(t.serialize())
+    assert back.epoch == 9
+    assert list(back.owner) == list(t.owner)
+    assert back.groups == t.groups
+    assert back.ranges() == t.ranges()
+
+
+def test_even_split_covers_everything():
+    for n in (1, 2, 3, 5):
+        t = even_split(n)
+        assert sorted({g for _, _, g in t.ranges()}) == list(range(n))
+        assert sum(b - a + 1 for a, b, _ in t.ranges()) == NSLOTS
+
+
+# ----------------------------------------------------------------- routing
+
+
+def test_route_four_way_contract():
+    cl = _two_group_state(0)
+    mine, theirs = _key_for_group(0), _key_for_group(1)
+    # owned, not migrating: serve locally
+    assert cl.route(mine) is None
+    # not owned: MOVED with the owner's address
+    r = cl.route(theirs)
+    assert isinstance(r, Err)
+    assert r.val == b"MOVED %d %s" % (slot_of(theirs), ADDRS[1].encode())
+    # owned but mid-handoff: ASK at the migration target
+    cl.migrating[slot_of(mine)] = "127.0.0.1:9999"
+    r = cl.route(mine)
+    assert r.val == b"ASK %d 127.0.0.1:9999" % slot_of(mine)
+    assert cl.redirects_sent == 2
+    # the target side serves a slot it is importing, table or no table
+    imp = ClusterState(1, even_split(2, addrs=ADDRS))
+    assert isinstance(imp.route(mine), Err)
+    imp.importing[slot_of(mine)] = ADDRS[0]
+    assert imp.route(mine) is None
+
+
+def test_needs_redirect_is_counter_free():
+    cl = _two_group_state(0)
+    theirs = _key_for_group(1)
+    assert cl.needs_redirect(theirs) and not cl.needs_redirect(
+        _key_for_group(0))
+    assert cl.redirects_sent == 0
+
+
+def test_adopt_only_strictly_newer_and_merges_addrs():
+    cl = _two_group_state(0)
+    same = even_split(2)
+    assert not cl.adopt(same)  # equal epoch: refused
+    newer = even_split(2)
+    newer.epoch = 5
+    newer.groups = {1: "127.0.0.1:9001"}  # no address for group 0
+    assert cl.adopt(newer)
+    assert cl.epoch == 5
+    # locally-known address survives the adoption
+    assert cl.table.groups[0] == ADDRS[0]
+    assert cl.table.groups[1] == "127.0.0.1:9001"
+
+
+def test_execute_redirects_before_any_state():
+    node = Node(node_id=1)
+    node.cluster = _two_group_state(0)
+    theirs, mine = _key_for_group(1), _key_for_group(0)
+    hlc0 = node.hlc.current
+    log0 = node.repl_log.last_uuid
+    r = execute(node, Arr([Bulk(b"set"), Bulk(theirs), Bulk(b"v")]))
+    assert isinstance(r, Err) and r.val.startswith(b"MOVED ")
+    # reads route identically
+    r = execute(node, Arr([Bulk(b"get"), Bulk(theirs)]))
+    assert isinstance(r, Err) and r.val.startswith(b"MOVED ")
+    # a redirect mints no uuid, applies nothing, replicates nothing
+    assert node.hlc.current == hlc0
+    assert node.repl_log.last_uuid == log0
+    assert theirs not in node.canonical()
+    assert node.cluster.redirects_sent == 2
+    # owned keys execute normally
+    execute(node, Arr([Bulk(b"set"), Bulk(mine), Bulk(b"v")]))
+    assert mine in node.canonical()
+    # control-plane commands never route (shard_routable gate)
+    r = execute(node, Arr([Bulk(b"cluster"), Bulk(b"info")]))
+    assert b"cluster_enabled:1" in as_bytes(r)
+
+
+def test_replication_path_never_routes():
+    """Replicated ops are group-scoped by construction (the writer
+    routed); apply_replicated must land them even for foreign slots."""
+    node = Node(node_id=1)
+    node.cluster = _two_group_state(0)
+    theirs = _key_for_group(1)
+    node.apply_replicated(b"set", [Bulk(theirs), Bulk(b"v")], 2,
+                          node.hlc.tick(True))
+    assert theirs in node.canonical()
+
+
+def test_cluster_off_serves_every_slot():
+    node = Node(node_id=1)
+    assert node.cluster is None
+    for gid in (0, 1):
+        k = _key_for_group(gid)
+        execute(node, Arr([Bulk(b"set"), Bulk(k), Bulk(b"v")]))
+        assert k in node.canonical()
+
+
+# ------------------------------------------------------------------ GC pin
+
+
+def test_gc_horizon_clamped_by_migration_pin():
+    node = Node(node_id=1)
+    cl = _two_group_state(0)
+    node.cluster = cl
+    execute(node, Arr([Bulk(b"set"), Bulk(b"gk"), Bulk(b"v")]))
+    free = node.gc_horizon()
+    assert free == node.hlc.current  # standalone: own clock
+    cl.pin_gc(7)
+    cl.pin_gc(12)  # lowest pin wins
+    assert node.gc_horizon() == 7
+    cl.migrating[3] = "x"
+    cl.unpin_gc()  # refused: a window is still open
+    assert node.gc_horizon() == 7
+    cl.migrating.clear()
+    cl.unpin_gc()
+    assert cl.gc_pin() is None
+    assert node.gc_horizon() == node.hlc.current
+
+
+# ----------------------------------------------------- observability arms
+
+
+def test_cluster_slots_and_info_sections():
+    node = Node(node_id=1)
+    node.cluster = _two_group_state(0)
+    r = execute(node, Arr([Bulk(b"cluster"), Bulk(b"slots")]))
+    rows = [(as_int(row.items[0]), as_int(row.items[1]),
+             as_int(row.items[2]), as_bytes(row.items[3]))
+            for row in r.items]
+    assert rows == [(0, NSLOTS // 2 - 1, 0, ADDRS[0].encode()),
+                    (NSLOTS // 2, NSLOTS - 1, 1, ADDRS[1].encode())]
+    info = as_bytes(execute(node, Arr([Bulk(b"info"), Bulk(b"cluster")])))
+    for want in (b"cluster_enabled:1", b"cluster_group:0",
+                 b"cluster_epoch:1", b"slots_owned:%d" % (NSLOTS // 2),
+                 b"migrations_out:0", b"redirects_sent:"):
+        assert want in info, want
+    off = Node(node_id=2)
+    assert b"cluster_enabled:0" in as_bytes(
+        execute(off, Arr([Bulk(b"info"), Bulk(b"cluster")])))
+    assert b"cluster_enabled:0" in as_bytes(
+        execute(off, Arr([Bulk(b"cluster"), Bulk(b"info")])))
+
+
+# ------------------------------------------- off-means-off wire pins
+
+
+def _fixed_clock():
+    t = [1_700_000_000_000]
+
+    def clock() -> int:
+        t[0] += 1
+        return t[0]
+    return clock
+
+
+def _stream_link(tmp_path, cluster: bool):
+    """A push-loop link over a deterministic node: fixed HLC clock +
+    identical writes, so two nodes differing ONLY in cluster mode must
+    produce byte-identical streams to a legacy peer."""
+    node = Node(node_id=1, repl_log_cap=100_000, clock=_fixed_clock())
+    if cluster:
+        node.cluster = _two_group_state(0)
+    for i in range(25):
+        _log_write(node, i)
+    app = types.SimpleNamespace(node=node, heartbeat=0.05,
+                                reconnect_delay=0.05,
+                                handshake_timeout=1.0,
+                                work_dir=str(tmp_path))
+    app.shared_dump = _SharedDumpStub(node, str(tmp_path))
+    return node, ReplicaLink(app, ReplicaMeta(addr="127.0.0.1:1"))
+
+
+async def _pump(link, caps: int) -> bytes:
+    writer = _Writer()
+    link._peer_caps = caps
+    task = asyncio.create_task(link._push_loop(writer, peer_resume=0))
+    try:
+        for _ in range(400):
+            await asyncio.sleep(0.01)
+            if b"k24" in writer.buf:  # the last logged write streamed
+                break
+    finally:
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+    assert b"k24" in writer.buf
+    return bytes(writer.buf)
+
+
+def _frame_kinds(buf: bytes) -> list[bytes]:
+    parser = make_parser()
+    parser.feed(buf)
+    kinds = []
+    while (msg := parser.next_msg()) is not None:
+        kinds.append(as_bytes(msg.items[0]).lower())
+    return kinds
+
+
+def test_cap_cluster_outside_my_caps():
+    assert not (MY_CAPS & CAP_CLUSTER)
+    on, off = Node(node_id=1), Node(node_id=2)
+    on.cluster = _two_group_state(0)
+    assert my_caps(types.SimpleNamespace(node=on)) & CAP_CLUSTER
+    assert not my_caps(types.SimpleNamespace(node=off)) & CAP_CLUSTER
+
+
+def test_legacy_peer_stream_is_byte_exact(tmp_path):
+    """The pin replica/link.py names: a cluster-ON node pushing to a
+    peer WITHOUT CAP_CLUSTER writes the byte-identical stream a
+    CONSTDB_CLUSTER=0 node would — zero CLUSTERTAB frames, nothing
+    reordered or resized around them.  REPLACK heartbeats carry real
+    wall time (link.py now_ms()) and are filtered before the compare —
+    every other frame must match byte-for-byte."""
+    from constdb_tpu.resp.codec import encode_msg
+
+    def data_frames(buf: bytes) -> list[bytes]:
+        parser = make_parser()
+        parser.feed(buf)
+        out = []
+        while (msg := parser.next_msg()) is not None:
+            if as_bytes(msg.items[0]).lower() != b"replack":
+                out.append(encode_msg(msg))
+        return out
+
+    async def main():
+        _, link_on = _stream_link(tmp_path, cluster=True)
+        _, link_off = _stream_link(tmp_path, cluster=False)
+        buf_on = await _pump(link_on, CAP_FULLSYNC_RESET)
+        buf_off = await _pump(link_off, CAP_FULLSYNC_RESET)
+        assert b"clustertab" not in buf_on
+        frames_on, frames_off = data_frames(buf_on), data_frames(buf_off)
+        n = min(len(frames_on), len(frames_off))
+        assert n >= 26  # partsync + the 25 replicate frames
+        assert frames_on[:n] == frames_off[:n]
+    asyncio.run(main())
+
+
+def test_cluster_peer_gets_one_clustertab_per_epoch(tmp_path):
+    async def main():
+        node, link = _stream_link(tmp_path, cluster=True)
+        buf = await _pump(link, CAP_FULLSYNC_RESET | CAP_CLUSTER)
+        kinds = _frame_kinds(buf)
+        assert kinds.count(b"clustertab") == 1
+        parser = make_parser()
+        parser.feed(buf)
+        while (msg := parser.next_msg()) is not None:
+            if as_bytes(msg.items[0]).lower() == b"clustertab":
+                assert as_int(msg.items[1]) == node.cluster.epoch
+                table = SlotTable.deserialize(as_bytes(msg.items[2]))
+                assert table.serialize() == node.cluster.table.serialize()
+    asyncio.run(main())
+
+
+class _EOFReader:
+    async def read(self, n: int) -> bytes:
+        return b""
+
+
+def _feed_clustertab(table: SlotTable):
+    from constdb_tpu.resp.codec import encode_msg
+    parser = make_parser()
+    parser.feed(encode_msg(Arr([Bulk(b"clustertab"), Int(table.epoch),
+                                Bulk(table.serialize())])))
+    return parser
+
+
+def test_clustertab_on_disabled_node_is_a_protocol_error(tmp_path):
+    """A CONSTDB_CLUSTER=0 node never advertised CAP_CLUSTER; a
+    CLUSTERTAB frame arriving anyway is a capability mismatch and must
+    be rejected loudly, not half-adopted."""
+    from constdb_tpu.errors import CstError
+    _, link = _stream_link(tmp_path, cluster=False)
+    parser = _feed_clustertab(even_split(2, addrs=ADDRS))
+
+    async def main():
+        with pytest.raises(CstError, match="non-cluster"):
+            await link._pull_frames(
+                _EOFReader(), None, parser,
+                types.SimpleNamespace(pending=False))
+    asyncio.run(main())
+
+
+def test_clustertab_pull_adopts_strictly_newer(tmp_path):
+    node, link = _stream_link(tmp_path, cluster=True)
+    newer = even_split(2, addrs=ADDRS)
+    newer.epoch = 5
+    stale = even_split(2, addrs=ADDRS)  # epoch 1 == current: refused
+
+    async def main():
+        for table, want_epoch in ((newer, 5), (stale, 5)):
+            with pytest.raises(ConnectionError):
+                await link._pull_frames(
+                    _EOFReader(), None, _feed_clustertab(table),
+                    types.SimpleNamespace(pending=False))
+            assert node.cluster.epoch == want_epoch
+    asyncio.run(main())
+
+
+# --------------------------------------------------------- migration e2e
+
+
+def test_slot_migration_end_to_end(tmp_path):
+    """Two served single-node groups, a live migration of one slot:
+    ownership flips behind the digest fixpoint, both groups land on the
+    bumped epoch, the data serves from the new owner, the old owner
+    redirects, counters tick, and the GC pins release."""
+    from constdb_tpu.chaos.cluster import Client
+    from constdb_tpu.chaos.cluster_cells import (RedirectClient,
+                                                 _migrate, _seed_addrs,
+                                                 _specs)
+    from constdb_tpu.chaos.cluster import ChaosCluster
+
+    async def main():
+        cluster = ChaosCluster(str(tmp_path), 11, _specs())
+        await cluster.start()
+        rc = RedirectClient()
+        try:
+            await _seed_addrs(cluster)
+            addr0 = cluster.apps[0].advertised_addr
+            addr1 = cluster.apps[1].advertised_addr
+            node0, node1 = cluster.apps[0].node, cluster.apps[1].node
+            key = _key_for_group(0, b"mig")
+            slot = slot_of(key)
+            await rc.cmd(addr0, b"set", key, b"payload")
+            await rc.cmd(addr0, b"sadd", key + b":s", b"a", b"b")
+            assert await _migrate(cluster, 0, slot, addr1), \
+                "migration never flipped ownership"
+            assert not node0.cluster.owns(slot)
+            assert node1.cluster.owns(slot)
+            # both sides on the same bumped epoch (finalize reply
+            # adoption — no repl link exists between the groups)
+            assert node0.cluster.epoch == node1.cluster.epoch == 2
+            # the data answers at the new owner; the old owner redirects
+            c1 = await Client().connect(addr1)
+            try:
+                assert as_bytes(await c1.cmd(b"get", key)) == b"payload"
+            finally:
+                await c1.close()
+            c0 = await Client().connect(addr0)
+            try:
+                r = await c0.cmd(b"get", key)
+                assert isinstance(r, Err)
+                assert r.val == b"MOVED %d %s" % (slot, addr1.encode())
+            finally:
+                await c0.close()
+            # the redirect-following client still reads through node 0
+            assert as_bytes(await rc.cmd(addr0, b"get", key)) == b"payload"
+            # counters + pins
+            assert node0.cluster.migrations_out == 1
+            assert node1.cluster.migrations_in == 1
+            assert node0.cluster.gc_pin() is None
+            assert node1.cluster.gc_pin() is None
+            assert not node0.cluster.migrating
+            assert not node1.cluster.importing
+            info = as_bytes(await rc.cmd(addr0, b"info", b"cluster"))
+            assert b"migrations_out:1" in info
+        finally:
+            await rc.close()
+            await cluster.close()
+    asyncio.run(main())

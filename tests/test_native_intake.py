@@ -276,6 +276,41 @@ def test_oom_shed_parity():
     assert na.canonical() == nb.canonical()
 
 
+# ----------------------------------------------- cluster redirect parity
+
+def _install_cluster(node):
+    """Group 0 of a 2-group even split, with the first owned workload
+    key's slot mid-handoff — so the differential stream carries local
+    serves, MOVED redirects, and ASK redirects at once."""
+    from constdb_tpu.cluster import ClusterState, even_split, slot_of
+    cl = ClusterState(0, even_split(
+        2, addrs=["127.0.0.1:7100", "127.0.0.1:7101"]))
+    for i in range(8):
+        s = slot_of(b"k%d" % i)
+        if cl.owns(s):
+            cl.migrating[s] = "127.0.0.1:7101"
+            break
+    node.cluster = cl
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_native_redirect_byte_parity(seed):
+    """Cluster routing differential: with half the keyspace foreign and
+    one owned slot in its ASK window, the native-opcode path and the
+    pure planner path emit byte-identical MOVED/ASK redirect streams,
+    identical surviving state, and the identical redirects_sent count
+    (the serve-plan demotion probe is counter-free; only execute()
+    counts)."""
+    chunks = mixed_chunks(seed)
+    na, ra = run_pure(chunks, setup=_install_cluster)
+    nb, rb = run_native(chunks, setup=_install_cluster)
+    assert b"MOVED " in ra and b"ASK " in ra
+    assert ra == rb
+    assert na.canonical() == nb.canonical()
+    assert logview(na) == logview(nb)
+    assert na.cluster.redirects_sent == nb.cluster.redirects_sent > 0
+
+
 # ------------------------------------------------------------- abi stamp
 
 def test_abi_stamp_matches_sources():
