@@ -11,13 +11,15 @@ emit-only-durable law across the move).
 
 Observability arms (INFO / SLOTS / SLOTDIGEST) answer on any node;
 mutation arms require cluster mode on.  The migration wire protocol
-(SETSLOT IMPORTING -> IMPORT chunks -> SLOTDIGEST -> FINALIZE) is
-driven by cluster/migrate.py on the source."""
+(SETSLOT IMPORTING -> IMPORT chunks -> SLOTDIGEST -> FINALIZE, with
+SETSLOT STABLE + SLOTEXPORT as the abort legs) is driven by
+cluster/migrate.py on the source."""
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from ..errors import CstError, UnknownSubCmd
 from ..resp.message import Arr, Bulk, Err, Int, OK
@@ -60,23 +62,31 @@ def cluster_command(node, ctx, args):
         # enough).  No epoch bump: addresses are not ownership.
         gid = args.next_int()
         cl.table.groups[gid] = args.next_str()
+        cl.rev += 1  # gossip re-broadcasts the learned address
         return OK
     if sub == b"setslot":
         slot = _slot_arg(args)
         verb = args.next_bytes().lower()
+        if verb == b"stable":
+            # the source's abort verb: close the import window (mark,
+            # chunk buffer, staleness stamp, GC pin) whether or not one
+            # is open — idempotent, so retries and the staleness sweep
+            # can race it safely.  From here redirected traffic bounces
+            # MOVED back at the settled owner instead of being acked
+            # into a window that will never finalize.
+            cl.drop_import(slot)
+            return OK
         if verb != b"importing":
             raise UnknownSubCmd(f"setslot {verb.decode('utf-8', 'replace')}")
         args.next_int()  # source epoch (diagnostic; flip is epoch-gated
         #                  by FINALIZE, not by this intake mark)
         source = args.next_str()
-        cl.importing[slot] = source
-        # a RETRIED migration (the first attempt's channel died mid-
-        # chunk) re-marks the slot; any partial chunk buffer from the
-        # dead attempt would corrupt the fresh stream's decode
-        cl._import_buf.pop(slot, None)
         # tombstone-GC pin mirrors the source's: nothing collected on
-        # the target either while the slot's story is still arriving
-        cl.pin_gc(node.hlc.current)
+        # the target either while the slot's story is still arriving.
+        # A RETRIED migration re-marks the slot: the buffer resets (a
+        # partial chunk from the dead attempt would corrupt the fresh
+        # stream's decode) but the pin does NOT stack (open_import).
+        cl.open_import(slot, source, node.hlc.current, time.monotonic())
         return OK
     if sub == b"import":
         slot = _slot_arg(args)
@@ -84,6 +94,7 @@ def cluster_command(node, ctx, args):
         chunk = args.next_bytes()
         if slot not in cl.importing:
             return Err(b"IMPORT for a slot not marked importing")
+        cl.touch_import(slot, time.monotonic())
         buf = cl._import_buf.setdefault(slot, bytearray())
         buf += chunk
         if more:
@@ -96,22 +107,53 @@ def cluster_command(node, ctx, args):
         # lands through the same engine seam snapshot ingest uses
         node.merge_batches([batch])
         return Int(len(payload))
+    if sub == b"slotexport":
+        # the reverse leg of the source's abort path (cluster/migrate.py
+        # _reclaim_ask_window): chunked export of this node's copy of
+        # the slot, so a source aborting AFTER its ASK window opened can
+        # reclaim the writes only this node acknowledged.  Offset 0
+        # snapshots the encoded batch — every chunk of one export
+        # describes ONE state cut even while this node keeps serving —
+        # and the final chunk drops the snapshot.
+        slot = _slot_arg(args)
+        off = args.next_int()
+        maxb = max(1, args.next_int())
+        if off == 0:
+            from ..persist.snapshot import _encode_batch
+            from .migrate import export_slot_batch
+            cl._export_buf[slot] = bytes(
+                _encode_batch(export_slot_batch(node, slot)))
+        payload = cl._export_buf.get(slot)
+        if payload is None:
+            return Err(b"SLOTEXPORT at a nonzero offset without a "
+                       b"snapshot (restart from offset 0)")
+        chunk = payload[off:off + maxb]
+        more = 1 if off + len(chunk) < len(payload) else 0
+        if not more:
+            cl._export_buf.pop(slot, None)
+        return Arr([Int(more), Bulk(chunk)])
     if sub == b"finalize":
         slot = _slot_arg(args)
         if slot not in cl.importing:
             return Err(b"FINALIZE for a slot not marked importing")
         table = cl.table.copy()
-        table.assign(slot, slot + 1, cl.my_gid)
-        table.epoch += 1
+        # mint STRICTLY above every epoch this node knows, and stamp it
+        # on exactly the flipped slot: two concurrent migrations to
+        # different groups may still mint the same number, but adopt()'s
+        # per-slot (epoch, gid) join merges those tables instead of
+        # dropping one — no collision resolution protocol needed
+        epoch = table.epoch + 1
+        table.assign(slot, slot + 1, cl.my_gid, epoch=epoch)
+        table.epoch = epoch
         app = node.app
         if app is not None and getattr(app, "advertised_addr", None):
             table.groups[cl.my_gid] = app.advertised_addr
         # the atomic flip: table swap + import-window close together,
         # before the reply carrying the new table leaves this handler
         cl.table = table
-        cl.importing.pop(slot, None)
+        cl.rev += 1
+        cl.drop_import(slot)
         cl.migrations_in += 1
-        cl.unpin_gc()
         return Bulk(table.serialize())
     if sub == b"migrate":
         # source-side admin entry: schedule the async driver; progress

@@ -29,10 +29,20 @@ Safety laws (docs/INVARIANTS.md "Slot ownership laws"):
   * the GC horizon is pinned below the migration start for its whole
     duration (server/node.py gc_horizon), so a delete landing during
     the handoff is still present — as a tombstone — in the final
-    export, and the key cannot resurrect across the flip;
+    export, and the key cannot resurrect across the flip; the pin is
+    PER HOLDER (a multiset in ClusterState), so concurrent migrations
+    cannot release each other's clamps;
   * the import path merges state batches WITHOUT adopting watermarks
     and WITHOUT re-replication (CMD_NO_REPLICATE), so the emit-only-
-    durable law and the repl-log cursor discipline survive the move.
+    durable law and the repl-log cursor discipline survive the move;
+  * an abort is never silent on the target: before the window opened
+    the source sends SETSLOT STABLE (closing the target's import
+    window and GC pin); after the window opened it additionally
+    reverse-ships the slot via SLOTEXPORT (_reclaim_ask_window), so
+    writes the target acknowledged during the window land back on the
+    source before it resumes serving the slot.  A target whose source
+    dies without either leg drops the window itself after
+    CONSTDB_MIGRATE_STALL_S of silence (expire_stale_imports).
 """
 
 from __future__ import annotations
@@ -44,7 +54,7 @@ import numpy as np
 
 from ..errors import CstError
 from ..resp.codec import encode_msg, make_parser
-from ..resp.message import Arr, Bulk, Err, Int, as_bytes
+from ..resp.message import Arr, Bulk, Err, Int, as_bytes, as_int
 from .slots import NSLOTS, SLOT_FANOUT, SLOT_LEAVES, bucket_of_slot
 
 log = logging.getLogger(__name__)
@@ -137,12 +147,86 @@ async def _ship_slot(chan: _Chan, node, slot: int, chunk_bytes: int) -> int:
             return total
 
 
+async def _pull_slot_back(chan: _Chan, node, slot: int,
+                          chunk_bytes: int) -> None:
+    """The reverse IMPORT: SETSLOT STABLE freezes the target's window
+    (from then on redirected traffic bounces MOVED instead of being
+    acknowledged into it), then SLOTEXPORT chunks the target's copy of
+    the slot home, merged as state — no watermark adoption, the same
+    law the forward IMPORT obeys."""
+    await chan.call(b"cluster", b"setslot", b"%d" % slot, b"stable")
+    parts: list = []
+    off = 0
+    while True:
+        r = await chan.call(b"cluster", b"slotexport", b"%d" % slot,
+                            b"%d" % off, b"%d" % chunk_bytes)
+        more = as_int(r.items[0])
+        chunk = as_bytes(r.items[1])
+        parts.append(chunk)
+        off += len(chunk)
+        if not more:
+            break
+    payload = b"".join(parts)
+    if payload:
+        from ..persist.snapshot import _decode_batch
+        node.merge_batches([_decode_batch(payload)])
+
+
+async def _reclaim_ask_window(chan: _Chan, node, app, slot: int,
+                              target_addr: str, chunk_bytes: int,
+                              timeout: float) -> bool:
+    """Abort path for a migration whose ASK window already opened:
+    every write the target acknowledged during the window exists ONLY
+    there (there is deliberately no inter-group repl stream), so before
+    the source resumes serving the slot as settled owner it pulls the
+    slot back (_pull_slot_back).  Falls back to one fresh dial when the
+    migration channel is the thing that died.  If the target is
+    unreachable the acknowledged writes are NOT destroyed — they stay
+    merged in the target's keyspace, and the next migration attempt's
+    digest fixpoint re-converges them into the flip — but until then
+    they are invisible to clients, so the failure is logged loudly."""
+    try:
+        await _pull_slot_back(chan, node, slot, chunk_bytes)
+        return True
+    except Exception:
+        pass
+    try:
+        host, port = target_addr.rsplit(":", 1)
+        reader, writer = await asyncio.wait_for(
+            app.open_peer_connection(host, int(port)), timeout)
+        fresh = _Chan(reader, writer, timeout)
+        try:
+            await _pull_slot_back(fresh, node, slot, chunk_bytes)
+            return True
+        finally:
+            fresh.close()
+    except Exception as e:
+        log.warning(
+            "slot %d migration aborted after its ASK window opened and "
+            "the window's writes could not be reclaimed from %s (%s); "
+            "they remain merged on the target and the next migration "
+            "attempt re-converges them", slot, target_addr, e)
+        return False
+
+
+async def _release_target(chan: _Chan, slot: int) -> None:
+    """Pre-window abort: best-effort SETSLOT STABLE so the target drops
+    its import window and GC pin NOW instead of waiting out the
+    CONSTDB_MIGRATE_STALL_S staleness sweep."""
+    try:
+        await chan.call(b"cluster", b"setslot", b"%d" % slot, b"stable")
+    except Exception:
+        pass  # dead channel: the target's staleness sweep cleans up
+
+
 async def migrate_slot(node, app, slot: int, target_addr: str, *,
                        timeout: float = 30.0) -> dict:
     """Drive one slot's migration to `target_addr` (any member of the
     target group).  Returns {"slot", "bytes", "rounds", "epoch"} for the
     bench/ops surface.  Raises on any epoch race or digest mismatch —
-    ownership never flips on an unproven copy."""
+    ownership never flips on an unproven copy — after unwinding the
+    target's import window (and, if the ASK window already opened,
+    reclaiming the writes it acknowledged)."""
     cl = node.cluster
     if cl is None:
         raise CstError("cluster mode is off")
@@ -155,20 +239,30 @@ async def migrate_slot(node, app, slot: int, target_addr: str, *,
     epoch0 = cl.epoch
     # pin tombstone GC below every op the migration window can produce:
     # a delete landing mid-handoff must still be a visible tombstone in
-    # the final export (no-resurrection across the flip)
-    cl.pin_gc(node.hlc.current)
+    # the final export (no-resurrection across the flip).  The pin is
+    # held from HERE — before the first await — because the whole
+    # dial/bulk/catch-up phase needs it, and it is this migration's own
+    # token: releasing it cannot disturb a concurrent move's pin.
+    pin = cl.pin_gc(node.hlc.current)
     chunk_bytes = migrate_batch_bytes(app)
     host, port = target_addr.rsplit(":", 1)
     shipped = rounds = 0
-    reader, writer = await asyncio.wait_for(
-        app.open_peer_connection(host, int(port)), timeout)
+    try:
+        reader, writer = await asyncio.wait_for(
+            app.open_peer_connection(host, int(port)), timeout)
+    except BaseException:
+        cl.unpin_gc(pin)
+        raise
     chan = _Chan(reader, writer, timeout)
+    marked = False        # target told SETSLOT IMPORTING
+    window_open = False   # ASK window: client writes drain to target
     try:
         if node.cluster is not cl or cl.epoch != epoch0:
             raise CstError("slot table changed while dialing; aborting")
         await chan.call(b"cluster", b"setslot", b"%d" % slot,
                         b"importing", b"%d" % epoch0,
                         app.advertised_addr.encode())
+        marked = True
         # bulk + catch-up rounds while still serving the slot
         for _ in range(1 + _CATCHUP_ROUNDS):
             if node.cluster is not cl or cl.epoch != epoch0:
@@ -177,57 +271,72 @@ async def migrate_slot(node, app, slot: int, target_addr: str, *,
             rounds += 1
         if node.cluster is not cl or cl.epoch != epoch0:
             raise CstError("slot table changed mid-migration; aborting")
-        # ASK handoff window: from here every new client write on the
+        # ASK handoff window: from here every new client WRITE on the
         # slot redirects to the target, so the final export is the
-        # whole remaining story
+        # whole remaining story (reads keep serving locally — the
+        # source copy stays complete until the flip)
         cl.migrating[slot] = target_addr
-        try:
-            # convergence certificate: the flip is safe iff the target
-            # holds EVERYTHING the (now frozen — ASK redirects all new
-            # writes) source copy holds.  The target may legally hold
-            # MORE (ASK-window writes land there), so source-vs-target
-            # digest equality is the wrong test; instead we use CRDT
-            # idempotence as a fixpoint probe — if re-merging the
-            # slot's full export leaves the target's per-slot digest
-            # unchanged, the export was a no-op and target >= source.
-            for attempt in range(_DIGEST_RETRIES):
-                if node.cluster is not cl or cl.epoch != epoch0:
-                    raise CstError(
-                        "slot table changed mid-handoff; aborting")
-                before = int(as_bytes(await chan.call(
-                    b"cluster", b"slotdigest", b"%d" % slot)))
-                shipped += await _ship_slot(chan, node, slot, chunk_bytes)
-                rounds += 1
-                after = int(as_bytes(await chan.call(
-                    b"cluster", b"slotdigest", b"%d" % slot)))
-                if after == before:
-                    break
-            else:
-                raise CstError(
-                    f"slot {slot} digest never reached its fixpoint on "
-                    f"{target_addr} after {_DIGEST_RETRIES} deltas")
+        window_open = True
+        # convergence certificate: the flip is safe iff the target
+        # holds EVERYTHING the (now frozen — ASK redirects all new
+        # writes) source copy holds.  The target may legally hold
+        # MORE (ASK-window writes land there), so source-vs-target
+        # digest equality is the wrong test; instead we use CRDT
+        # idempotence as a fixpoint probe — if re-merging the
+        # slot's full export leaves the target's per-slot digest
+        # unchanged, the export was a no-op and target >= source.
+        for attempt in range(_DIGEST_RETRIES):
             if node.cluster is not cl or cl.epoch != epoch0:
-                raise CstError("slot table changed pre-finalize; aborting")
-            # the flip: the target assigns itself the slot at a bumped
-            # epoch and returns the table; adopting it atomically turns
-            # our ASK window into a plain MOVED
-            reply = await chan.call(b"cluster", b"finalize", b"%d" % slot)
-            from .slots import SlotTable
-            table = SlotTable.deserialize(as_bytes(reply))
-            if table.epoch <= epoch0 or \
-                    table.owner[slot] == cl.my_gid:
-                raise CstError("finalize returned a non-advancing table")
-        finally:
-            cl.migrating.pop(slot, None)
+                raise CstError(
+                    "slot table changed mid-handoff; aborting")
+            before = int(as_bytes(await chan.call(
+                b"cluster", b"slotdigest", b"%d" % slot)))
+            shipped += await _ship_slot(chan, node, slot, chunk_bytes)
+            rounds += 1
+            after = int(as_bytes(await chan.call(
+                b"cluster", b"slotdigest", b"%d" % slot)))
+            if after == before:
+                break
+        else:
+            raise CstError(
+                f"slot {slot} digest never reached its fixpoint on "
+                f"{target_addr} after {_DIGEST_RETRIES} deltas")
+        if node.cluster is not cl or cl.epoch != epoch0:
+            raise CstError("slot table changed pre-finalize; aborting")
+        # the flip: the target assigns itself the slot at a bumped
+        # epoch and returns the table; adopting it atomically turns
+        # our ASK window into a plain MOVED (adopt BEFORE the window
+        # closes — no gap where this node serves the slot as settled
+        # owner)
+        reply = await chan.call(b"cluster", b"finalize", b"%d" % slot)
+        from .slots import SlotTable
+        table = SlotTable.deserialize(as_bytes(reply))
+        if table.epoch <= epoch0 or \
+                table.owner[slot] == cl.my_gid:
+            raise CstError("finalize returned a non-advancing table")
         cl.adopt(table)
+        cl.migrating.pop(slot, None)
+        window_open = False
         cl.migrations_out += 1
         log.info("slot %d migrated to %s: %d bytes over %d rounds, "
                  "epoch %d -> %d", slot, target_addr, shipped, rounds,
                  epoch0, table.epoch)
         return {"slot": slot, "bytes": shipped, "rounds": rounds,
                 "epoch": table.epoch}
+    except BaseException:
+        if window_open:
+            # stop redirecting first (new writes stay local and are
+            # CRDT-safe against the pull-back), then reclaim what the
+            # target acknowledged while the window was open
+            cl.migrating.pop(slot, None)
+            await _reclaim_ask_window(chan, node, app, slot,
+                                      target_addr, chunk_bytes, timeout)
+        elif marked:
+            await _release_target(chan, slot)
+        raise
     finally:
-        cl.unpin_gc()
+        cl.migrating.pop(slot, None)
+        cl.unpin_gc(pin)
         chan.close()
 
 

@@ -17,31 +17,43 @@ certified by the same digest the delta-sync plane already trusts.
 
 Routing contract (server/commands.py execute + server/serve.py): every
 data command is FIRST-KEY-CONFINED (the KEY-CONFINED lint rule pins
-this statically), so ``ClusterState.route(key)`` decides from the first
-argument alone:
+this statically), so ``ClusterState.route(key, is_write)`` decides from
+the first argument alone:
 
     owned, not migrating      -> None               (serve locally)
-    owned, slot mid-handoff   -> -ASK <slot> <addr>  (writes drain to
-                                                      the target during
-                                                      the handoff window)
+    owned, slot mid-handoff   -> writes: -ASK <slot> <addr> (they drain
+                                 to the target, so the final delta is
+                                 the whole remaining story); reads:
+                                 None (the source's copy holds every
+                                 write the source ever acknowledged,
+                                 while the target may still lack the
+                                 final delta — redirecting a read there
+                                 could un-read a committed write)
     not owned, slot importing -> None               (serve: the ASK
                                                       target side)
     not owned                 -> -MOVED <slot> <addr>
 
-Ownership is EPOCH-GATED: the table only ever adopts a peer's table at
-a strictly higher epoch (adopt()), and every migration finalize bumps
-the epoch exactly once, so a stale owner converges to redirecting at
-its first gossip exchange and two groups never both serve a slot at
-the same epoch."""
+Ownership is EPOCH-GATED per slot: every assignment carries the epoch
+it was minted at (``SlotTable.slot_epoch``), a migration FINALIZE mints
+``max(known)+1`` for exactly its slot, and ``adopt()`` is a per-slot
+JOIN — higher ``(epoch, gid)`` wins, gid as the deterministic
+tie-break — so two tables minted concurrently at the same epoch MERGE
+(both flips survive, any exchange order converges) instead of racing
+on who gossips first, and a stale owner converges to redirecting at
+its first exchange."""
 
 from __future__ import annotations
 
 import json
+import logging
 import zlib
 from array import array
 from typing import Optional
 
+from ..conf import env_int
 from ..resp.message import Err
+
+log = logging.getLogger(__name__)
 
 NSLOTS = 16384
 # the canonical digest geometry under which slot == bucket (module doc)
@@ -68,27 +80,40 @@ class SlotTable:
     ``owner[slot]`` is a group id (gid); ``groups`` maps gid to the
     group's advertised client address ("host:port" — any member of the
     group; redirects land on it and its mesh replicates inside the
-    group).  ``epoch`` totally orders tables: higher epoch wins,
-    unconditionally, everywhere (adopt below).  A single-group table
-    (every slot owned by gid 0) is the legacy picture — what a
-    CONSTDB_CLUSTER=0 node, or any pre-cluster peer, implicitly holds."""
+    group).  ``slot_epoch[slot]`` is the epoch the slot's CURRENT
+    assignment was minted at (Redis configEpoch, per slot): adoption
+    joins tables per slot on ``(slot_epoch, gid)``, so concurrent
+    migrations to different groups can mint the same epoch without the
+    meshes diverging — both flips survive the merge.  ``epoch`` is the
+    highest mint this table has seen (``max(slot_epoch)``); FINALIZE
+    mints from it.  A single-group table (every slot owned by gid 0)
+    is the legacy picture — what a CONSTDB_CLUSTER=0 node, or any
+    pre-cluster peer, implicitly holds."""
 
-    __slots__ = ("epoch", "owner", "groups")
+    __slots__ = ("epoch", "owner", "groups", "slot_epoch")
 
-    def __init__(self, epoch: int = 0, owner=None, groups=None):
+    def __init__(self, epoch: int = 0, owner=None, groups=None,
+                 slot_epoch=None):
         self.epoch = epoch
         self.owner = owner if owner is not None \
             else array("i", bytes(4 * NSLOTS))
+        self.slot_epoch = slot_epoch if slot_epoch is not None \
+            else array("q", [epoch]) * NSLOTS
         self.groups: dict[int, str] = dict(groups) if groups else {}
 
     def owner_of(self, slot: int) -> int:
         return self.owner[slot]
 
-    def assign(self, start: int, stop: int, gid: int) -> None:
-        """Assign slots [start, stop) to gid (no epoch change — callers
-        bump once per atomic ownership flip)."""
+    def assign(self, start: int, stop: int, gid: int,
+               epoch: Optional[int] = None) -> None:
+        """Assign slots [start, stop) to gid.  ``epoch`` stamps the
+        assignment's mint (FINALIZE passes the bumped value for exactly
+        its slot); None leaves the per-slot stamps untouched (bootstrap
+        fills them from the table epoch at construction)."""
         for s in range(start, stop):
             self.owner[s] = gid
+            if epoch is not None:
+                self.slot_epoch[s] = epoch
 
     def slots_owned(self, gid: int) -> int:
         return sum(1 for g in self.owner if g == gid)
@@ -107,6 +132,20 @@ class SlotTable:
         out.append((start, NSLOTS - 1, cur))
         return out
 
+    def epoch_runs(self) -> list[tuple[int, int, int, int]]:
+        """Contiguous (start, end_inclusive, gid, slot_epoch) runs —
+        the codec shape (the join needs the per-slot mints)."""
+        out = []
+        start = 0
+        cur = (self.owner[0], self.slot_epoch[0])
+        for s in range(1, NSLOTS):
+            nxt = (self.owner[s], self.slot_epoch[s])
+            if nxt != cur:
+                out.append((start, s - 1) + cur)
+                start, cur = s, nxt
+        out.append((start, NSLOTS - 1) + cur)
+        return out
+
     # ------------------------------------------------------------ codec
     # run-length JSON: small (a fresh table is one run), stdlib-only,
     # and self-describing for the CLUSTERTAB gossip frame and the
@@ -116,7 +155,7 @@ class SlotTable:
         return json.dumps({
             "epoch": self.epoch,
             "groups": {str(g): a for g, a in sorted(self.groups.items())},
-            "runs": [[a, b, g] for a, b, g in self.ranges()],
+            "runs": [[a, b, g, e] for a, b, g, e in self.epoch_runs()],
         }, separators=(",", ":")).encode()
 
     @classmethod
@@ -124,13 +163,18 @@ class SlotTable:
         doc = json.loads(payload.decode("utf-8"))
         t = cls(epoch=int(doc["epoch"]),
                 groups={int(g): str(a) for g, a in doc["groups"].items()})
-        for a, b, g in doc["runs"]:
-            t.assign(int(a), int(b) + 1, int(g))
+        for run in doc["runs"]:
+            a, b, g = int(run[0]), int(run[1]), int(run[2])
+            # 3-element runs predate per-slot mints: stamp the table
+            # epoch, the strongest claim the old format could make
+            e = int(run[3]) if len(run) > 3 else t.epoch
+            t.assign(a, b + 1, g, epoch=e)
         return t
 
     def copy(self) -> "SlotTable":
         return SlotTable(self.epoch, array("i", self.owner),
-                         dict(self.groups))
+                         dict(self.groups),
+                         array("q", self.slot_epoch))
 
 
 def even_split(n_groups: int, addrs=None) -> SlotTable:
@@ -158,14 +202,20 @@ class ClusterState:
     windows (``migrating``: slot -> target addr, the ASK window on the
     source; ``importing``: slot -> source addr, the serve-anyway window
     on the target), the redirect/migration counters INFO reports, and
-    the GC migration pin: while any slot is mid-flight, gc_horizon()
-    (server/node.py) is clamped at the pin so no tombstone written
-    during the handoff is collected before the target holds it — the
-    no-resurrection law extended across an ownership flip."""
+    the GC migration pins: while any migration or import window is in
+    flight, gc_horizon() (server/node.py) is clamped at the lowest pin
+    so no tombstone written during the handoff is collected before the
+    target holds it — the no-resurrection law extended across an
+    ownership flip.  ``rev`` counts local table changes (adoptions,
+    finalizes, address learning) — the gossip loop's re-broadcast
+    trigger, deliberately finer than ``epoch`` because a join can
+    change ownership without minting a new epoch."""
 
     __slots__ = ("my_gid", "table", "migrating", "importing",
                  "redirects_sent", "migrations_in", "migrations_out",
-                 "_gc_pin", "_import_buf", "_tasks")
+                 "rev", "import_stall_s", "_gc_pins", "_import_buf",
+                 "_import_pins", "_import_touch", "_export_buf",
+                 "_tasks")
 
     def __init__(self, my_gid: int, table: SlotTable):
         self.my_gid = my_gid
@@ -175,8 +225,14 @@ class ClusterState:
         self.redirects_sent = 0
         self.migrations_in = 0
         self.migrations_out = 0
-        self._gc_pin: Optional[int] = None
+        self.rev = 0
+        self.import_stall_s = float(env_int("CONSTDB_MIGRATE_STALL_S",
+                                            120))
+        self._gc_pins: list[int] = []
         self._import_buf: dict[int, bytearray] = {}
+        self._import_pins: dict[int, int] = {}
+        self._import_touch: dict[int, float] = {}
+        self._export_buf: dict[int, bytes] = {}
         self._tasks: set = set()
 
     @property
@@ -194,29 +250,32 @@ class ClusterState:
 
     # ---------------------------------------------------------- routing
 
-    def needs_redirect(self, key: bytes) -> bool:
-        """Counter-free probe of route(): True iff route(key) would
-        return a redirect.  The serve coalescer demotes such commands
-        out of its planned runs with this, and the ONE counted route()
-        call then happens in commands.execute — so pure, native, and
-        lone-command intakes produce the identical reply bytes and the
-        identical redirects_sent count."""
+    def needs_redirect(self, key: bytes, is_write: bool = True) -> bool:
+        """Counter-free probe of route(): True iff route(key, is_write)
+        would return a redirect.  The serve coalescer demotes such
+        commands out of its planned runs with this, and the ONE counted
+        route() call then happens in commands.execute — so pure,
+        native, and lone-command intakes produce the identical reply
+        bytes and the identical redirects_sent count."""
         slot = slot_of(key)
         if self.table.owner[slot] == self.my_gid:
-            return slot in self.migrating
+            return is_write and slot in self.migrating
         return slot not in self.importing
 
-    def route(self, key: bytes):
+    def route(self, key: bytes, is_write: bool = True):
         """None = serve locally; otherwise the exact redirect Err.
         See the module doc for the four-way contract."""
         slot = slot_of(key)
         if self.table.owner[slot] == self.my_gid:
             target = self.migrating.get(slot)
-            if target is None:
+            if target is None or not is_write:
+                # reads keep serving from the source during the handoff
+                # window: its copy holds every write this group ever
+                # acknowledged, while the target may still lack the
+                # final delta — redirecting a read there could un-read
+                # a committed write.  ASK-window exactness is a WRITE
+                # law: only writes must drain to the target.
                 return None
-            # handoff window: the slot's bulk state is already on the
-            # target; new writes must land THERE so the final delta is
-            # the whole story (ASK-window exactness law)
             self.redirects_sent += 1
             return Err(b"ASK %d %s" % (slot, target.encode()))
         if slot in self.importing:
@@ -231,31 +290,110 @@ class ClusterState:
     # ------------------------------------------------- table adoption
 
     def adopt(self, table: SlotTable) -> bool:
-        """Adopt a gossiped/finalized table iff it is STRICTLY newer.
-        Preserves locally-known group addresses the newer table lacks
-        (gossip carries ownership, not necessarily every address)."""
-        if table.epoch <= self.table.epoch:
-            return False
-        merged = dict(self.table.groups)
-        merged.update(table.groups)
-        table.groups = merged
-        self.table = table
-        return True
+        """Join a gossiped/finalized table into ours, PER SLOT: the
+        assignment with the higher ``(slot_epoch, gid)`` wins — epoch
+        first, gid as the deterministic tie-break (Redis configEpoch
+        collision handling).  The join is commutative, associative and
+        idempotent, so two tables minted concurrently at the same epoch
+        merge identically in any exchange order — both flips survive —
+        where a whole-table higher-epoch-wins rule would drop one
+        (ownership regression).  Locally-known group addresses the
+        incoming table lacks are preserved (gossip carries ownership,
+        not necessarily every address).  Returns True iff anything
+        changed; ``rev`` advances with it so the gossip loops
+        re-broadcast joins that do not mint a new epoch."""
+        mine = self.table
+        mo, me = mine.owner, mine.slot_epoch
+        to, te = table.owner, table.slot_epoch
+        changed = False
+        for s in range(NSLOTS):
+            e, g = te[s], to[s]
+            if e > me[s] or (e == me[s] and g > mo[s]):
+                mo[s], me[s] = g, e
+                changed = True
+        if table.epoch > mine.epoch:
+            mine.epoch = table.epoch
+            changed = True
+        for g, a in table.groups.items():
+            if mine.groups.get(g) != a:
+                mine.groups[g] = a
+                changed = True
+        if changed:
+            self.rev += 1
+        return changed
 
     # ----------------------------------------------------- GC pinning
 
-    def pin_gc(self, uuid: int) -> None:
-        """Clamp the tombstone-GC horizon at `uuid` for the duration of
-        a migration (lowest pin wins across overlapping migrations)."""
-        if self._gc_pin is None or uuid < self._gc_pin:
-            self._gc_pin = uuid
+    def pin_gc(self, uuid: int) -> int:
+        """Clamp the tombstone-GC horizon at `uuid` until the matching
+        ``unpin_gc(uuid)``.  Pins are a MULTISET — every in-flight
+        migration (source side, from before its first await) and every
+        import window (target side) holds its own pin, and gc_horizon
+        clamps at the min — so one migration finishing or aborting can
+        never release a pin a concurrent one still needs."""
+        self._gc_pins.append(uuid)
+        return uuid
 
-    def unpin_gc(self) -> None:
-        if not self.migrating and not self.importing:
-            self._gc_pin = None
+    def unpin_gc(self, uuid: int) -> None:
+        """Release ONE holder's pin (no-op if already released — abort
+        paths may race their own cleanup)."""
+        try:
+            self._gc_pins.remove(uuid)
+        except ValueError:
+            pass
 
     def gc_pin(self) -> Optional[int]:
-        return self._gc_pin
+        return min(self._gc_pins) if self._gc_pins else None
+
+    # ------------------------------------------- import-window lifecycle
+
+    def open_import(self, slot: int, source: str, pin_uuid: int,
+                    now: float) -> None:
+        """Mark `slot` importing from `source`: GC pin (once — a
+        RETRIED migration re-marks the slot and must not stack a second
+        pin on the same window), staleness stamp, and a clean chunk
+        buffer (a partial buffer from a dead attempt would corrupt the
+        fresh stream's decode)."""
+        if slot not in self._import_pins:
+            self._import_pins[slot] = self.pin_gc(pin_uuid)
+        self.importing[slot] = source
+        self._import_buf.pop(slot, None)
+        self._import_touch[slot] = now
+
+    def touch_import(self, slot: int, now: float) -> None:
+        self._import_touch[slot] = now
+
+    def drop_import(self, slot: int) -> bool:
+        """Close an import window: forget the mark, the partial chunk
+        buffer, the staleness stamp, and release the window's GC pin.
+        Idempotent — FINALIZE, the source's abort path (SETSLOT
+        STABLE), and the staleness sweep can all reach it."""
+        self._import_touch.pop(slot, None)
+        self._import_buf.pop(slot, None)
+        self._export_buf.pop(slot, None)
+        pin = self._import_pins.pop(slot, None)
+        if pin is not None:
+            self.unpin_gc(pin)
+        return self.importing.pop(slot, None) is not None
+
+    def expire_stale_imports(self, now: float) -> None:
+        """Target-side failure path: a source that dies after SETSLOT
+        IMPORTING never sends STABLE or FINALIZE, and without this
+        sweep the window would serve the slot's partial copy and pin
+        tombstone GC forever.  Driven from node.gc_horizon() (the same
+        periodic pulse GC itself rides); every IMPORT chunk refreshes
+        the stamp, so only a silent source trips it."""
+        if not self.importing:
+            return
+        stale = [s for s, t in self._import_touch.items()
+                 if now - t > self.import_stall_s]
+        for s in stale:
+            log.warning(
+                "import window for slot %d went silent for %.0fs; "
+                "dropping the window and its GC pin (source %s "
+                "presumed dead — a retried migration re-opens cleanly)",
+                s, self.import_stall_s, self.importing.get(s, "?"))
+            self.drop_import(s)
 
     # ------------------------------------------------------ INFO feed
 

@@ -287,6 +287,14 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "ColumnarBatch export streams as CLUSTER IMPORT frames of "
            "at most this size, so one big slot cannot wedge the "
            "target's loop behind a single giant frame"),
+    EnvVar("CONSTDB_MIGRATE_STALL_S", "120",
+           "import-window staleness timeout (seconds): a migration "
+           "target whose source goes silent after SETSLOT IMPORTING — "
+           "no IMPORT chunk, no STABLE, no FINALIZE — for this long "
+           "drops the import window and releases its tombstone-GC pin "
+           "instead of serving the slot's partial copy (and pinning "
+           "GC) forever; a retried migration re-opens the window "
+           "cleanly"),
 )}
 
 

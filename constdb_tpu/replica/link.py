@@ -672,7 +672,7 @@ class ReplicaLink:
             # is the intended one, not a stale shared read
             cursor = 0
             last_ack = 0.0
-            tab_epoch = -1  # slot-table epoch last gossiped on this conn
+            tab_rev = -1  # slot-table revision last gossiped on this conn
             while True:
                 acked = meta.uuid_i_acked
                 while inflight and inflight[0][0] <= acked:
@@ -904,15 +904,18 @@ class ReplicaLink:
 
                 cl = node.cluster
                 if cl is not None and (self._peer_caps & CAP_CLUSTER) \
-                        and cl.epoch != tab_epoch:
-                    # slot-table gossip: once per epoch change per
+                        and cl.rev != tab_rev:
+                    # slot-table gossip: once per table CHANGE per
                     # connection (first round includes the initial
-                    # table).  Only to peers that advertised the
+                    # table).  Gated on cl.rev, not the epoch: a
+                    # per-slot join or a learned address can change the
+                    # table without minting a new epoch, and peers need
+                    # that news too.  Only to peers that advertised the
                     # capability — a legacy or disabled peer's stream
                     # carries zero cluster bytes (the byte-exact pin).
-                    tab_epoch = cl.epoch
+                    tab_rev = cl.rev
                     self._write(writer, encode_msg(Arr([
-                        Bulk(CLUSTERTAB), Int(tab_epoch),
+                        Bulk(CLUSTERTAB), Int(cl.epoch),
                         Bulk(cl.table.serialize())])))
 
                 now = asyncio.get_running_loop().time()
@@ -1443,9 +1446,11 @@ class ReplicaLink:
                 if self._digest_acks is not None and len(items) >= 4:
                     self._digest_acks.put_nowait(items)
             elif kind == CLUSTERTAB:
-                # slot-table gossip (cluster/slots.py): adopt iff
-                # STRICTLY newer — epoch-gated routing is what keeps a
-                # flapped owner from resurrecting a stale table.  Only
+                # slot-table gossip (cluster/slots.py): per-slot JOIN —
+                # higher (slot_epoch, gid) wins per slot, so a stale or
+                # concurrently-minted table merges instead of clobbering
+                # (epoch-gated routing is what keeps a flapped owner
+                # from resurrecting a stale assignment).  Only
                 # cluster-mode peers send these (we advertised
                 # CAP_CLUSTER); a disabled node treats one as the
                 # protocol error it is, like any unknown frame.

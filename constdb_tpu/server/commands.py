@@ -259,7 +259,7 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
         # replicated ops are already group-scoped by construction (the
         # writer routed), and must always land (apply_replicated).
         try:
-            redirect = cl.route(as_bytes(items[1]))
+            redirect = cl.route(as_bytes(items[1]), cmd.is_write)
         except CstError:
             redirect = None  # unkeyable arg: the handler's exact error
         if redirect is not None:

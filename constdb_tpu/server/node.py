@@ -314,6 +314,12 @@ class Node:
             horizon = self.hlc.current
         cl = self.cluster
         if cl is not None:
+            # the GC pulse doubles as the import-window staleness sweep:
+            # a migration source that died after SETSLOT IMPORTING must
+            # not pin this node's tombstone GC (or keep the slot's
+            # partial copy serving) forever
+            import time
+            cl.expire_stale_imports(time.monotonic())
             pin = cl.gc_pin()
             if pin is not None and pin < horizon:
                 horizon = pin

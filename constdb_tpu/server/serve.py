@@ -289,12 +289,14 @@ class ServeCoalescer:
                 if fn is None:
                     continue
                 if type(fn) is tuple:
-                    key = fn[2]
+                    key, wr = fn[2], False  # read plans serve through
+                    #                         an ASK window (write law)
                 else:
                     it = msgs[i].items
                     key = it[1].val if len(it) > 1 and \
                         type(it[1]) is Bulk else None
-                if key is not None and cl.needs_redirect(key):
+                    wr = True  # callable planners are all write planners
+                if key is not None and cl.needs_redirect(key, wr):
                     plan[i] = None
         n = len(msgs)
         n_plannable = sum(callable(f) for f in plan)
@@ -418,14 +420,18 @@ class ServeCoalescer:
                     continue
                 if type(fn) is int:
                     pl = payloads[i]
-                    key = pl[1][0] if fn < _FIRST_READ_OP else pl[0]
+                    if fn < _FIRST_READ_OP:
+                        key, wr = pl[1][0], True
+                    else:
+                        key, wr = pl[0], False
                 elif type(fn) is tuple:
-                    key = fn[2]
+                    key, wr = fn[2], False
                 else:
                     it = payloads[i].items
                     key = it[1].val if len(it) > 1 and \
                         type(it[1]) is Bulk else None
-                if key is not None and cl.needs_redirect(key):
+                    wr = True  # callable planners are all write planners
+                if key is not None and cl.needs_redirect(key, wr):
                     plan[i] = None
         n_plannable = sum(1 for fn in plan if callable(fn) or
                           (type(fn) is int and fn < _FIRST_READ_OP))

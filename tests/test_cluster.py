@@ -130,15 +130,20 @@ def test_route_four_way_contract():
     mine, theirs = _key_for_group(0), _key_for_group(1)
     # owned, not migrating: serve locally
     assert cl.route(mine) is None
-    # not owned: MOVED with the owner's address
+    # not owned: MOVED with the owner's address — reads and writes alike
     r = cl.route(theirs)
     assert isinstance(r, Err)
     assert r.val == b"MOVED %d %s" % (slot_of(theirs), ADDRS[1].encode())
-    # owned but mid-handoff: ASK at the migration target
+    assert isinstance(cl.route(theirs, False), Err)
+    # owned but mid-handoff: WRITES get ASK at the migration target,
+    # reads keep serving from the still-complete source copy (a read
+    # redirected before the final delta lands could miss a write the
+    # source already committed)
     cl.migrating[slot_of(mine)] = "127.0.0.1:9999"
     r = cl.route(mine)
     assert r.val == b"ASK %d 127.0.0.1:9999" % slot_of(mine)
-    assert cl.redirects_sent == 2
+    assert cl.route(mine, False) is None
+    assert cl.redirects_sent == 3
     # the target side serves a slot it is importing, table or no table
     imp = ClusterState(1, even_split(2, addrs=ADDRS))
     assert isinstance(imp.route(mine), Err)
@@ -151,21 +156,109 @@ def test_needs_redirect_is_counter_free():
     theirs = _key_for_group(1)
     assert cl.needs_redirect(theirs) and not cl.needs_redirect(
         _key_for_group(0))
+    # probe matches route() on the read/write split too
+    mine = _key_for_group(0)
+    cl.migrating[slot_of(mine)] = "127.0.0.1:9999"
+    assert cl.needs_redirect(mine, True)
+    assert not cl.needs_redirect(mine, False)
     assert cl.redirects_sent == 0
 
 
-def test_adopt_only_strictly_newer_and_merges_addrs():
+def test_ask_window_serves_reads_locally_through_execute():
+    """A committed write must stay readable on the source during its
+    slot's ASK window: reads serve locally, writes redirect."""
+    node = Node(node_id=1)
+    node.cluster = _two_group_state(0)
+    mine = _key_for_group(0)
+    execute(node, Arr([Bulk(b"set"), Bulk(mine), Bulk(b"committed")]))
+    node.cluster.migrating[slot_of(mine)] = "127.0.0.1:9999"
+    r = execute(node, Arr([Bulk(b"get"), Bulk(mine)]))
+    assert as_bytes(r) == b"committed"
+    r = execute(node, Arr([Bulk(b"set"), Bulk(mine), Bulk(b"x")]))
+    assert isinstance(r, Err) and r.val.startswith(b"ASK ")
+    assert node.cluster.redirects_sent == 1
+
+
+def test_adopt_joins_and_merges_addrs():
     cl = _two_group_state(0)
     same = even_split(2)
-    assert not cl.adopt(same)  # equal epoch: refused
+    assert not cl.adopt(same)  # no news: refused (and rev untouched)
+    assert cl.rev == 0
     newer = even_split(2)
     newer.epoch = 5
     newer.groups = {1: "127.0.0.1:9001"}  # no address for group 0
     assert cl.adopt(newer)
-    assert cl.epoch == 5
+    assert cl.epoch == 5 and cl.rev == 1
     # locally-known address survives the adoption
     assert cl.table.groups[0] == ADDRS[0]
     assert cl.table.groups[1] == "127.0.0.1:9001"
+
+
+def _finalize_like(base, slot: int, gid: int):
+    """A table a concurrent FINALIZE on `gid` would mint from `base`."""
+    t = base.copy()
+    t.assign(slot, slot + 1, gid, epoch=base.epoch + 1)
+    t.epoch = base.epoch + 1
+    return t
+
+
+def test_adopt_merges_concurrent_equal_epoch_mints():
+    """The REVIEW.md collision: two migrations to DIFFERENT groups
+    finalize concurrently and both mint epoch N+1.  The per-slot
+    (epoch, gid) join merges the tables — both flips survive, any
+    exchange order converges byte-identically — where whole-table
+    strictly-newer adoption would drop one and silently revert its
+    flip."""
+    base = even_split(3, addrs=ADDRS + ["127.0.0.1:7102"])
+    s_a = 0      # owned by gid 0, flips to gid 1
+    s_b = 16000  # owned by gid 2, flips to gid 1... use distinct gids
+    t_a = _finalize_like(base, s_a, 1)
+    t_b = _finalize_like(base, s_b, 0)
+    assert t_a.epoch == t_b.epoch == base.epoch + 1
+    one = ClusterState(0, base.copy())
+    two = ClusterState(1, base.copy())
+    assert one.adopt(t_a) and one.adopt(t_b)
+    assert two.adopt(t_b) and two.adopt(t_a)
+    for cl in (one, two):
+        assert cl.table.owner[s_a] == 1
+        assert cl.table.owner[s_b] == 0
+        assert cl.epoch == base.epoch + 1
+    assert one.table.serialize() == two.table.serialize()
+    # idempotent: re-adopting either input changes nothing
+    assert not one.adopt(t_a) and not one.adopt(t_b)
+
+
+def test_adopt_same_slot_same_epoch_ties_break_on_gid():
+    """A same-slot same-epoch conflict (can only arise from a split
+    lineage) resolves deterministically — higher gid — in every
+    exchange order, so the mesh converges instead of ping-ponging."""
+    base = even_split(3, addrs=ADDRS + ["127.0.0.1:7102"])
+    slot = 0
+    t_lo = _finalize_like(base, slot, 1)
+    t_hi = _finalize_like(base, slot, 2)
+    one = ClusterState(0, base.copy())
+    two = ClusterState(0, base.copy())
+    one.adopt(t_lo)
+    one.adopt(t_hi)
+    two.adopt(t_hi)
+    assert not two.adopt(t_lo)  # lower gid at the same epoch: no news
+    assert one.table.owner[slot] == two.table.owner[slot] == 2
+    assert one.table.serialize() == two.table.serialize()
+
+
+def test_codec_roundtrips_slot_epochs():
+    base = even_split(2, addrs=ADDRS)
+    t = _finalize_like(base, 7, 1)
+    back = SlotTable.deserialize(t.serialize())
+    assert list(back.slot_epoch) == list(t.slot_epoch)
+    assert back.slot_epoch[7] == 2 and back.slot_epoch[8] == 1
+    # legacy 3-element runs (pre-slot-epoch payload) stamp the table
+    # epoch — the strongest claim the old format could make
+    import json as _json
+    doc = _json.loads(t.serialize().decode())
+    doc["runs"] = [[a, b, g] for a, b, g, _e in doc["runs"]]
+    legacy = SlotTable.deserialize(_json.dumps(doc).encode())
+    assert set(legacy.slot_epoch) == {t.epoch}
 
 
 def test_execute_redirects_before_any_state():
@@ -222,16 +315,80 @@ def test_gc_horizon_clamped_by_migration_pin():
     execute(node, Arr([Bulk(b"set"), Bulk(b"gk"), Bulk(b"v")]))
     free = node.gc_horizon()
     assert free == node.hlc.current  # standalone: own clock
-    cl.pin_gc(7)
-    cl.pin_gc(12)  # lowest pin wins
+    a = cl.pin_gc(7)
+    b = cl.pin_gc(12)  # lowest pin wins while both are held
     assert node.gc_horizon() == 7
-    cl.migrating[3] = "x"
-    cl.unpin_gc()  # refused: a window is still open
+    # pins are per holder (a MULTISET): releasing one migration's pin
+    # never releases a concurrent one's — the REVIEW.md resurrection
+    # shape was exactly a second migration's unpin wiping the first's
+    # pin during its bulk/catch-up phase
+    cl.unpin_gc(b)
     assert node.gc_horizon() == 7
-    cl.migrating.clear()
-    cl.unpin_gc()
+    cl.unpin_gc(b)  # double-release: no-op, the other pin survives
+    assert node.gc_horizon() == 7
+    cl.unpin_gc(a)
     assert cl.gc_pin() is None
     assert node.gc_horizon() == node.hlc.current
+
+
+def test_gc_pins_survive_concurrent_release_order():
+    """Equal-valued pins from two overlapping migrations are distinct
+    holders: one release drops exactly one instance."""
+    cl = _two_group_state(0)
+    cl.pin_gc(5)
+    cl.pin_gc(5)
+    cl.unpin_gc(5)
+    assert cl.gc_pin() == 5
+    cl.unpin_gc(5)
+    assert cl.gc_pin() is None
+
+
+def test_import_window_lifecycle_pins_and_expiry():
+    """open_import pins once (a retry re-marks without stacking),
+    drop_import releases exactly the window's pin, and a silent source
+    trips the staleness sweep — the target never pins GC forever."""
+    cl = _two_group_state(1)
+    cl.open_import(3, ADDRS[0], 40, now=100.0)
+    assert cl.gc_pin() == 40 and 3 in cl.importing
+    # a RETRIED migration re-marks the slot: buffer resets, pin does
+    # not stack (and keeps the ORIGINAL, lower, clamp)
+    cl._import_buf[3] = bytearray(b"partial")
+    cl.open_import(3, ADDRS[0], 55, now=101.0)
+    assert cl.gc_pin() == 40
+    assert 3 not in cl._import_buf
+    # fresh stamps survive the sweep; silence past the stall drops the
+    # window, the buffer, and the pin
+    cl.expire_stale_imports(now=101.0 + cl.import_stall_s)
+    assert 3 in cl.importing
+    cl.touch_import(3, 200.0)
+    cl.expire_stale_imports(now=200.0 + cl.import_stall_s + 1)
+    assert 3 not in cl.importing
+    assert cl.gc_pin() is None
+    # drop_import is idempotent
+    assert not cl.drop_import(3)
+
+
+def test_setslot_stable_closes_the_window():
+    """The source's abort verb: SETSLOT STABLE drops the importing
+    mark, the partial chunk buffer, and the GC pin — and is idempotent
+    (the staleness sweep can race it)."""
+    node = Node(node_id=1)
+    node.cluster = _two_group_state(1)
+    slot = slot_of(_key_for_group(0))
+    r = execute(node, Arr([Bulk(b"cluster"), Bulk(b"setslot"),
+                           Bulk(b"%d" % slot), Bulk(b"importing"),
+                           Bulk(b"1"), Bulk(ADDRS[0].encode())]))
+    assert as_bytes(r) == b"OK"
+    assert slot in node.cluster.importing
+    assert node.cluster.gc_pin() is not None
+    node.cluster._import_buf[slot] = bytearray(b"partial")
+    for _ in range(2):  # idempotent
+        r = execute(node, Arr([Bulk(b"cluster"), Bulk(b"setslot"),
+                               Bulk(b"%d" % slot), Bulk(b"stable")]))
+        assert as_bytes(r) == b"OK"
+        assert slot not in node.cluster.importing
+        assert node.cluster.gc_pin() is None
+        assert slot not in node.cluster._import_buf
 
 
 # ----------------------------------------------------- observability arms
@@ -473,6 +630,101 @@ def test_slot_migration_end_to_end(tmp_path):
             assert not node1.cluster.importing
             info = as_bytes(await rc.cmd(addr0, b"info", b"cluster"))
             assert b"migrations_out:1" in info
+        finally:
+            await rc.close()
+            await cluster.close()
+    asyncio.run(main())
+
+
+def test_abort_after_ask_window_reclaims_target_writes(tmp_path, monkeypatch):
+    """REVIEW.md abort law, end to end: a migration that dies AFTER its
+    ASK window opened must pull the window's target-acknowledged writes
+    back to the source (SETSLOT STABLE + SLOTEXPORT) before the source
+    resumes serving the slot — there is deliberately no inter-group
+    repl stream to carry them later."""
+    from constdb_tpu.chaos.cluster import ChaosCluster, Client
+    from constdb_tpu.chaos.cluster_cells import (RedirectClient,
+                                                 _seed_addrs, _specs)
+    from constdb_tpu.cluster import migrate
+    from constdb_tpu.errors import CstError
+
+    reached = asyncio.Event()
+    proceed = asyncio.Event()
+    probes = [0]
+
+    class _StuckChan(migrate._Chan):
+        """Real wire for everything except SLOTDIGEST, which (a) parks
+        the first probe so the test can inject window writes and (b)
+        never repeats a value, so the fixpoint can never certify and
+        the migration aborts with its window open."""
+
+        async def call(self, *parts):
+            if len(parts) > 1 and parts[1] == b"slotdigest":
+                if not reached.is_set():
+                    reached.set()
+                    await proceed.wait()
+                probes[0] += 1
+                return Bulk(b"%d" % probes[0])
+            return await super().call(*parts)
+
+    async def main():
+        monkeypatch.setattr(migrate, "_Chan", _StuckChan)
+        cluster = ChaosCluster(str(tmp_path), 23, _specs())
+        await cluster.start()
+        rc = RedirectClient()
+        try:
+            await _seed_addrs(cluster)
+            addr0 = cluster.apps[0].advertised_addr
+            addr1 = cluster.apps[1].advertised_addr
+            node0, node1 = cluster.apps[0].node, cluster.apps[1].node
+            key = _key_for_group(0, b"mig")
+            slot = slot_of(key)
+            # a second key in the SAME slot, born during the window
+            j, fresh = 0, None
+            while fresh is None:
+                k = b"w%d" % j
+                if slot_of(k) == slot:
+                    fresh = k
+                j += 1
+            await rc.cmd(addr0, b"set", key, b"payload")
+            task = asyncio.create_task(migrate.migrate_slot(
+                node0, cluster.apps[0], slot, addr1, timeout=5.0))
+            await asyncio.wait_for(reached.wait(), 5.0)
+            # the ASK window is open: these writes redirect to the
+            # target and are acknowledged ONLY there
+            assert slot in node0.cluster.migrating
+            await rc.cmd(addr0, b"set", key, b"window-write")
+            await rc.cmd(addr0, b"set", fresh, b"window-born")
+            assert rc.redirects >= 2
+            proceed.set()
+            with pytest.raises(CstError, match="fixpoint"):
+                await task
+            # ownership never flipped and every window artifact is gone
+            assert node0.cluster.owns(slot)
+            assert not node1.cluster.owns(slot)
+            assert not node0.cluster.migrating
+            assert not node1.cluster.importing
+            assert node0.cluster.gc_pin() is None
+            assert node1.cluster.gc_pin() is None
+            assert not node1.cluster._export_buf
+            # the reclaimed writes answer DIRECTLY on the source
+            c0 = await Client().connect(addr0)
+            try:
+                assert as_bytes(await c0.cmd(b"get", key)) \
+                    == b"window-write"
+                assert as_bytes(await c0.cmd(b"get", fresh)) \
+                    == b"window-born"
+            finally:
+                await c0.close()
+            # the target, its window closed by STABLE, bounces the slot
+            # back at the settled owner
+            c1 = await Client().connect(addr1)
+            try:
+                r = await c1.cmd(b"get", key)
+                assert isinstance(r, Err)
+                assert r.val.startswith(b"MOVED %d " % slot)
+            finally:
+                await c1.close()
         finally:
             await rc.close()
             await cluster.close()
