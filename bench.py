@@ -2172,6 +2172,215 @@ def serve_read_main(args) -> None:
         sys.exit(1)
 
 
+def tracked_workload(ci: int, n_clients: int, per_ops: int, n_keys: int,
+                     hot: int, seed: int = 0xC0FFEE) -> list:
+    """Deterministic per-client schedule for the tracked-caching legs
+    (round 22): 10% writes / 90% reads, with 90% of reads hammering the
+    `hot` head of the universe (the skew that makes a near-cache earn
+    its keep) and the tail uniform.  Writes are SINGLE-WRITER: client
+    `ci` only ever sets keys where `idx % n_clients == ci`, each with a
+    per-key serial — so the final visible value of every key is
+    schedule-determined and the stripped canonical export must match
+    exactly across legs (same oracle as the serve modes)."""
+    import random
+
+    rng = random.Random((seed << 8) | ci)
+    owned = [i for i in range(n_keys) if i % n_clients == ci]
+    serial: dict = {}
+    sched = []
+    for _ in range(per_ops):
+        r = rng.random()
+        if r < 0.10:
+            idx = owned[rng.randrange(len(owned))]
+            serial[idx] = serial.get(idx, 0) + 1
+            sched.append((b"set", b"trk:%d" % idx,
+                          b"c%d:%d" % (ci, serial[idx])))
+        elif r < 0.91:
+            sched.append((b"get", b"trk:%d" % rng.randrange(hot), None))
+        else:
+            sched.append((b"get", b"trk:%d" % rng.randrange(n_keys), None))
+    return sched
+
+
+async def _tracked_leg(tracked: bool, schedules: list, n_keys: int,
+                       work_dir: str) -> tuple:
+    """One in-process leg: a fresh single node on a real socket, K
+    concurrent request-reply clients driving their schedules — plain
+    RESP2 clients (every GET is a server round-trip) or tracked RESP3
+    `NearCacheClient`s (a quiet-key GET never leaves the process).
+    In-process (unlike `_serve_leg`'s fork) because the headline metric
+    is the SERVER-side read-op count, read straight off the node's
+    `cmds_processed` gauge (bumped once per client command on both the
+    per-command and planned paths): the storm's delta minus its write
+    count IS the reads that reached the server.  Returns
+    (wall, counters, canonical-export)."""
+    from constdb_tpu.chaos.cluster import Client
+    from constdb_tpu.client import NearCacheClient
+    from constdb_tpu.resp.message import Err
+    from constdb_tpu.server.io import start_node
+    from constdb_tpu.server.node import Node
+
+    node = Node(node_id=1)
+    app = await start_node(node, host="127.0.0.1", port=0,
+                           work_dir=work_dir)
+    addr = app.advertised_addr
+    direct = await Client().connect(addr)
+    try:
+        # seed every key: the cold tail reads DATA, and both legs start
+        # from the same per-key write history (seed, then owner serials)
+        for i in range(n_keys):
+            await direct.cmd(b"set", b"trk:%d" % i, b"seed:%d" % i)
+        if tracked:
+            clients = [await NearCacheClient(addr).connect()
+                       for _ in schedules]
+        else:
+            clients = [await Client().connect(addr) for _ in schedules]
+        n_writes = sum(1 for s in schedules for op, _k, _v in s
+                       if op == b"set")
+        cmds0 = node.stats.cmds_processed
+
+        async def drive(c, sched):
+            for op, k, v in sched:
+                if op == b"set":
+                    r = await (c.set(k, v) if tracked
+                               else c.cmd(b"set", k, v))
+                else:
+                    r = await (c.get(k) if tracked else c.cmd(b"get", k))
+                if isinstance(r, Err):
+                    raise AssertionError(f"leg reply error: {r.val!r}")
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(drive(c, s)
+                               for c, s in zip(clients, schedules)))
+        wall = time.perf_counter() - t0
+        # snapshot BEFORE the zero-stale oracle's direct reads below —
+        # those are measurement traffic, not workload
+        server_read_ops = node.stats.cmds_processed - cmds0 - n_writes
+        stale = 0
+        if tracked:
+            # quiesce past the coalescing window, then the zero-stale
+            # oracle: every entry still resident in every near-cache
+            # must equal a direct read from the server
+            await asyncio.sleep(0.3)
+            for c in clients:
+                await asyncio.sleep(0)
+                for k, v in list(c.cache.items()):
+                    if await direct.cmd(b"get", k) != v:
+                        stale += 1
+        st = node.stats
+        counters = {
+            "server_read_ops": server_read_ops,
+            "stale_entries": stale,
+            "tracking_invalidations_sent": st.tracking_invalidations_sent,
+            "tracking_pushes": st.tracking_pushes,
+            "tracking_demotions": st.tracking_demotions,
+            "near_cache_hits": sum(getattr(c, "hits", 0)
+                                   for c in clients),
+            "near_cache_misses": sum(getattr(c, "misses", 0)
+                                     for c in clients),
+            "near_cache_invalidations": sum(
+                getattr(c, "invalidations", 0) for c in clients),
+            "near_cache_flushes": sum(getattr(c, "flushes", 0)
+                                      for c in clients),
+        }
+        canon = app.node.canonical()
+        for c in clients:
+            await c.close()
+        return wall, counters, canon
+    finally:
+        await direct.close()
+        await app.close()
+
+
+def tracked_main(args) -> None:
+    """`bench.py --mode tracked`: the client-assisted-caching legs
+    (round 22).  K tracked RESP3 near-cache clients vs K plain clients
+    on the SAME deterministic hot-key 90:10 storm; the claim is
+    server-side — the tracked leg's reads that actually reach the
+    server must be >= 5x fewer — certified by the zero-stale oracle
+    (every resident near-cache entry equals a direct read at quiesce)
+    and the timestamp-stripped canonical export matching across legs.
+    Emits one JSON line (BENCH_r22.json) with the host fingerprint."""
+    import tempfile
+
+    n_ops = int(os.environ.get("CONSTDB_BENCH_TRACKED_OPS", 40_000))
+    n_clients = int(os.environ.get("CONSTDB_BENCH_TRACKED_CLIENTS", 4))
+    n_keys = int(os.environ.get("CONSTDB_BENCH_TRACKED_KEYS", 512))
+    hot = int(os.environ.get("CONSTDB_BENCH_TRACKED_HOT", 16))
+    reps = int(os.environ.get("CONSTDB_BENCH_TRACKED_REPS", 2))
+    floor = float(os.environ.get("CONSTDB_BENCH_TRACKED_FLOOR", 5.0))
+
+    ensure_native()
+    per_ops = n_ops // n_clients
+    total = per_ops * n_clients
+    schedules = [tracked_workload(ci, n_clients, per_ops, n_keys, hot)
+                 for ci in range(n_clients)]
+    n_reads = sum(1 for s in schedules for op, _k, _v in s
+                  if op == b"get")
+    print(f"[bench] tracked: {total} ops ({n_reads} reads) over "
+          f"{n_clients} clients, {n_keys} keys (hot {hot})",
+          file=sys.stderr)
+
+    best: dict = {"tracked": None, "plain": None}
+    for rep in range(reps):
+        for name, is_tracked in (("plain", False), ("tracked", True)):
+            with tempfile.TemporaryDirectory() as td:
+                leg = asyncio.run(_tracked_leg(is_tracked, schedules,
+                                               n_keys, td))
+            print(f"[bench] rep {rep + 1} {name}: {leg[0]:.3f}s = "
+                  f"{total / leg[0]:,.0f} op/s, "
+                  f"{leg[1]['server_read_ops']} server reads",
+                  file=sys.stderr)
+            if best[name] is None or leg[0] < best[name][0]:
+                best[name] = leg
+
+    plain, tracked = best["plain"], best["tracked"]
+    reduction = plain[1]["server_read_ops"] / \
+        max(1, tracked[1]["server_read_ops"])
+    hits = tracked[1]["near_cache_hits"]
+    hit_rate = hits / max(1, hits + tracked[1]["near_cache_misses"])
+    export_ok = strip_canonical_times(plain[2]) == \
+        strip_canonical_times(tracked[2])
+    verified = (export_ok
+                and tracked[1]["stale_entries"] == 0
+                and tracked[1]["tracking_invalidations_sent"] > 0
+                and tracked[1]["tracking_demotions"] == 0
+                and reduction >= floor)
+    print(f"[bench] tracked: {plain[1]['server_read_ops']} -> "
+          f"{tracked[1]['server_read_ops']} server reads = "
+          f"{reduction:.1f}x reduction (floor {floor}x), hit rate "
+          f"{hit_rate:.3f}; export {'OK' if export_ok else 'MISMATCH'}, "
+          f"{tracked[1]['stale_entries']} stale", file=sys.stderr)
+
+    out = {
+        "metric": "tracked_server_read_reduction",
+        "value": round(reduction, 2),
+        "unit": "x fewer server-side reads",
+        "mode": "tracked",
+        "host_note": "in-process legs (client+server share the box): "
+                     "the op-count reduction is load-independent, the "
+                     "op/s walls are not",
+        "ops": total,
+        "reads": n_reads,
+        "clients": n_clients,
+        "keys": n_keys,
+        "hot_keys": hot,
+        "plain": {"op_per_s": round(total / plain[0], 1),
+                  "wall_s": round(plain[0], 3),
+                  **plain[1]},
+        "tracked": {"op_per_s": round(total / tracked[0], 1),
+                    "wall_s": round(tracked[0], 3),
+                    "near_cache_hit_rate": round(hit_rate, 3),
+                    **tracked[1]},
+        "export_ok": export_ok,
+        "verified": verified,
+        "host": host_fingerprint(),
+    }
+    print(json.dumps(out))
+    if not verified:
+        sys.exit(1)
+
+
 def serve_aof_main(args) -> None:
     """`bench.py --mode serve --aof`: the durability legs — the SAME
     pipelined serve workload against AOF-off / everysec / always
@@ -4209,7 +4418,8 @@ def main() -> None:
                     "1 = single-keyspace path)")
     ap.add_argument("--mode",
                     choices=["snapshot", "stream", "serve", "resync",
-                             "tensor", "intake", "recover", "cluster"],
+                             "tensor", "intake", "recover", "cluster",
+                             "tracked"],
                     default="snapshot",
                     help="snapshot = bulk catch-up merge (default); "
                     "stream = steady-state replication apply through the "
@@ -4228,7 +4438,11 @@ def main() -> None:
                     "vs 1 group with a union-canonical oracle, the "
                     "redirect-check tax vs the pre-cluster node, and a "
                     "live slot-range migration's O(slot bytes) cost "
-                    "(BENCH_r21)")
+                    "(BENCH_r21); tracked = client-assisted caching — "
+                    "K tracked near-cache clients vs K plain clients "
+                    "on a hot-key 90:10 storm, server-side read-op "
+                    "reduction with a zero-stale + stripped-export "
+                    "oracle (BENCH_r22)")
     ap.add_argument("--frame-log", default=None,
                     help="stream mode: record the generated frame log "
                     "here (or replay it if the file exists)")
@@ -4298,6 +4512,9 @@ def main() -> None:
         return
     if args.mode == "cluster":
         cluster_main(args)
+        return
+    if args.mode == "tracked":
+        tracked_main(args)
         return
     if args.mode == "resync":
         resync_main(args)

@@ -61,6 +61,12 @@ class Cell:
     #                         instead of the replication matrix — two
     #                         slot groups, no inter-group repl links,
     #                         the other knobs above do not apply
+    tracking: str = ""      # tracking cell name (round 22): when set,
+    #                         the cell drives a real NearCacheClient
+    #                         through a fault-injected storm
+    #                         (tracking_cells.TRACKING_CELLS) and
+    #                         certifies the zero-stale law instead of
+    #                         running the replication matrix
 
     @property
     def name(self) -> str:
@@ -69,7 +75,8 @@ class Cell:
                 f"-shards{self.shards}-{self.engine}"
                 + (f"-aof-{self.aof}" if self.aof else "")
                 + ("-ckpt" if self.ckpt else "")
-                + (f"-cluster-{self.cluster}" if self.cluster else ""))
+                + (f"-cluster-{self.cluster}" if self.cluster else "")
+                + (f"-{self.tracking}" if self.tracking else ""))
 
     def specs(self, n: int = 3, mixed_idx: Optional[int] = None
               ) -> list[NodeSpec]:
@@ -140,6 +147,11 @@ def matrix_cells() -> list[Cell]:
     # ownership flap, and deletes landing mid-move (cluster_cells.py)
     from .cluster_cells import CLUSTER_CELLS
     cells.extend(Cell(cluster=c) for c in CLUSTER_CELLS)
+    # client-assisted caching (round 22): the near-cache invalidation
+    # laws under replication, partitions, connection kills, and slot
+    # migration (tracking_cells.py)
+    from .tracking_cells import TRACKING_CELLS
+    cells.extend(Cell(tracking=t) for t in TRACKING_CELLS)
     return cells
 
 
@@ -152,7 +164,8 @@ def smoke_cells() -> list[Cell]:
     return [Cell(), Cell(wire=False, delta=False, compress=False),
             Cell(engine="xla-resident"), Cell(shards=2, wire=False),
             Cell(aof="always", ckpt=True), Cell(aof="everysec"),
-            Cell(cluster="migrate-partition")]
+            Cell(cluster="migrate-partition"),
+            Cell(tracking="track-partition")]
 
 
 @dataclass
@@ -692,6 +705,10 @@ def run_scenario(sc: Scenario) -> dict:
         from .cluster_cells import run_cluster_cell
         return run_cluster_cell(sc.cell.cluster, sc.seed,
                                 ops=sc.ops_per_burst)
+    if sc.cell.tracking:
+        from .tracking_cells import run_tracking_cell
+        return run_tracking_cell(sc.cell.tracking, sc.seed,
+                                 ops=sc.ops_per_burst)
     return asyncio.run(_run_scenario_async(sc))
 
 

@@ -1,0 +1,8 @@
+"""Client-side tier: the tracked near-cache (client/near_cache.py).
+
+Server counterpart: server/tracking.py (RESP3 invalidation pushes).
+"""
+
+from .near_cache import NearCacheClient
+
+__all__ = ["NearCacheClient"]

@@ -1,0 +1,249 @@
+"""Tracked RESP3 client with a near-cache tier.
+
+The server half (server/tracking.py) forwards the reply cache's
+invalidation stream to subscribed connections as RESP3 push frames;
+this client turns that stream into a local read tier: a GET whose key
+is quiet since the last read is answered from process memory with ZERO
+server round-trips.
+
+Trust discipline (docs/INVARIANTS.md "Tracking laws"):
+
+  * **connection-scoped trust** — a cached entry is only trustworthy
+    while the connection that filled it is live: the server's one-shot
+    invalidation promise is per-connection state that dies with the
+    socket.  ANY disconnect (error, EOF, server abort, reconnect)
+    therefore flushes the whole near-cache BEFORE the first read after
+    it — the reconnect-flush law.  The flush happens at disconnect
+    DETECTION (both in the reader task and on the command path), so a
+    half-dead connection can never serve a stale entry in between.
+  * **invalidate-before-visible, client half** — push frames are
+    consumed by a dedicated reader task the moment they arrive, and a
+    near-cache hit yields to the event loop first (`sleep(0)`), so an
+    invalidation that has reached this process is always applied before
+    a hit is served.  (The wire itself is ordered: the server queues
+    the push before the mutation's effects are observable.)
+  * **own writes** — a write issued through this client drops its key
+    locally at send time; the server's push (which the registry owes
+    this very connection) would arrive only after the reply.
+
+The transport mirrors chaos/cluster.py Client — one connection, one
+in-flight command (callers serialize through an internal lock), pure
+RespParser (it decodes `>N` push frames natively; resp/codec.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..resp.codec import RespParser, encode_msg
+from ..resp.message import Arr, Bulk, Err, Msg, Nil, Push, as_int
+
+# commands whose FIRST argument names a key this client may mutate —
+# issued through cmd(), they drop the key from the near-cache locally
+# (the server's own push covers every other writer)
+_WRITE_CMDS = frozenset((b"set", b"del", b"incr", b"incrby", b"decr",
+                         b"decrby", b"sadd", b"srem", b"hset", b"hdel",
+                         b"lpush", b"rpush", b"lpop", b"rpop", b"expire",
+                         b"persist"))
+
+
+class NearCacheClient:
+    """One tracked RESP3 connection + its near-cache tier."""
+
+    def __init__(self, addr: str, bcast: bool = False,
+                 prefixes: tuple = (), max_entries: int = 65536) -> None:
+        self.addr = addr
+        self.bcast = bcast
+        self.prefixes = tuple(prefixes)
+        self.max_entries = max_entries
+        self.cache: dict[bytes, Msg] = {}
+        # client-side telemetry (the bench oracle + chaos cells read
+        # these)
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0   # keys dropped by push frames
+        self.flushes = 0         # whole-cache drops (push-nil/disconnect)
+        self.client_id = 0
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._replies: asyncio.Queue = asyncio.Queue()
+        self._reader_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._connected = False
+        # fill-race guard: an invalidation (or flush) that lands while
+        # a GET is in flight POISONS the fill — caching the reply after
+        # its invalidation was already consumed would strand a stale
+        # entry forever (the server's one-shot promise is spent)
+        self._pending_key: Optional[bytes] = None
+        self._poisoned = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def connect(self) -> "NearCacheClient":
+        """Dial + HELLO 3 + CLIENT TRACKING on.  Always flushes the
+        near-cache first: whatever connection previously filled it is
+        gone, and with it the server's invalidation promise."""
+        self._flush("reconnect")
+        host, port = self.addr.rsplit(":", 1)
+        self.reader, self.writer = await asyncio.open_connection(
+            host, int(port))
+        self._replies = asyncio.Queue()
+        self._connected = True
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop())
+        hello = await self._roundtrip(b"hello", b"3")
+        if isinstance(hello, Err):
+            await self.close()
+            raise ConnectionError(f"HELLO 3 refused: {hello.val!r}")
+        items = hello.items if isinstance(hello, Arr) else []
+        for i in range(0, len(items) - 1, 2):
+            if isinstance(items[i], Bulk) and items[i].val == b"id":
+                self.client_id = as_int(items[i + 1])
+        sub = [b"client", b"tracking", b"on"]
+        if self.bcast:
+            sub.append(b"bcast")
+            for p in self.prefixes:
+                sub += [b"prefix", p]
+        reply = await self._roundtrip(*sub)
+        if isinstance(reply, Err):
+            await self.close()
+            raise ConnectionError(
+                f"CLIENT TRACKING refused: {reply.val!r}")
+        return self
+
+    async def close(self) -> None:
+        self._connected = False
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader_task = None
+        if self.writer is not None:
+            self.writer.close()
+            try:
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self.writer = None
+        self.reader = None
+
+    # ------------------------------------------------------------ data path
+
+    async def get(self, key: bytes) -> Msg:
+        """GET through the near-cache: a tracked hit costs zero server
+        round-trips.  The `sleep(0)` yield lets the reader task apply
+        any already-arrived invalidation push before the hit is
+        trusted."""
+        if not self._connected:
+            raise ConnectionError("not connected")
+        await asyncio.sleep(0)
+        hit = self.cache.get(key)
+        if hit is not None:
+            self.hits += 1
+            return hit
+        self.misses += 1
+        async with self._lock:
+            self._pending_key, self._poisoned = key, False
+            try:
+                reply = await self._send_and_wait(
+                    [Bulk(b"get"), Bulk(key)])
+            finally:
+                poisoned = self._poisoned
+                self._pending_key, self._poisoned = None, False
+            if not poisoned and not isinstance(reply, Err):
+                if len(self.cache) >= self.max_entries:
+                    # bounded tier: drop the oldest entry (insertion
+                    # order) — correctness never depends on residency
+                    self.cache.pop(next(iter(self.cache)))
+                self.cache[key] = reply
+            return reply
+
+    async def cmd(self, *parts) -> Msg:
+        """Generic passthrough.  A write command's key drops from the
+        near-cache at send time (see module doc, "own writes")."""
+        if not self._connected:
+            raise ConnectionError("not connected")
+        items = [Bulk(p if isinstance(p, bytes) else str(p).encode())
+                 for p in parts]
+        if len(items) > 1 and items[0].val.lower() in _WRITE_CMDS:
+            self.cache.pop(items[1].val, None)
+        async with self._lock:
+            return await self._send_and_wait(items)
+
+    async def set(self, key: bytes, val: bytes) -> Msg:
+        return await self.cmd(b"set", key, val)
+
+    # ------------------------------------------------------------- plumbing
+
+    async def _roundtrip(self, *parts) -> Msg:
+        async with self._lock:
+            return await self._send_and_wait(
+                [Bulk(p if isinstance(p, bytes) else str(p).encode())
+                 for p in parts])
+
+    async def _send_and_wait(self, items: list) -> Msg:
+        try:
+            self.writer.write(encode_msg(Arr(items)))
+            await self.writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._on_disconnect()
+            raise ConnectionError(str(e)) from e
+        reply = await self._replies.get()
+        if reply is None:
+            # the reader task died: the connection is gone (it already
+            # flushed the cache) — surface it on the command path
+            raise ConnectionError("connection lost")
+        return reply
+
+    async def _read_loop(self) -> None:
+        """Dedicated frame pump: push frames apply IMMEDIATELY (the
+        client half of invalidate-before-visible); everything else is a
+        reply for the command in flight."""
+        parser = RespParser()
+        try:
+            while True:
+                data = await self.reader.read(1 << 16)
+                if not data:
+                    break
+                parser.feed(data)
+                while (msg := parser.next_msg()) is not None:
+                    if isinstance(msg, Push):
+                        self._on_push(msg)
+                    else:
+                        self._replies.put_nowait(msg)
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            self._on_disconnect()
+
+    def _on_push(self, msg: Push) -> None:
+        items = msg.items
+        if not items or not isinstance(items[0], Bulk) or \
+                items[0].val != b"invalidate":
+            return  # unknown push kind: ignore (forward-compatible)
+        payload = items[1] if len(items) > 1 else None
+        if isinstance(payload, Arr):
+            for k in payload.items:
+                if isinstance(k, Bulk):
+                    if self.cache.pop(k.val, None) is not None:
+                        self.invalidations += 1
+                    if k.val == self._pending_key:
+                        self._poisoned = True
+        elif isinstance(payload, Nil) or payload is None:
+            self._flush("push-nil")
+
+    def _on_disconnect(self) -> None:
+        if self._connected:
+            self._connected = False
+            self._flush("disconnect")
+            self._replies.put_nowait(None)  # wake a waiting command
+
+    def _flush(self, _why: str) -> None:
+        if self._pending_key is not None:
+            self._poisoned = True
+        if self.cache:
+            self.flushes += 1
+            self.cache.clear()

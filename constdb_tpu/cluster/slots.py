@@ -215,11 +215,17 @@ class ClusterState:
                  "redirects_sent", "migrations_in", "migrations_out",
                  "rev", "import_stall_s", "_gc_pins", "_import_buf",
                  "_import_pins", "_import_touch", "_export_buf",
-                 "_tasks")
+                 "_tasks", "on_slots_lost")
 
     def __init__(self, my_gid: int, table: SlotTable):
         self.my_gid = my_gid
         self.table = table
+        # called with the set of slots whose ownership just moved AWAY
+        # from this group (adopt) — the tracking registry invalidates
+        # every tracked key in them (server/tracking.py slots_lost):
+        # their future writes land on the new owner, so this node can
+        # never keep the one-shot invalidation promise for them
+        self.on_slots_lost = None
         self.migrating: dict[int, str] = {}
         self.importing: dict[int, str] = {}
         self.redirects_sent = 0
@@ -306,11 +312,17 @@ class ClusterState:
         mo, me = mine.owner, mine.slot_epoch
         to, te = table.owner, table.slot_epoch
         changed = False
+        gid = self.my_gid
+        lost: set = set()
         for s in range(NSLOTS):
             e, g = te[s], to[s]
             if e > me[s] or (e == me[s] and g > mo[s]):
+                if mo[s] == gid and g != gid:
+                    lost.add(s)
                 mo[s], me[s] = g, e
                 changed = True
+        if lost and self.on_slots_lost is not None:
+            self.on_slots_lost(lost)
         if table.epoch > mine.epoch:
             mine.epoch = table.epoch
             changed = True

@@ -295,6 +295,20 @@ ENV_REGISTRY: dict[str, EnvVar] = {v.name: v for v in (
            "instead of serving the slot's partial copy (and pinning "
            "GC) forever; a retried migration re-opens the window "
            "cleanly"),
+    EnvVar("CONSTDB_TRACKING_BATCH", "128",
+           "max invalidation keys coalesced into one RESP3 push frame "
+           "per tracked connection before an immediate flush "
+           "(server/tracking.py; the batch half of the dual bound)"),
+    EnvVar("CONSTDB_TRACKING_LATENCY_MS", "2",
+           "max milliseconds a pending invalidation key waits in a "
+           "tracked connection's coalescing buffer before its push "
+           "frame flushes (the latency half of the dual bound); 0 = "
+           "flush on the next loop tick"),
+    EnvVar("CONSTDB_TRACKING_MAX_KEYS", "65536",
+           "per-connection cap on keys the default-mode tracking "
+           "registry records for one client; past it the server sends "
+           "a flush-all invalidation and starts over (bounded memory, "
+           "never silently stale)"),
 )}
 
 

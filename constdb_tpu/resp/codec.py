@@ -15,7 +15,8 @@ from __future__ import annotations
 from typing import Optional
 
 from ..errors import InvalidRequestMsg
-from .message import Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, Nil, NoReply, Simple
+from .message import (Arr, Bulk, Err, Int, Msg, NIL, NO_REPLY, Nil,
+                      NoReply, Push, Simple)
 
 _CRLF = b"\r\n"
 _COMPACT_THRESHOLD = 1 << 16
@@ -75,6 +76,16 @@ def _py_encode_into(out: bytearray, m: Msg) -> None:
         out += b"$%d\r\n" % len(m.val)
         out += m.val
         out += _CRLF
+    elif isinstance(m, Push):
+        # ordered before Arr (Push subclasses it): RESP3 push frames
+        # carry the '>' type byte but are otherwise array-shaped.  The
+        # native encoder declines subclasses, so this branch is the only
+        # encode path for pushes — RESP2 replies never reach it.
+        out += b">%d\r\n" % len(m.items)
+        for item in m.items:
+            if isinstance(item, NoReply):
+                raise TypeError("NoReply inside Push would desync the frame")
+            encode_into(out, item)
     elif isinstance(m, Arr):
         out += b"*%d\r\n" % len(m.items)
         for item in m.items:
@@ -347,6 +358,16 @@ class RespParser:
             if n > 1 << 20:
                 raise InvalidRequestMsg("array too large")
             return Arr([self._parse(depth + 1) for _ in range(n)])
+        if t == 0x3E:  # '>' — RESP3 push frame (client-side parse of
+            # invalidation broadcasts; a push is never nil-length).  The
+            # native scanners defer unknown type bytes here, so both
+            # parsers share this one branch.
+            n = self._int_line()
+            if n < 0:
+                raise InvalidRequestMsg("negative push length")
+            if n > 1 << 20:
+                raise InvalidRequestMsg("push frame too large")
+            return Push([self._parse(depth + 1) for _ in range(n)])
         raise InvalidRequestMsg(f"unexpected type byte {bytes([t])!r}")
 
 

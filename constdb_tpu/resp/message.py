@@ -109,6 +109,25 @@ class Arr(Msg):
         return hash(("Arr", tuple(self.items)))
 
 
+class Push(Arr):
+    """RESP3 push frame: >len\r\n ... — an out-of-band server-initiated
+    message (invalidation broadcasts, server/tracking.py).  Subclasses
+    Arr so every item-walking consumer works unchanged, but compares as
+    its own type: a Push is NOT equal to an Arr with the same items
+    (the wire type byte differs)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return f"Push({self.items!r})"
+
+    def __eq__(self, other) -> bool:
+        return type(other) is Push and other.items == self.items
+
+    def __hash__(self) -> int:
+        return hash(("Push", tuple(self.items)))
+
+
 NIL = Nil()
 NO_REPLY = NoReply()
 OK = Simple(b"OK")

@@ -135,6 +135,7 @@ READ_FLUSH_FAMILIES: dict[bytes, tuple] = {
     b"sismember": ("env", "el"),
     b"hget": ("env", "el"),
     b"hgetall": ("env", "el"),
+    b"hlen": ("env", "el"),
     b"lrange": ("env", "el"),
     b"llen": ("env", "el"),
     b"mvget": ("env", "el"),
@@ -306,6 +307,16 @@ def execute(node: "Node", req, client=None, uuid=None) -> Msg:
         _invalidate_read_cache(node, cmd, items[1:], scoped=True)
         if not (cmd.flags & CMD_NO_REPLICATE):
             node.replicate_cmd(uuid, name, items[1:])
+    elif client is not None and client.tracking == 1 and \
+            fams is not None and len(items) > 1:
+        # default-mode client tracking (server/tracking.py): record the
+        # key this tracked connection just read — the listed key-scoped
+        # reads (READ_FLUSH_FAMILIES) are exactly the first-key-confined
+        # data reads, so items[1] is the one key the reply observes
+        try:
+            node.tracking.note_read(client, as_bytes(items[1]))
+        except CstError:
+            pass
     return reply
 
 
@@ -326,7 +337,21 @@ def _invalidate_read_cache(node: "Node", cmd: Command, args: list,
     member-scoped when `scoped` (the SUCCESS path only: an errored
     handler gets the conservative whole-key drop).  Invalidating on the
     ERROR path too is deliberate — a handler that raised mid-mutation
-    must not leave a stale cached reply behind."""
+    must not leave a stale cached reply behind.
+
+    The tracked-client push stream (server/tracking.py) taps the same
+    seam under its own gate: tracking is key-granular on the wire, so
+    member-scoped writes still push the whole key."""
+    tr = node.tracking
+    if tr is not None and tr.active:
+        if cmd.flags & CMD_CTRL or not cmd.families:
+            if cmd.flags & CMD_CTRL:
+                tr.flush_all()
+        else:
+            try:
+                tr.invalidate_key(as_bytes(args[0]) if args else b"")
+            except CstError:
+                tr.flush_all()
     rc = node.read_cache
     if not len(rc):
         return
@@ -522,11 +547,83 @@ def repllog_command(node, ctx, args):
     raise UnknownSubCmd(sub, "REPLLOG")
 
 
+@register("hello", CMD_CTRL)
+def hello_command(node, ctx, args):
+    """HELLO [protover] — RESP protocol negotiation (Redis 6 shape,
+    flattened to a RESP2 key/value array either way).  `HELLO 3` arms
+    RESP3 on the connection: the server may then write out-of-band push
+    frames (server/tracking.py invalidation broadcasts).  Connections
+    that never say HELLO 3 stay byte-exact RESP2 — no push frame is
+    ever emitted toward them.  Dropping back to HELLO 2 turns tracking
+    off first (a RESP2 stream cannot carry the pushes)."""
+    c = ctx.client
+    if args.has_more:
+        try:
+            ver = args.next_int()
+        except CstError:
+            return Err(b"NOPROTO unsupported protocol version")
+        if ver not in (2, 3):
+            return Err(b"NOPROTO unsupported protocol version")
+        if c is not None:
+            if ver == 2 and c.tracking:
+                node.tracking.unsubscribe(c)
+            c.resp3 = ver == 3
+    proto = 3 if c is not None and c.resp3 else 2
+    return Arr([Bulk(b"server"), Bulk(b"constdb"),
+                Bulk(b"version"), Bulk(b"1"),
+                Bulk(b"proto"), Int(proto),
+                Bulk(b"id"), Int(c.cid if c is not None else 0),
+                Bulk(b"mode"),
+                Bulk(b"cluster" if node.cluster is not None
+                     else b"standalone")])
+
+
 @register("client", CMD_CTRL)
 def client_command(node, ctx, args):
     sub = args.next_str().lower()
     if sub == "threadid":
         return Bulk(str(threading.get_ident()).encode())
+    if sub == "id":
+        # unique per-connection id (Redis CLIENT ID); 0 for executions
+        # with no connection (tests, replication, internal)
+        return Int(ctx.client.cid if ctx.client is not None else 0)
+    if sub == "list":
+        app = getattr(node, "app", None)
+        conns = list(app.client_conns.values()) \
+            if app is not None and getattr(app, "client_conns", None) \
+            else ([ctx.client] if ctx.client is not None else [])
+        lines = "".join(c.describe() + "\n"
+                        for c in sorted(conns, key=lambda c: c.cid))
+        return Bulk(lines.encode())
+    if sub == "tracking":
+        # CLIENT TRACKING on|off [BCAST] [PREFIX p]... (server/tracking.py)
+        mode = args.next_str().lower()
+        bcast = False
+        prefixes: list = []
+        while args.has_more:
+            opt = args.next_str().lower()
+            if opt == "bcast":
+                bcast = True
+            elif opt == "prefix":
+                prefixes.append(args.next_bytes())
+            else:
+                raise UnknownSubCmd(opt, "CLIENT TRACKING")
+        c = ctx.client
+        if mode == "off":
+            if c is not None and c.tracking:
+                node.tracking.unsubscribe(c)
+            return OK
+        if mode != "on":
+            raise UnknownSubCmd(mode, "CLIENT TRACKING")
+        if c is None:
+            return Err(b"CLIENT TRACKING requires a client connection")
+        if not c.resp3:
+            return Err(b"CLIENT TRACKING requires the RESP3 protocol "
+                       b"(say HELLO 3 first)")
+        if prefixes and not bcast:
+            return Err(b"PREFIX requires BCAST mode")
+        node.tracking.subscribe(c, bcast=bcast, prefixes=tuple(prefixes))
+        return OK
     raise UnknownSubCmd(sub, "CLIENT")
 
 
@@ -814,6 +911,24 @@ def hgetall_command(node, ctx, args):
         raise _invalid_type()
     return Arr([Arr([Bulk(f), Bulk(v if v is not None else b"")])
                 for f, v, _t in ks.elem_live(kid)])
+
+
+@serve_read("hlen", "card", enc=S.ENC_DICT)
+@register("hlen", CMD_READONLY)
+def hlen_command(node, ctx, args):
+    """HLEN key — live field count (the hash twin of SCNT/LLEN; Redis
+    HLEN).  Mirrors HGETALL's visibility exactly: the key-level
+    tombstone is NOT consulted — a dead key's count is the count of its
+    live fields (normally 0, but add-wins fields newer than the delete
+    stay visible)."""
+    key = args.next_bytes()
+    ks = node.ks
+    kid = ks.query(key, ctx.uuid)
+    if kid < 0:
+        return Int(0)
+    if ks.enc_of(kid) != S.ENC_DICT:
+        raise _invalid_type()
+    return Int(sum(1 for _ in ks.elem_live(kid)))
 
 
 @register("hdel", CMD_WRITE, families=("env", "el"))

@@ -37,6 +37,10 @@ def _section_server(node, out):
 def _section_clients(node, out):
     out.append(("connected_clients", node.stats.current_clients))
     out.append(("total_connections_received", node.stats.connections_accepted))
+    # client-assisted caching (server/tracking.py): live tracked
+    # subscriptions on this node
+    tr = getattr(node, "tracking", None)
+    out.append(("tracking_clients", tr.n_clients if tr is not None else 0))
 
 
 def _current_rss_bytes():
@@ -180,6 +184,13 @@ def _section_stats(node, out):
     out.append(("oom_hard_reclaims", st.oom_hard_reclaims))
     out.append(("client_outbuf_disconnects", st.client_outbuf_disconnects))
     out.append(("repl_window_pauses", st.repl_window_pauses))
+    # client-assisted caching (server/tracking.py): invalidation keys
+    # pushed to tracked connections, the push frames carrying them, and
+    # over-outbuf trackers demoted to untracked (each one a loud
+    # disconnect — the reconnect-flush law restores correctness)
+    out.append(("tracking_invalidations_sent", st.tracking_invalidations_sent))
+    out.append(("tracking_pushes", st.tracking_pushes))
+    out.append(("tracking_demotions", st.tracking_demotions))
     if st.serve_lat:
         lat_ms = np.fromiter(st.serve_lat, dtype=np.float64) * 1000.0
         out.append(("serve_lat_p50_ms",

@@ -283,6 +283,10 @@ class ServerApp:
             from ..cluster.slots import ClusterState, even_split
             node.cluster = ClusterState(
                 cluster_group, even_split(max(1, self.slot_groups)))
+            # slot ownership moving away invalidates every tracked key
+            # hashing into the moved slots (server/tracking.py
+            # slots_lost — the migration half of the tracking laws)
+            node.cluster.on_slots_lost = node.tracking.slots_lost
         self.serve_plane = None
         # awaited by start() AFTER the serve plane is up but BEFORE the
         # listener opens — the sharded boot restore (start_node) runs
@@ -297,6 +301,11 @@ class ServerApp:
         self._server: Optional[asyncio.base_events.Server] = None
         self._cron_task: Optional[asyncio.Task] = None
         self._conn_tasks: set[asyncio.Task] = set()
+        # live client connections (server/tracking.py ClientConn), keyed
+        # by the monotonically-minted client id — CLIENT ID/LIST and the
+        # tracking registry's fan-out both read this
+        self.client_conns: dict[int, object] = {}
+        self._next_cid = 0
         self._closing = False
         from ..persist.share import SharedDump
         self.shared_dump = SharedDump(self)
@@ -483,6 +492,16 @@ class ServerApp:
         self._conn_tasks.add(task)
         self.node.stats.connections_accepted += 1
         self.node.stats.current_clients += 1
+        from .tracking import ClientConn
+        self._next_cid += 1
+        try:
+            peer = writer.get_extra_info("peername")
+            addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        except (AttributeError, OSError, IndexError):  # pragma: no cover
+            addr = "?"
+        client = ClientConn(self._next_cid, addr, writer,
+                            created=time.time())
+        self.client_conns[client.cid] = client
         try:
             # bound the transport's userspace reply buffer: drain()
             # engages at the high-water mark, so one connection's
@@ -506,7 +525,8 @@ class ServerApp:
             # With a serve PLANE active the chunk is ROUTED instead
             # (server/serve_shards.py) — the workers own the coalescers.
             from .serve import ServeCoalescer
-            coal = ServeCoalescer(self.node, max_run=self.serve_batch)
+            coal = ServeCoalescer(self.node, max_run=self.serve_batch,
+                                  client=client)
         try:
             while True:
                 data = await reader.read(_READ_CHUNK)
@@ -526,7 +546,7 @@ class ServerApp:
                                                      parser)
                             upgraded = True
                             break
-                        reply = self.node.execute(msg)
+                        reply = self.node.execute(msg, client=client)
                         if not isinstance(reply, NoReply):
                             encode_into(out, reply)
                 else:
@@ -552,7 +572,7 @@ class ServerApp:
                             parser.pushback(msgs[i + 1:])
                             if i:
                                 await self._run_chunk(plane, coal,
-                                                      msgs[:i], out)
+                                                      msgs[:i], out, client)
                             await self._aof_ack_barrier()
                             out = self._flush_out(writer, out)
                             self._upgrade_to_replica(msg, reader, writer,
@@ -561,7 +581,8 @@ class ServerApp:
                             break
                     else:
                         if msgs:
-                            await self._run_chunk(plane, coal, msgs, out)
+                            await self._run_chunk(plane, coal, msgs, out,
+                                                  client)
                 if upgraded:
                     return  # connection now owned by the replica link
                 if out:
@@ -596,10 +617,11 @@ class ServerApp:
                     salvaged = head
                 if salvaged:
                     if coal is not None or plane is not None:
-                        await self._run_chunk(plane, coal, salvaged, out)
+                        await self._run_chunk(plane, coal, salvaged, out,
+                                              client)
                     else:
                         for msg in salvaged:
-                            reply = self.node.execute(msg)
+                            reply = self.node.execute(msg, client=client)
                             if not isinstance(reply, NoReply):
                                 encode_into(out, reply)
                 await self._aof_ack_barrier()
@@ -616,6 +638,15 @@ class ServerApp:
         finally:
             self.node.stats.current_clients -= 1
             self._conn_tasks.discard(task)
+            # tracking state dies with the connection (the liveness half
+            # of the invalidate-before-visible law): a client's cached
+            # entries are only trustworthy while the connection that
+            # filled them lives, so the server forgets the subscription
+            # the moment it can no longer deliver pushes on it
+            if client.tracking:
+                self.node.tracking.unsubscribe(client)
+            client.writer = None
+            self.client_conns.pop(client.cid, None)
             # an upgraded connection is owned by its replica link now
             if not upgraded and not writer.is_closing():
                 writer.close()
@@ -628,12 +659,15 @@ class ServerApp:
             await oplog.ack_barrier()
 
     async def _run_chunk(self, plane, coal, msgs: list,
-                         out: bytearray) -> None:
+                         out: bytearray, client=None) -> None:
         """One drained pipelined chunk, through whichever machinery this
         node runs: the shard-routing plane (serve_shards > 1) or the
-        in-loop coalescer (serve_batch > 1)."""
+        in-loop coalescer (serve_batch > 1).  `client` is the
+        connection's ClientConn (HELLO / CLIENT TRACKING state) — the
+        coalescer already carries it; the shared plane takes it per
+        chunk."""
         if plane is not None:
-            await plane.run_chunk(msgs, out)
+            await plane.run_chunk(msgs, out, client=client)
         else:
             coal.run_chunk(msgs, out)
 

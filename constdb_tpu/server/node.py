@@ -109,6 +109,12 @@ class NodeStats:
     oom_hard_reclaims: int = 0
     client_outbuf_disconnects: int = 0
     repl_window_pauses: int = 0
+    # client-assisted caching (server/tracking.py): invalidation keys
+    # pushed to tracked RESP3 connections, push frames written, and
+    # slow trackers demoted to untracked at the outbuf cap
+    tracking_invalidations_sent: int = 0
+    tracking_pushes: int = 0
+    tracking_demotions: int = 0
     merges: int = 0
     merge_rows: int = 0
     merge_secs: float = 0.0
@@ -259,6 +265,12 @@ class Node:
         # = the exact pre-cluster single-group node (every hot-path gate
         # is one `is None` test)
         self.cluster = None
+        # RESP3 client tracking (server/tracking.py): the invalidation
+        # fan-out to tracked client connections.  Always constructed
+        # (empty dicts), never active until a CLIENT TRACKING on — every
+        # hot-path tap gates on `.active`, one attribute test.
+        from .tracking import TrackingRegistry
+        self.tracking = TrackingRegistry(self)
 
     def _make_keyspace(self) -> KeySpace:
         """Fresh keyspace with the node's event wiring (shared by boot and
@@ -355,7 +367,15 @@ class Node:
         wire batches, serve-coalescer runs, oplog replay all ride
         merge_batch/merge_batches, so hooking here (BEFORE the merge
         lands) is what makes invalidate-before-visible complete
-        (server/read_cache.py)."""
+        (server/read_cache.py) — and the tracked-client push stream
+        (server/tracking.py) taps the same seam with its own gate, so
+        wire invalidation is complete by the same construction."""
+        tr = self.tracking
+        if tr is not None and tr.active:
+            for b in batches:
+                tr.invalidate_keys(b.keys)
+                if b.del_keys:
+                    tr.invalidate_keys(b.del_keys)
         rc = self.read_cache
         if not len(rc):
             return
@@ -466,6 +486,11 @@ class Node:
         # every cached reply describes wiped state (and its stamps hold
         # kids of the discarded keyspace object)
         self.read_cache.clear()
+        # ... and so does every tracked client's near-cache: flush-all
+        # push before the wipe is visible (server/tracking.py)
+        tr = self.tracking
+        if tr is not None and tr.active:
+            tr.flush_all()
         cap = self.repl_log.cap
         fence = max(self.repl_log.last_uuid, self.hlc.current)
         self.ks = self._make_keyspace()

@@ -143,7 +143,7 @@ CONFLICT = object()
 _OP_SET, _OP_INCR1, _OP_INCR, _OP_DECR1, _OP_DECR = 1, 2, 3, 4, 5
 _OP_SADD, _OP_SREM, _OP_HSET, _OP_HDEL = 6, 7, 8, 9
 _OP_GET, _OP_SCNT, _OP_SISMEMBER, _OP_SMEMBERS = 10, 11, 12, 13
-_OP_HGET, _OP_HGETALL, _OP_LLEN = 14, 15, 16
+_OP_HGET, _OP_HGETALL, _OP_LLEN, _OP_HLEN = 14, 15, 16, 17
 _FIRST_READ_OP = _OP_GET
 
 _OP_NAME = {_OP_SET: b"set", _OP_INCR1: b"incr", _OP_INCR: b"incr",
@@ -151,7 +151,8 @@ _OP_NAME = {_OP_SET: b"set", _OP_INCR1: b"incr", _OP_INCR: b"incr",
             _OP_SREM: b"srem", _OP_HSET: b"hset", _OP_HDEL: b"hdel",
             _OP_GET: b"get", _OP_SCNT: b"scnt",
             _OP_SISMEMBER: b"sismember", _OP_SMEMBERS: b"smembers",
-            _OP_HGET: b"hget", _OP_HGETALL: b"hgetall", _OP_LLEN: b"llen"}
+            _OP_HGET: b"hget", _OP_HGETALL: b"hgetall", _OP_LLEN: b"llen",
+            _OP_HLEN: b"hlen"}
 # shared command-head Bulks for demote-time message materialization
 # (handlers only ever read them)
 _OP_HEAD = {op: Bulk(nm) for op, nm in _OP_NAME.items()}
@@ -162,7 +163,7 @@ _OOM_OPS = frozenset((_OP_SET, _OP_INCR1, _OP_INCR, _OP_DECR1, _OP_DECR,
 # read opcode -> (SERVE_READS spec, canonical lowercase name): the same
 # (spec, name) pair _planner_of resolves per message
 _NOP_READ = {op: (SERVE_READS[_OP_NAME[op]], _OP_NAME[op])
-             for op in range(_FIRST_READ_OP, _OP_LLEN + 1)}
+             for op in range(_FIRST_READ_OP, _OP_HLEN + 1)}
 # element-family write opcodes that share one planner body
 _NOP_ELEM = {_OP_SADD: (b"sadd", S.ENC_SET, True),
              _OP_SREM: (b"srem", S.ENC_SET, False),
@@ -203,13 +204,17 @@ class ServeCoalescer:
     __slots__ = ("node", "max_run", "nodeid", "ks", "regs", "cnts", "els",
                  "tns", "_keys", "_pending_keys", "_buf", "_log",
                  "_pending", "_planned", "_lat_pending", "_sample_every",
-                 "_now", "_cur_uuid")
+                 "_now", "_cur_uuid", "client")
 
     def __init__(self, node, max_run: int = 512,
                  sample_every: int | None = None,
-                 now=time.monotonic) -> None:
+                 now=time.monotonic, client=None) -> None:
         from ..conf import env_int
         self.node = node
+        # the connection's ClientConn (server/tracking.py): demoted
+        # per-command executions carry it into ExecCtx, and planned
+        # reads feed note_read for default-mode tracking subscribers
+        self.client = client
         self.max_run = max_run
         self.nodeid = node.node_id
         self.ks = node.ks
@@ -877,6 +882,16 @@ class ServeCoalescer:
         back at their exact positions."""
         node = self.node
         st = node.stats
+        cl = self.client
+        if cl is not None and cl.tracking == 1:
+            # default-mode client tracking (server/tracking.py): every
+            # read in the batch is a key this connection observes — the
+            # tap covers cache hits, planned gathers, AND demotions
+            # (the demoted re-execute records again; note_read is
+            # idempotent per key)
+            trk = node.tracking
+            for sp in specs:
+                trk.note_read(cl, sp[4])
         # read-your-writes: the run must land first iff a read observes
         # a key with pending rows; reads of un-pending keys commute
         # with the whole pending run (the batched twin of
@@ -1176,7 +1191,7 @@ class ServeCoalescer:
         write executed per-command by CHOICE is not a barrier, but its
         mutation still invalidates its key's cached probes."""
         node = self.node
-        reply = node.execute(msg, uuid=self._cur_uuid)
+        reply = node.execute(msg, client=self.client, uuid=self._cur_uuid)
         if not isinstance(reply, NoReply):
             encode_into(out, reply)
         if count_barrier:

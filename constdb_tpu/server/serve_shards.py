@@ -161,10 +161,21 @@ class ServeShardPlane:
 
     # ------------------------------------------------------------- routing
 
-    async def run_chunk(self, msgs: list, out: bytearray) -> None:
+    async def run_chunk(self, msgs: list, out: bytearray,
+                        client=None) -> None:
         """Plan, route, and execute one drained chunk of client
-        messages, appending every reply to `out` in request order."""
+        messages, appending every reply to `out` in request order.
+
+        `client` is the connection's ClientConn (server/tracking.py).
+        The PARENT owns every tracked subscription on a sharded node —
+        invalidation streams fold through this routing plane: a routed
+        write invalidates at route time (before the worker executes it,
+        so invalidate-before-visible holds), a routed read feeds
+        default-mode note_read, and barrier commands carry the client
+        into the parent-side execute (HELLO / CLIENT TRACKING work
+        unchanged)."""
         node = self.node
+        tracking = node.tracking
         n = len(msgs)
         if not n:
             return
@@ -236,6 +247,12 @@ class ServeShardPlane:
                     except Exception:
                         key = None  # execute() raises the exact op error
                     if key is not None:
+                        if tracking is not None and tracking.active:
+                            if cmd.is_write:
+                                tracking.invalidate_key(key)
+                            elif client is not None and \
+                                    client.tracking == 1:
+                                tracking.note_read(client, key)
                         shard = shard_of(key, self.n_shards)
                         uuid = node.hlc.tick(cmd.is_write)
                         sub = subs.get(shard)
@@ -260,7 +277,7 @@ class ServeShardPlane:
                 if had_outstanding:
                     node.stats.extra["serve_xshard_barriers"] = \
                         node.stats.extra.get("serve_xshard_barriers", 0) + 1
-                reply = node.execute(msg)
+                reply = node.execute(msg, client=client)
                 if not lone:
                     node.stats.serve_barriers += 1
                 if not isinstance(reply, NoReply):
@@ -376,8 +393,16 @@ class ServeShardPlane:
         from ..store.sharded_keyspace import extract_shard, shard_ids
         applied = 0
         x = self.node.stats.extra
+        tracking = self.node.tracking
         try:
             for b in batches:
+                if tracking is not None and tracking.active:
+                    # bulk intake (full/delta sync) mutates worker state
+                    # without touching the parent command path — the
+                    # tracked-invalidation fold happens here, pre-merge
+                    tracking.invalidate_keys(b.keys)
+                    if b.del_keys:
+                        tracking.invalidate_keys(b.del_keys)
                 sids = shard_ids(b.keys, self.n_shards)
                 dsids = shard_ids(b.del_keys, self.n_shards) \
                     if b.del_keys else None
@@ -467,6 +492,9 @@ class ServeShardPlane:
         every shard worker, fence fresh segments at the pre-wipe
         watermark, and kick every other live peer connection."""
         node = self.node
+        tr = node.tracking
+        if tr is not None and tr.active:
+            tr.flush_all()  # the wiped state invalidates EVERY near-cache
         await self.pool.barrier()
         fence = max(self.merged.last_uuid, node.hlc.current)
         await self.pool.call_all("reset")
@@ -560,7 +588,14 @@ class ShardApplier:
             if not self._frames:
                 self._advance(uuid)
             return
-        shard = shard_of(as_bytes(items[5]), self.plane.n_shards)
+        key = as_bytes(items[5])
+        tr = self.node.tracking
+        if tr is not None and tr.active:
+            # replicated write folding into a worker: invalidate on the
+            # parent BEFORE the frame routes (the sharded twin of
+            # apply_replicated's pre-land invalidation)
+            tr.invalidate_key(key)
+        shard = shard_of(key, self.plane.n_shards)
         if not self._frames:
             self._first_ts = self._now()
         encode_into(self._bufs[shard], Arr(items))

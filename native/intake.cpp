@@ -15,7 +15,7 @@
 //
 // NATIVE-INTAKE-TABLE-BEGIN (parsed by analysis/rules.py NATIVE-CONTRACT)
 //   native: set incr decr sadd srem hset hdel
-//   native-reads: get scnt sismember smembers hget hgetall llen
+//   native-reads: get scnt sismember smembers hget hgetall llen hlen
 //   python-only: cntundo tensor.set tensor.merge lrange
 // NATIVE-INTAKE-TABLE-END
 //
@@ -81,6 +81,7 @@ enum Op : unsigned char {
     OP_HGET = 14,
     OP_HGETALL = 15,
     OP_LLEN = 16,
+    OP_HLEN = 17,
 };
 
 constexpr unsigned char kFirstRead = OP_GET;
@@ -146,6 +147,7 @@ inline unsigned char classify(const char* nm, Py_ssize_t nl, Py_ssize_t n) {
             if (!memcmp(nm, "scnt", 4)) return n == 2 ? OP_SCNT : OP_OTHER;
             if (!memcmp(nm, "hget", 4)) return n == 3 ? OP_HGET : OP_OTHER;
             if (!memcmp(nm, "llen", 4)) return n == 2 ? OP_LLEN : OP_OTHER;
+            if (!memcmp(nm, "hlen", 4)) return n == 2 ? OP_HLEN : OP_OTHER;
             break;
         case 7:
             if (!memcmp(nm, "hgetall", 7))

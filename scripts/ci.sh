@@ -224,6 +224,42 @@ print("read-path smoke verified:",
 EOF
 
 echo
+echo "== tracking smoke (bench --mode tracked) =="
+# tiny oracle-verified run of the client-assisted caching tier over
+# real sockets: K tracked RESP3 near-cache clients vs K plain clients
+# on the same deterministic hot-key 90:10 storm.  The server must have
+# actually pushed invalidations (tracking_invalidations_sent > 0, no
+# loud demotions), every entry still resident in a near-cache at
+# quiesce must equal a direct server read (zero-stale), the stripped
+# exports must match across legs, and the reads that reached the
+# server must shrink by the advertised floor (the unit/property suites
+# proper run inside tier-1 — tests/test_tracking.py /
+# tests/test_resp_fuzz.py; the track-partition chaos cell rides the
+# chaos smoke below, the full tracking cell set the slow matrix)
+JAX_PLATFORMS=cpu CONSTDB_BENCH_TRACKED_OPS=8000 \
+CONSTDB_BENCH_TRACKED_REPS=1 \
+    timeout -k 10 300 python bench.py --mode tracked \
+    > /tmp/_ci_tracked.json || exit $?
+python - <<'EOF' || exit $?
+import json
+out = json.load(open("/tmp/_ci_tracked.json"))
+assert out["verified"], "tracking smoke failed oracle verification"
+trk = out["tracked"]
+assert trk["tracking_invalidations_sent"] > 0, \
+    "server never pushed an invalidation"
+assert trk["tracking_demotions"] == 0, "a tracker was demoted"
+assert trk["stale_entries"] == 0, "near-cache served stale entries"
+assert out["export_ok"], "tracked leg diverged from the plain leg"
+assert out["value"] >= 5.0, \
+    f"server-side read reduction collapsed: {out['value']}x"
+print("tracking smoke verified:",
+      f"{out['plain']['server_read_ops']} -> {trk['server_read_ops']}",
+      f"server reads = {out['value']}x, hit rate",
+      f"{trk['near_cache_hit_rate']},",
+      f"{trk['tracking_invalidations_sent']} invalidations pushed")
+EOF
+
+echo
 echo "== resync smoke (bench --mode resync) =="
 # tiny oracle-verified run of the digest-negotiated delta resync vs the
 # full-snapshot leg through the REAL push loop: both pullers must

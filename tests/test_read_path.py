@@ -69,8 +69,10 @@ def read_heavy_workload(n_conns: int, rounds: int, seed: int = 31,
                         chunk.append(cmd(b"hgetall", b"h" + k))
                     elif q < 0.89:
                         chunk.append(cmd(b"lrange", b"l" + k, 0, -1))
-                    elif q < 0.93:
+                    elif q < 0.91:
                         chunk.append(cmd(b"llen", b"l" + k))
+                    elif q < 0.93:
+                        chunk.append(cmd(b"hlen", b"h" + k))
                     elif q < 0.95:
                         chunk.append(cmd(b"get", b"c" + k))  # counter get
                     elif q < 0.97:

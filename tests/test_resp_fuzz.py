@@ -11,7 +11,7 @@ import pytest
 from constdb_tpu.errors import InvalidRequestMsg
 from constdb_tpu.resp.codec import (NativeRespParser, RespParser, encode_into,
                                     encode_msg)
-from constdb_tpu.resp.message import Arr, Bulk, Err, Int, NIL, Simple
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, NIL, Push, Simple
 
 PARSERS = (RespParser, NativeRespParser)  # native degrades to pure w/o ext
 
@@ -19,10 +19,13 @@ PARSERS = (RespParser, NativeRespParser)  # native degrades to pure w/o ext
 def rand_msg(rng: random.Random, depth: int = 0):
     """A random message tree.  Simple/Err payloads exclude CR/LF (the
     encoder is not responsible for escaping line frames — no real reply
-    contains them); Bulk payloads are arbitrary binary."""
+    contains them); Bulk payloads are arbitrary binary.  Push frames
+    (RESP3, server/tracking.py) only ever appear top-level on a real
+    wire, but the parser accepts them at any depth — fuzz both."""
     r = rng.random()
     if depth < 3 and r < 0.25:
-        return Arr([rand_msg(rng, depth + 1)
+        cls = Push if rng.random() < 0.2 else Arr
+        return cls([rand_msg(rng, depth + 1)
                     for _ in range(rng.randrange(0, 6))])
     if r < 0.45:
         return Bulk(bytes(rng.randrange(256)
@@ -180,6 +183,156 @@ def test_configured_bulk_cap_enforced(parser_cls):
     parser.feed(b"$2048\r\n")
     with pytest.raises(InvalidRequestMsg):
         parser.next_msg()
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+def test_push_frames_roundtrip(parser_cls):
+    """RESP3 push frames (server/tracking.py invalidation shape) round-
+    trip in BOTH parsers, compare as their own type (a Push is never
+    equal to the Arr with the same items), and survive every-prefix
+    truncation without the cursor advancing early."""
+    frames = [
+        Push([Bulk(b"invalidate"), Arr([Bulk(b"k1"), Bulk(b"k2")])]),
+        Push([Bulk(b"invalidate"), NIL]),
+        Push([]),
+        Push([Bulk(b"invalidate"),
+              Arr([Bulk(bytes(range(256)))])]),  # binary key
+    ]
+    wire = b"".join(encode_msg(f) for f in frames)
+    parser = parser_cls()
+    parser.feed(wire)
+    got = parser.drain()
+    assert got == frames
+    for g in got:
+        assert type(g) is Push
+    # Push != Arr with identical items, both directions
+    p = Push([Bulk(b"x")])
+    a = Arr([Bulk(b"x")])
+    assert p != a and a != p
+    assert encode_msg(p) == b">1\r\n$1\r\nx\r\n"
+    assert encode_msg(a) == b"*1\r\n$1\r\nx\r\n"
+    # every-prefix truncation: None + whole prefix buffered, then exact
+    for f in frames:
+        w = encode_msg(f)
+        for cut in range(len(w)):
+            parser = parser_cls()
+            parser.feed(w[:cut])
+            assert parser.next_msg() is None, (f, cut)
+            assert parser.buffered == cut, (f, cut)
+            parser.feed(w[cut:])
+            assert parser.next_msg() == f, (f, cut)
+
+
+@pytest.mark.parametrize("parser_cls", PARSERS)
+@pytest.mark.parametrize("bad", (
+    b">-2\r\n",             # negative push length
+    b">99999999\r\n",       # absurd push header
+    b">x\r\n",              # non-integer push length
+))
+def test_malformed_push_rejected(parser_cls, bad):
+    parser = parser_cls()
+    parser.feed(bad)
+    with pytest.raises(InvalidRequestMsg):
+        while parser.next_msg() is None:
+            pass  # pragma: no cover - raise happens on the first call
+
+
+def test_tracked_vs_untracked_lockstep_differential():
+    """The serve-path differential for client tracking: one tracked
+    RESP3 connection and one plain RESP2 connection send the IDENTICAL
+    command stream to the same node; the tracked stream minus its push
+    frames must be byte-identical to the untracked stream (tracking is
+    an out-of-band overlay, never a reply rewrite) — and the RESP2
+    stream must contain no push bytes at all."""
+    import asyncio
+
+    from constdb_tpu.server.io import start_node
+    from constdb_tpu.server.node import Node
+
+    rng = random.Random(31337)
+    keys = [b"k%d" % i for i in range(8)]
+    script: list[list[bytes]] = []
+    for _ in range(120):
+        k = rng.choice(keys)
+        script.append(rng.choice((
+            [b"set", k, b"v%d" % rng.randrange(100)],
+            [b"get", k], [b"incr", b"c:" + k], [b"get", b"c:" + k],
+            [b"hset", b"h:" + k, b"f", b"1"], [b"hlen", b"h:" + k],
+            [b"sadd", b"s:" + k, b"m%d" % rng.randrange(4)],
+            [b"scnt", b"s:" + k],
+        )))
+
+    async def main():
+        node = Node(alias="difftest")
+        app = await start_node(node, port=0)
+        addr = app.advertised_addr
+
+        async def stream(tracked: bool):
+            host, port = addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            parser = RespParser()
+
+            async def roundtrip(parts):
+                writer.write(encode_msg(Arr([Bulk(p) for p in parts])))
+                await writer.drain()
+                while True:
+                    m = parser.next_msg()
+                    if m is None:
+                        data = await reader.read(1 << 16)
+                        assert data, "server closed mid-differential"
+                        parser.feed(data)
+                        continue
+                    if isinstance(m, Push):
+                        assert tracked, "push frame on a RESP2 stream"
+                        continue
+                    return m
+
+            if tracked:
+                assert not isinstance(await roundtrip([b"hello", b"3"]),
+                                      Err)
+                assert not isinstance(
+                    await roundtrip([b"client", b"tracking", b"on"]), Err)
+            replies = [await roundtrip(parts) for parts in script]
+            writer.close()
+            return replies
+
+        tracked = await stream(True)
+        node2 = Node(alias="difftest2")
+        app2 = await start_node(node2, port=0)
+        addr2 = app2.advertised_addr
+
+        async def stream2():
+            host, port = addr2.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            parser = RespParser()
+            replies = []
+            for parts in script:
+                writer.write(encode_msg(Arr([Bulk(p) for p in parts])))
+                await writer.drain()
+                while True:
+                    m = parser.next_msg()
+                    if m is not None:
+                        assert not isinstance(m, Push)
+                        replies.append(m)
+                        break
+                    data = await reader.read(1 << 16)
+                    assert data
+                    parser.feed(data)
+            writer.close()
+            return replies
+
+        untracked = await stream2()
+        assert len(tracked) == len(untracked) == len(script)
+        # non-push portion byte-identical: same message objects AND the
+        # same re-encoded bytes
+        assert tracked == untracked
+        assert b"".join(map(encode_msg, tracked)) == \
+            b"".join(map(encode_msg, untracked))
+        assert node.stats.tracking_invalidations_sent > 0
+        await app.close()
+        await app2.close()
+
+    asyncio.run(main())
 
 
 def test_parsers_agree_on_random_trees():

@@ -1,0 +1,463 @@
+"""Client-assisted caching (round 20): RESP3 push tracking + near-cache.
+
+The load-bearing claims, each pinned here (docs/INVARIANTS.md
+"Tracking laws"):
+  * registry bookkeeping — default mode is one-shot per (conn, key);
+    the per-connection tracked set is capped (flush-all past the cap,
+    never silently stale); unsubscribe drops every trace;
+  * coalescing — invalidations flush under a dual batch/latency bound:
+    one push frame carries the whole pending batch;
+  * BCAST — prefix filtering is exact, and a flush encodes ONCE per
+    prefix class regardless of subscriber count (the PR 13 encode-once
+    cache shares the bytes);
+  * backpressure — a tracked connection over the PR 12 outbuf cap is
+    demoted LOUDLY (counter + abort), never silently stale;
+  * slot migration — keys hashing into a lost slot are invalidated the
+    moment ownership flips (cluster/slots.py adopt hook);
+  * end-to-end over real sockets — HELLO 3 negotiation, CLIENT
+    TRACKING/ID/LIST, push delivery on peer writes, INFO gauges, and
+    the client near-cache's reconnect-flush + own-write laws.
+"""
+
+import asyncio
+import types
+
+from constdb_tpu.client import NearCacheClient
+from constdb_tpu.resp.codec import RespParser
+from constdb_tpu.resp.message import Arr, Bulk, Err, Int, Nil, Push
+from constdb_tpu.server import tracking as tracking_mod
+from constdb_tpu.server.node import Node
+from constdb_tpu.server.tracking import (TRACK_DEFAULT, TRACK_OFF,
+                                         TrackingRegistry, ClientConn)
+
+from cluster_util import Client, close_cluster, make_cluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ====================================================================
+# registry unit tests (fake transports, no sockets)
+# ====================================================================
+
+class FakeTransport:
+    def __init__(self):
+        self.buf_size = 0
+        self.closed = False
+        self.aborted = False
+
+    def is_closing(self):
+        return self.closed
+
+    def get_write_buffer_size(self):
+        return self.buf_size
+
+    def abort(self):
+        self.aborted = True
+        self.closed = True
+
+
+class FakeWriter:
+    def __init__(self):
+        self.transport = FakeTransport()
+        self.frames: list[bytes] = []
+
+    def write(self, data):
+        self.frames.append(bytes(data))
+
+
+def parse_pushes(frames: list[bytes]) -> list:
+    """Decode a writer's frames; every one must be a RESP3 push."""
+    parser = RespParser()
+    for f in frames:
+        parser.feed(f)
+    out = []
+    while (m := parser.next_msg()) is not None:
+        assert isinstance(m, Push), m
+        assert m.items[0] == Bulk(b"invalidate")
+        out.append(m.items[1])
+    return out
+
+
+def push_keys(payload) -> set:
+    assert isinstance(payload, Arr), payload
+    return {i.val for i in payload.items}
+
+
+def make_registry(batch: int = 1) -> tuple[Node, TrackingRegistry]:
+    node = Node(node_id=77)
+    reg = node.tracking
+    reg.batch = batch          # deterministic: flush on the batch bound
+    return node, reg
+
+
+def tracked_conn(reg, cid=1, bcast=False, prefixes=()):
+    c = ClientConn(cid, f"t:{cid}", FakeWriter())
+    c.resp3 = True
+    reg.subscribe(c, bcast=bcast, prefixes=prefixes)
+    return c
+
+
+def test_registry_default_mode_one_shot():
+    node, reg = make_registry()
+    c = tracked_conn(reg)
+    assert reg.active and c.tracking == TRACK_DEFAULT
+    reg.note_read(c, b"k1")
+    reg.note_read(c, b"k1")           # idempotent
+    assert reg.key_map == {b"k1": {c}}
+    # a mutation of an untracked key sends nothing
+    reg.invalidate_key(b"other")
+    assert not c.writer.frames
+    # first mutation of the tracked key pushes; the promise is spent
+    reg.invalidate_key(b"k1")
+    (payload,) = parse_pushes(c.writer.frames)
+    assert push_keys(payload) == {b"k1"}
+    assert b"k1" not in reg.key_map and b"k1" not in c.tracked
+    c.writer.frames.clear()
+    reg.invalidate_key(b"k1")         # one-shot: no second push
+    assert not c.writer.frames
+    assert node.stats.tracking_invalidations_sent == 1
+    assert node.stats.tracking_pushes == 1
+    # unsubscribe drops every trace and deactivates the registry
+    reg.note_read(c, b"k2")
+    reg.unsubscribe(c)
+    assert c.tracking == TRACK_OFF and not c.tracked and not c.pend
+    assert not reg.key_map and not reg.active
+
+
+def test_registry_batch_coalescing():
+    node, reg = make_registry(batch=3)
+    c = tracked_conn(reg)
+    for k in (b"a", b"b", b"c"):
+        reg.note_read(c, k)
+    reg.invalidate_key(b"a")
+    reg.invalidate_key(b"b")
+    assert not c.writer.frames            # below the batch bound, no loop
+    reg.invalidate_key(b"c")              # bound reached: one frame, 3 keys
+    (payload,) = parse_pushes(c.writer.frames)
+    assert push_keys(payload) == {b"a", b"b", b"c"}
+    assert node.stats.tracking_pushes == 1
+    assert node.stats.tracking_invalidations_sent == 3
+
+
+def test_registry_max_keys_flush_all():
+    node, reg = make_registry()
+    reg.max_keys = 3
+    c = tracked_conn(reg)
+    for i in range(3):
+        reg.note_read(c, b"k%d" % i)
+    assert len(c.tracked) == 3 and not c.writer.frames
+    reg.note_read(c, b"k3")               # over the cap: flush-all, reset
+    (payload,) = parse_pushes(c.writer.frames)
+    assert isinstance(payload, Nil)       # nil payload = flush everything
+    assert not c.tracked and not reg.key_map
+    assert node.stats.tracking_invalidations_sent == 1
+
+
+def test_registry_bcast_prefix_filter_and_encode_once():
+    node, reg = make_registry(batch=4)
+    u1 = tracked_conn(reg, 1, bcast=True, prefixes=(b"user:",))
+    u2 = tracked_conn(reg, 2, bcast=True, prefixes=(b"user:",))
+    every = tracked_conn(reg, 3, bcast=True)
+    encodes = {"n": 0}
+    real = tracking_mod._encode_keys_frame
+
+    def counting(keys):
+        encodes["n"] += 1
+        return real(keys)
+
+    tracking_mod._encode_keys_frame = counting
+    try:
+        for k in (b"user:a", b"user:b", b"item:c", b"item:d"):
+            reg.invalidate_key(k)
+    finally:
+        tracking_mod._encode_keys_frame = real
+    # one encode per prefix class — NOT per subscriber (u2 spliced u1's
+    # published bytes through node.wire_cache)
+    assert encodes["n"] == 2
+    (p1,) = parse_pushes(u1.writer.frames)
+    (p2,) = parse_pushes(u2.writer.frames)
+    assert push_keys(p1) == {b"user:a", b"user:b"}   # prefix-filtered
+    assert u1.writer.frames == u2.writer.frames      # byte-identical
+    (pe,) = parse_pushes(every.writer.frames)
+    assert push_keys(pe) == {b"user:a", b"user:b", b"item:c", b"item:d"}
+    assert node.stats.tracking_pushes == 3
+    # no per-read bookkeeping in BCAST mode
+    assert not reg.key_map and not u1.tracked
+
+
+def test_registry_outbuf_demotion_is_loud():
+    node, reg = make_registry()
+    node.app = types.SimpleNamespace(client_outbuf_max=100)
+    c = tracked_conn(reg)
+    reg.note_read(c, b"k")
+    c.writer.transport.buf_size = 1000    # over the cap when the push fires
+    reg.invalidate_key(b"k")
+    assert not c.writer.frames            # frame dropped, not buffered
+    assert c.writer.transport.aborted     # client observes a disconnect
+    assert c.tracking == TRACK_OFF and not reg.active
+    assert node.stats.tracking_demotions == 1
+
+
+def test_registry_flush_all_and_slots_lost():
+    from constdb_tpu.cluster.slots import slot_of
+    node, reg = make_registry()
+    c = tracked_conn(reg, 1)
+    b = tracked_conn(reg, 2, bcast=True)
+    reg.note_read(c, b"moved")
+    reg.note_read(c, b"stays")
+    # pick a slot set containing only "moved"
+    reg.slots_lost({slot_of(b"moved")} - {slot_of(b"stays")})
+    (payload,) = parse_pushes(c.writer.frames)
+    assert push_keys(payload) == {b"moved"}
+    assert b"stays" in c.tracked          # unmoved key still tracked
+    # BCAST subscription is prefix-, not slot-scoped: flush-all
+    (pb,) = parse_pushes(b.writer.frames)
+    assert isinstance(pb, Nil)
+    c.writer.frames.clear()
+    b.writer.frames.clear()
+    # state-wipe events flush every tracked client wholesale
+    reg.flush_all()
+    (pc,) = parse_pushes(c.writer.frames)
+    (pb,) = parse_pushes(b.writer.frames)
+    assert isinstance(pc, Nil) and isinstance(pb, Nil)
+    assert not reg.key_map and not c.tracked
+
+
+# ====================================================================
+# end-to-end over real sockets
+# ====================================================================
+
+async def wait_for(pred, timeout=5.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not pred():
+        assert loop.time() < deadline, f"timed out waiting for {what}"
+        await asyncio.sleep(0.01)
+
+
+def test_tracking_e2e_push_info_and_client_list(tmp_path):
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        node = apps[0].node
+        nc = await NearCacheClient(apps[0].advertised_addr).connect()
+        w = await Client().connect(apps[0].advertised_addr)
+        try:
+            assert nc.client_id > 0
+            await w.cmd("set", "k", "v1")
+            assert await nc.get(b"k") == Bulk(b"v1")
+            assert await nc.get(b"k") == Bulk(b"v1")   # near-cache hit
+            assert nc.hits == 1 and nc.misses == 1
+            # a peer write pushes an invalidation; the near-cache drops
+            # the key without this client issuing any command
+            await w.cmd("set", "k", "v2")
+            await wait_for(lambda: b"k" not in nc.cache,
+                           what="invalidation push")
+            assert nc.invalidations == 1
+            assert await nc.get(b"k") == Bulk(b"v2")   # fresh re-read
+            assert node.stats.tracking_invalidations_sent >= 1
+            assert node.stats.tracking_pushes >= 1
+            # CLIENT ID / LIST + INFO gauges
+            assert isinstance(await w.cmd("client", "id"), Int)
+            listing = (await w.cmd("client", "list")).val.decode()
+            assert "resp=3 tracking=on" in listing
+            assert "resp=2 tracking=off" in listing
+            info = (await w.cmd("info", "clients")).val.decode()
+            assert "tracking_clients:1" in info
+            assert "connected_clients:2" in info
+            stats = (await w.cmd("info", "stats")).val.decode()
+            assert "tracking_invalidations_sent:" in stats
+            assert "tracking_pushes:" in stats
+            assert "tracking_demotions:0" in stats
+        finally:
+            await nc.close()
+            await w.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_near_cache_reconnect_flushes(tmp_path):
+    """Reconnect-flush law, client half: ANY disconnect makes every
+    cached entry untrustworthy (the server's one-shot promise died with
+    the connection), so the first read after reconnect goes to the
+    server."""
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        nc = await NearCacheClient(apps[0].advertised_addr).connect()
+        w = await Client().connect(apps[0].advertised_addr)
+        try:
+            await w.cmd("set", "k", "old")
+            assert await nc.get(b"k") == Bulk(b"old")
+            assert b"k" in nc.cache
+            # sever the tracked connection (socket-level, no goodbye)
+            nc.writer.transport.abort()
+            await wait_for(lambda: not nc._connected,
+                           what="disconnect detection")
+            assert not nc.cache and nc.flushes >= 1
+            # the write happens while no tracking subscription exists —
+            # no push will ever describe it
+            await w.cmd("set", "k", "new")
+            await nc.connect()
+            assert await nc.get(b"k") == Bulk(b"new")  # NOT the stale "old"
+        finally:
+            await nc.close()
+            await w.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_near_cache_own_writes_drop_locally(tmp_path):
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        nc = await NearCacheClient(apps[0].advertised_addr).connect()
+        try:
+            await nc.set(b"k", b"v1")
+            assert await nc.get(b"k") == Bulk(b"v1")
+            await nc.set(b"k", b"v2")          # drops b"k" at send time
+            assert b"k" not in nc.cache
+            assert await nc.get(b"k") == Bulk(b"v2")
+        finally:
+            await nc.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_near_cache_bcast_prefixes(tmp_path):
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        nc = await NearCacheClient(apps[0].advertised_addr, bcast=True,
+                                   prefixes=(b"hot:",)).connect()
+        w = await Client().connect(apps[0].advertised_addr)
+        try:
+            await w.cmd("set", "hot:k", "a")
+            await w.cmd("set", "cold:k", "a")
+            assert await nc.get(b"hot:k") == Bulk(b"a")
+            assert await nc.get(b"cold:k") == Bulk(b"a")
+            await w.cmd("set", "hot:k", "b")
+            await wait_for(lambda: b"hot:k" not in nc.cache,
+                           what="bcast invalidation")
+            # outside the prefix: no push, entry stays (by design —
+            # the subscription scopes trust to the prefix list)
+            await asyncio.sleep(0.05)
+            assert b"cold:k" in nc.cache
+            assert await nc.get(b"hot:k") == Bulk(b"b")
+        finally:
+            await nc.close()
+            await w.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_slots_lost_pushes_over_the_wire(tmp_path):
+    """Cluster mode: adopting a slot table that moves a tracked key's
+    slot away fires the adopt-time hook (io.py wires
+    cluster.on_slots_lost to the registry) and the invalidation
+    reaches the tracked client as a real push frame — no CTRL command
+    involved, the pure gossip-adoption path."""
+    async def main():
+        from constdb_tpu.cluster.slots import slot_of
+
+        apps = await make_cluster(1, str(tmp_path), cluster=True,
+                                  slot_groups=2, cluster_group=0)
+        node = apps[0].node
+        nc = await NearCacheClient(apps[0].advertised_addr).connect()
+        w = await Client().connect(apps[0].advertised_addr)
+        try:
+            # two group-0-owned keys in distinct slots
+            keys, j = [], 0
+            while len(keys) < 2:
+                k = b"adopt%d" % j
+                if slot_of(k) < 8192 and (not keys or
+                                          slot_of(k) != slot_of(keys[0])):
+                    keys.append(k)
+                j += 1
+            moving, staying = keys
+            for k in keys:
+                await w.cmd(b"set", k, b"v")
+                assert await nc.get(k) == Bulk(b"v")
+            # adopt a table minting the moved slot to the other group
+            table = node.cluster.table.copy()
+            s = slot_of(moving)
+            table.epoch = node.cluster.epoch + 1
+            table.assign(s, s + 1, 1, epoch=table.epoch)
+            node.cluster.adopt(table)
+            await wait_for(lambda: moving not in nc.cache,
+                           what="slots_lost push")
+            assert nc.invalidations == 1 and nc.flushes == 0
+            assert staying in nc.cache     # per-slot, not flush-all
+        finally:
+            await nc.close()
+            await w.close()
+            await close_cluster(apps)
+    run(main())
+
+
+# ====================================================================
+# HLEN: the hash twin of SCNT/LLEN on the read planner
+# ====================================================================
+
+def test_hlen_command_surface(tmp_path):
+    async def main():
+        apps = await make_cluster(1, str(tmp_path))
+        c = await Client().connect(apps[0].advertised_addr)
+        try:
+            assert await c.cmd("hlen", "h") == Int(0)      # missing key
+            await c.cmd("hset", "h", "f1", "v1")
+            await c.cmd("hset", "h", "f2", "v2")
+            assert await c.cmd("hlen", "h") == Int(2)
+            await c.cmd("hdel", "h", "f1")
+            assert await c.cmd("hlen", "h") == Int(1)
+            await c.cmd("set", "s", "v")
+            bad = await c.cmd("hlen", "s")                 # type conflict
+            assert isinstance(bad, Err) and b"WRONGTYPE" in bad.val
+            bad = await c.cmd("hlen")                      # wrong arity
+            assert isinstance(bad, Err)
+            await c.cmd("del", "h")
+            assert await c.cmd("hlen", "h") == Int(0)
+        finally:
+            await c.close()
+            await close_cluster(apps)
+    run(main())
+
+
+def test_hlen_rides_read_planner_and_cache(tmp_path):
+    """Pipelined HLEN goes through the coalesced read planner (a cache
+    entry forms) and repeat rounds hit the reply cache; a member write
+    stamps the entry dead."""
+    async def main():
+        from constdb_tpu.resp.codec import encode_msg
+
+        apps = await make_cluster(1, str(tmp_path))
+        node = apps[0].node
+        host, port = apps[0].advertised_addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        parser = RespParser()
+
+        async def chunk(cmds):
+            writer.write(b"".join(
+                encode_msg(Arr([Bulk(p) for p in parts])) for parts in cmds))
+            await writer.drain()
+            out = []
+            while len(out) < len(cmds):
+                data = await reader.read(1 << 16)
+                assert data
+                parser.feed(data)
+                while (m := parser.next_msg()) is not None:
+                    out.append(m)
+            return out
+
+        await chunk([[b"hset", b"h", b"f%d" % i, b"v"] for i in range(3)])
+        r1 = await chunk([[b"hlen", b"h"]] * 4)
+        assert all(m == Int(3) for m in r1)
+        hits0 = node.read_cache.hits
+        r2 = await chunk([[b"hlen", b"h"]] * 4)
+        assert all(m == Int(3) for m in r2)
+        assert node.read_cache.hits > hits0, "hlen not cache-served"
+        # member write invalidates the whole-key card entry
+        await chunk([[b"hdel", b"h", b"f0"]])
+        (r3,) = await chunk([[b"hlen", b"h"]])
+        assert r3 == Int(3 - 1)
+        writer.close()
+        await close_cluster(apps)
+    run(main())
